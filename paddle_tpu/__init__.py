@@ -1,0 +1,92 @@
+"""paddle_tpu — a TPU-native framework with Paddle's API surface.
+
+The ``paddle.*`` public namespace (upstream ``python/paddle/__init__.py``)
+re-implemented over jax/XLA. ``import paddle_tpu as paddle`` is the intended
+usage; ``paddle_tpu.compat.install()`` also registers it as ``paddle``.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (
+    Tensor, Parameter, to_tensor, no_grad, enable_grad, set_grad_enabled,
+    is_grad_enabled,
+    DType, bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128,
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CUDAPinnedPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    seed, get_rng_state, set_rng_state,
+)
+from .framework.dtype import convert_dtype
+from .framework import random as _random_mod
+
+# the full op surface: paddle.add, paddle.matmul, ...
+from .ops import *  # noqa: F401,F403
+from .ops import OPS as _OPS
+
+from . import autograd
+from .autograd import grad
+
+# aliases matching paddle top-level
+bool = bool_
+
+from . import nn
+from . import optimizer
+from . import metric
+from . import io
+from . import vision
+from . import amp
+from . import jit
+from . import static
+from . import device
+from . import distributed
+from . import incubate
+from . import utils
+from . import profiler
+from . import sparse
+from . import fft
+from .hapi import Model, summary
+from .framework.io import save, load
+from .nn.layer.layers import Layer
+from .parallel import DataParallel
+from .base_flags import set_flags, get_flags
+
+# paddle.linalg / paddle.tensor namespace parity (flat + namespaced access)
+import sys as _sys
+from .ops import linalg as linalg
+from . import ops as tensor
+_sys.modules[__name__ + ".linalg"] = linalg
+
+disable_static = static.disable_static
+enable_static = static.enable_static
+in_dynamic_mode = static.in_dynamic_mode
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def check_shape_dtype(*a, **k):  # legacy no-op helpers
+    pass
+
+
+def disable_signal_handler():
+    pass
+
+
+def set_default_dtype(dtype):
+    from .framework import dtype as _dt
+    global _default_dtype
+    _default_dtype = _dt.convert_dtype(dtype)
+
+
+def get_default_dtype():
+    return getattr(_get_module(), "_default_dtype", float32).name
+
+
+def _get_module():
+    import sys
+    return sys.modules[__name__]
+
+
+_default_dtype = float32

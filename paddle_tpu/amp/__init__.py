@@ -1,0 +1,213 @@
+"""AMP (``python/paddle/amp/`` parity) — bf16-first on TPU.
+
+O1 = op-list based autocast at dispatch; O2 = cast the model to the low
+dtype with fp32 master weights in the optimizer. On TPU bf16 needs no loss
+scaling, so ``GradScaler`` is a numerically-transparent pass-through that
+still implements the full found_inf protocol for fp16 parity
+(``check_finite_and_unscale`` / ``update_loss_scaling`` op equivalents).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+from ..framework.dtype import convert_dtype
+
+__all__ = ["auto_cast", "autocast", "decorate", "GradScaler", "amp_guard",
+           "is_bfloat16_supported", "is_float16_supported",
+           "white_list", "black_list"]
+
+# Paddle O1 lists (``python/paddle/amp/amp_lists.py``): matmul/conv run in
+# low precision, reductions/softmax/norms stay fp32.
+WHITE_LIST = {"matmul", "linear", "conv1d", "conv2d", "conv3d", "bmm", "mm",
+              "einsum", "flash_attention"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "layer_norm",
+              "batch_norm", "rms_norm", "mean", "sum", "exp", "log",
+              "logsumexp", "erf", "pow", "cumsum"}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+from ..framework.core import set_amp_hook as _set_amp_hook
+
+
+def _cast_for_op(op_name, arrays):
+    """Called from the dispatch layer when AMP O1 is active."""
+    if not _state.enabled or _state.level != "O1":
+        return arrays
+    low = convert_dtype(_state.dtype).np_dtype
+    if op_name in WHITE_LIST:
+        return [a.astype(low) if hasattr(a, "dtype")
+                and jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays]
+    if op_name in BLACK_LIST:
+        return [a.astype(np.float32) if hasattr(a, "dtype")
+                and a.dtype == low else a for a in arrays]
+    return arrays
+
+
+_set_amp_hook(_cast_for_op)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level)
+    added_w = set(custom_white_list or ())
+    added_b = set(custom_black_list or ())
+    WHITE_LIST.update(added_w)
+    BLACK_LIST.update(added_b)
+    _state.enabled = enable
+    _state.dtype = dtype
+    _state.level = level
+    try:
+        yield
+    finally:
+        _state.enabled, _state.dtype, _state.level = prev
+        WHITE_LIST.difference_update(added_w)
+        BLACK_LIST.difference_update(added_b)
+
+
+autocast = auto_cast
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model floating params to low dtype; optimizer keeps fp32
+    master copies (multi_precision)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+            m._casted_by_pure_fp16 = True
+    if optimizers is not None:
+        single_opt = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if single_opt else list(optimizers)
+        for opt in opt_list:
+            opt._multi_precision = True if master_weight is None \
+                else bool(master_weight)
+        if single_model:
+            return models, optimizers
+        return model_list, opt_list
+    return models if single_model else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (``python/paddle/amp/grad_scaler.py``). With
+    bf16 (TPU default) scaling is 1.0 and checks are cheap no-ops unless
+    enabled explicitly."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable or self._scale == 1.0:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = as_jax(p.grad) * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                if not finite:
+                    found = True
+                p._grad = _wrap_out(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._scale != 1.0:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, d):
+        self._scale = d.get("scale", self._scale)
+        self._good_steps = d.get("good_steps", 0)
+        self._bad_steps = d.get("bad_steps", 0)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

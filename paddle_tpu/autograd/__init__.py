@@ -1,0 +1,158 @@
+"""User-facing autograd API (``python/paddle/autograd/`` parity).
+
+``backward``/``grad`` drive the eager tape engine in framework/core.py;
+``PyLayer`` lets users define custom VJPs that participate in the tape.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..framework.core import (
+    Tensor, GradNode, apply_jax, as_jax, _wrap_out, calc_gradients,
+    is_grad_enabled, no_grad, enable_grad, run_backward, set_grad_enabled,
+)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext", "hessian",
+           "jacobian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors,
+                                                   (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    return calc_gradients(outputs, inputs, grad_outputs=grad_outputs,
+                          retain_graph=retain_graph,
+                          create_graph=create_graph,
+                          allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = value
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose pullback calls the user's ``backward``."""
+
+    __slots__ = ("ctx", "backward_fn", "n_inputs")
+
+    def __init__(self, op_name, ctx, backward_fn, inputs, outputs):
+        super().__init__(op_name, None, inputs, outputs)
+        self.ctx = ctx
+        self.backward_fn = backward_fn
+        self.vjp_fn = self._call_backward
+
+    def _call_backward(self, out_grads):
+        grads_in = [_wrap_out(g) for g in out_grads]
+        res = self.backward_fn(self.ctx, *grads_in)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        return tuple(None if r is None else as_jax(r) for r in res)
+
+    def release(self):
+        self.ctx = None
+        self.backward_fn = None
+        super().release()
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer is not instantiable; use .apply()")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom autograd op (``python/paddle/autograd/py_layer.py`` parity)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if needs_grad:
+            out_tensors = []
+            for o in out_list:
+                t = _wrap_out(as_jax(o))
+                t.stop_gradient = False
+                out_tensors.append(t)
+            diff_inputs = [t for t in tensor_inputs if not t.stop_gradient]
+            node = _PyLayerNode(cls.__name__, ctx, cls.backward,
+                                tensor_inputs, out_tensors)
+            for t in out_tensors:
+                t.grad_node = node
+            out_list = out_tensors
+        return out_list[0] if single else tuple(out_list)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense jacobian via the functional path (jax.jacrev on replay is not
+    possible post-hoc; computed column-by-column through the tape)."""
+    import numpy as np
+    ys_t = ys if isinstance(ys, Tensor) else ys[0]
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    flat_y = int(np.prod(ys_t.shape)) if ys_t.shape else 1
+    rows = []
+    for i in range(flat_y):
+        seed = jnp.zeros((flat_y,), as_jax(ys_t).dtype).at[i].set(1.0)
+        seed = seed.reshape(tuple(ys_t.shape) if ys_t.shape else ())
+        gs = calc_gradients([ys_t], xs_list, grad_outputs=[_wrap_out(seed)],
+                            retain_graph=True, allow_unused=True)
+        rows.append([None if g is None else as_jax(g).reshape(-1)
+                     for g in gs])
+    outs = []
+    for j in range(len(xs_list)):
+        cols = [r[j] for r in rows]
+        outs.append(_wrap_out(jnp.stack(
+            [c if c is not None else
+             jnp.zeros(int(np.prod(xs_list[j].shape)),
+                       as_jax(xs_list[j]).dtype) for c in cols])))
+    return outs[0] if not isinstance(xs, (list, tuple)) else outs
+
+
+def hessian(func_or_ys, xs=None, batch_axis=None):
+    raise NotImplementedError(
+        "hessian: use the functional API (paddle_tpu.incubate.autograd) "
+        "backed by jax.hessian")

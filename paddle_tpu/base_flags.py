@@ -1,0 +1,59 @@
+"""Runtime flag system (``paddle/common/flags.cc`` / ``paddle.set_flags``).
+
+A registry of FLAGS_* knobs settable via env or ``set_flags``; consumers
+read through ``get_flag``. Env vars win at first read, matching Paddle's
+gflags-from-env behavior.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {}
+_DEFAULTS: Dict[str, Any] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_check_nan_inf_level": 0,
+    "FLAGS_cudnn_deterministic": True,   # XLA is deterministic by default
+    "FLAGS_embedding_deterministic": 1,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_use_stream_safe_cuda_allocator": True,
+    "FLAGS_benchmark": False,
+    "FLAGS_paddle_tpu_donate_buffers": True,
+    "FLAGS_paddle_tpu_default_matmul_precision": "default",
+    "FLAGS_log_level": 0,
+}
+
+
+def _coerce(default, raw: str):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def get_flag(name: str, default=None):
+    if name in _FLAGS:
+        return _FLAGS[name]
+    if name in os.environ:
+        base = _DEFAULTS.get(name, default)
+        val = _coerce(base if base is not None else "", os.environ[name])
+        _FLAGS[name] = val
+        return val
+    if name in _DEFAULTS:
+        return _DEFAULTS[name]
+    return default
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: get_flag(k) for k in flags}

@@ -1,0 +1,147 @@
+"""Device API (``python/paddle/device/``) over jax devices."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.place import (
+    Place, CPUPlace, CUDAPlace, TPUPlace, set_device, get_device,
+    _get_default_place,
+)
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "device_count", "synchronize", "cuda",
+           "is_compiled_with_cuda", "Stream", "Event", "current_stream"]
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all dispatched work completes (stream sync parity)."""
+    try:
+        (jax.device_put(0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class Stream:
+    """XLA owns scheduling on TPU; streams are no-op handles
+    (``StreamSafeCUDAAllocator`` concerns disappear — SURVEY.md §5.2)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class _CudaNS:
+    """``paddle.device.cuda`` compat namespace mapped onto the accelerator."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def current_stream(device=None):
+        return Stream(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = _memory_stats()
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        stats = _memory_stats()
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = _memory_stats()
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        stats = _memory_stats()
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.devices()[0]
+        class _Props:
+            name = getattr(d, "device_kind", str(d))
+            total_memory = _memory_stats().get("bytes_limit", 0)
+            major, minor = 0, 0
+            multi_processor_count = 1
+        return _Props()
+
+
+def _memory_stats():
+    try:
+        return jax.devices()[0].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+cuda = _CudaNS()

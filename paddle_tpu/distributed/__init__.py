@@ -1,0 +1,47 @@
+"""``paddle.distributed`` namespace (L4 in SURVEY.md §1).
+
+Mesh-based: process groups are mesh axes, collectives are XLA ops, the
+launcher shims onto single-controller jax or multi-process emulation.
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized, device_mesh, get_mesh, set_mesh)
+from .collective import (Group, P2POp, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, alltoall,
+                         alltoall_single, barrier, batch_isend_irecv,
+                         broadcast, broadcast_object_list, get_group,
+                         isend, irecv, new_group, recv, reduce_scatter,
+                         scatter, send, wait, _all_reduce_eager_mean)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """``paddle.distributed.spawn`` — multiprocess launch over local
+    devices (used by collective tests; each proc sees the emulated mesh)."""
+    import multiprocessing as mp
+    import os
+    if nprocs == -1:
+        nprocs = 1
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = str(nprocs)
+
+        def target(r=rank, e=env):
+            os.environ.update(e)
+            func(*args)
+
+        p = mp.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawned process exited with {p.exitcode}")
+    return procs
+
+
+def get_backend():
+    return "xla"

@@ -1,0 +1,284 @@
+"""Collective communication facades (``python/paddle/distributed/
+communication/`` parity).
+
+Two execution regimes, matching SURVEY.md §5.8:
+  - Inside ``shard_map``-traced code (the real multi-chip path):
+    facades emit ``jax.lax.p*`` collectives over the named mesh axis —
+    XLA schedules them on ICI.
+  - Eager single-process: world_size==1 group semantics (identity), so
+    Paddle scripts run unchanged on one chip.
+
+``Group`` carries a mesh-axis name instead of an NCCL communicator.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+from . import env as _env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Process-group facade: a set of ranks bound to a mesh axis name."""
+
+    _next_id = 0
+
+    def __init__(self, ranks=None, axis_name=None, pg=None, name=None):
+        self.ranks = list(ranks) if ranks is not None else list(
+            range(_env.get_world_size()))
+        self.axis_name = axis_name
+        Group._next_id += 1
+        self.id = Group._next_id
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        r = _env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def process_group(self):
+        return self
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(axis_name="dp")
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    return Group(ranks=ranks, axis_name=axis_name)
+
+
+def get_group(gid=0):
+    return _get_default_group()
+
+
+def _in_shard_map() -> bool:
+    """True when called under a shard_map trace with named axes bound."""
+    try:
+        return bool(jax.core.get_axis_env().axis_sizes)  # jax>=0.6 internals
+    except Exception:
+        import jax.core as jcore
+        frame = getattr(jcore, "thread_local_state", None)
+        return False
+
+
+def _axis(group):
+    g = group or _get_default_group()
+    return g.axis_name
+
+
+def _maybe_axis_active(axis_name) -> bool:
+    if axis_name is None:
+        return False
+    try:
+        jax.lax.axis_index(axis_name)  # raises NameError outside shard_map
+        return True
+    except Exception:
+        return False
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis(group)
+    arr = as_jax(tensor)
+    if _maybe_axis_active(axis):
+        fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin,
+               ReduceOp.AVG: jax.lax.pmean}
+        out = fns[op](arr, axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return _wrap_out(out)
+    # single-process world: identity
+    return tensor if isinstance(tensor, Tensor) else _wrap_out(arr)
+
+
+def _all_reduce_eager_mean(tensor, group=None):
+    return all_reduce(tensor, ReduceOp.AVG, group)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax_name = _axis(group)
+    arr = as_jax(tensor)
+    if _maybe_axis_active(ax_name):
+        gathered = jax.lax.all_gather(arr, ax_name)
+        n = gathered.shape[0]
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(_wrap_out(gathered[i]) for i in range(n))
+            return
+        return _wrap_out(gathered)
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        tensor_list.append(tensor if isinstance(tensor, Tensor)
+                           else _wrap_out(arr))
+        return
+    return tensor
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.clear()
+    obj_list.append(obj)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax_name = _axis(group)
+    if tensor_list is not None:
+        src = jnp.concatenate([as_jax(t) for t in tensor_list], axis=0)
+    else:
+        src = as_jax(tensor)
+    if _maybe_axis_active(ax_name):
+        out = jax.lax.psum_scatter(src, ax_name, tiled=True)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return _wrap_out(out)
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    # replicated-by-construction on the mesh; identity otherwise
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        g = group or _get_default_group()
+        idx = g.rank if g.rank >= 0 else 0
+        tensor._rebind(tensor_list[idx] if isinstance(tensor_list[idx],
+                                                      Tensor)
+                       else _wrap_out(as_jax(tensor_list[idx])))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op=True):
+    ax_name = _axis(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        stacked = jnp.stack([as_jax(t) for t in in_tensor_list])
+    else:
+        stacked = as_jax(in_tensor_list)
+    if _maybe_axis_active(ax_name):
+        out = jax.lax.all_to_all(stacked, ax_name, split_axis=0,
+                                 concat_axis=0, tiled=False)
+        outs = [_wrap_out(out[i]) for i in range(out.shape[0])]
+    else:
+        outs = [t if isinstance(t, Tensor) else _wrap_out(as_jax(t))
+                for t in (in_tensor_list if isinstance(
+                    in_tensor_list, (list, tuple)) else [in_tensor_list])]
+    if isinstance(out_tensor_list, list):
+        out_tensor_list.clear()
+        out_tensor_list.extend(outs)
+        return
+    return outs
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax_name = _axis(group)
+    arr = as_jax(in_tensor)
+    if _maybe_axis_active(ax_name):
+        out = jax.lax.all_to_all(arr, ax_name, split_axis=0, concat_axis=0,
+                                 tiled=True)
+    else:
+        out = arr
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._data = out
+        return out_tensor
+    return _wrap_out(out)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    if g.nranks == 1:
+        return
+    raise NotImplementedError(
+        "point-to-point send outside shard_map: use ppermute-based "
+        "pipeline schedules (paddle_tpu.distributed.fleet pp) instead")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = group or _get_default_group()
+    if g.nranks == 1:
+        return tensor
+    raise NotImplementedError(
+        "point-to-point recv outside shard_map: use ppermute-based "
+        "pipeline schedules (paddle_tpu.distributed.fleet pp) instead")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    for op in p2p_op_list:
+        op.op(op.tensor, op.peer, op.group)
+    return []
+
+
+def barrier(group=None):
+    try:
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        try:
+            tensor._data.block_until_ready()
+        except Exception:
+            pass
+
+
+def stream_all_reduce(*a, **k):
+    return all_reduce(*a, **k)

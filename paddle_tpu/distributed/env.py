@@ -1,0 +1,133 @@
+"""Distributed environment: the Mesh is the ProcessGroup.
+
+Reference parity: ``ProcessGroupNCCL`` + ``TCPStore`` bootstrap
+(``paddle/fluid/distributed/collective/``, ``paddle/fluid/distributed/
+store/tcp_store.cc``). TPU-first: ``jax.distributed.initialize`` is the
+rendezvous, ``jax.sharding.Mesh`` axes are the process groups, collectives
+are XLA ops over ICI/DCN (SURVEY.md §5.8 mapping).
+
+Single-controller jax means "rank" here is the process index
+(``jax.process_index``), and intra-process device parallelism is expressed
+with shardings rather than ranks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+
+class ParallelEnv:
+    """``paddle.distributed.ParallelEnv`` parity."""
+
+    def __init__(self):
+        self._init_from_env()
+
+    def _init_from_env(self):
+        self.rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                       jax.process_index()))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        n_env = len(eps.split(",")) if eps else jax.process_count()
+        self.world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", n_env))
+        self.device_id = int(os.environ.get("FLAGS_selected_gpus",
+                                            "0").split(",")[0])
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                               "127.0.0.1:6170")
+        self.trainer_endpoints = eps.split(",") if eps else [
+            self.current_endpoint]
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_parallel_env: Optional[ParallelEnv] = None
+_initialized = False
+_global_mesh: Optional[jax.sharding.Mesh] = None
+
+
+def _env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def init_parallel_env(strategy=None):
+    """``paddle.distributed.init_parallel_env`` — multi-host rendezvous via
+    the jax coordination service when endpoints are configured."""
+    global _initialized
+    if _initialized:
+        return _env()
+    env = _env()
+    coord = os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ADDR")
+    if coord and env.world_size > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=f"{coord}:{port}"
+                if ":" not in coord else coord,
+                num_processes=env.world_size, process_id=env.rank)
+        except Exception:
+            pass  # already initialized or single-host emulation
+    _initialized = True
+    return env
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None and hasattr(group, "rank"):
+        return group.rank
+    return _env().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    return _env().world_size
+
+
+def device_mesh(shape: Dict[str, int] = None) -> jax.sharding.Mesh:
+    """The global device mesh. Default: all local devices on one 'dp' axis;
+    fleet topology reshapes it into (pp, dp, sharding, sep, mp) axes."""
+    global _global_mesh
+    if shape is None:
+        if _global_mesh is None:
+            devs = np.array(jax.devices())
+            _global_mesh = jax.sharding.Mesh(devs, ("dp",))
+        return _global_mesh
+    names = tuple(shape.keys())
+    sizes = tuple(shape.values())
+    devs = np.array(jax.devices())
+    total = int(np.prod(sizes))
+    if total > devs.size:
+        raise ValueError(
+            f"mesh {dict(shape)} needs {total} devices, "
+            f"have {devs.size}")
+    mesh = jax.sharding.Mesh(devs[:total].reshape(sizes), names)
+    _global_mesh = mesh
+    return mesh
+
+
+def set_mesh(mesh: jax.sharding.Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[jax.sharding.Mesh]:
+    return _global_mesh

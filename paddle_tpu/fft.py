@@ -1,0 +1,69 @@
+"""``paddle.fft`` over jnp.fft."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import apply_jax
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "rfft2",
+           "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+
+def _wrap1(name, fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_jax(op.__name__,
+                         lambda a: fn(a, n=n, axis=axis, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+def _wrapn(name, fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_jax(op.__name__,
+                         lambda a: fn(a, s=s, axes=axes, norm=norm), x)
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1("fft", jnp.fft.fft)
+ifft = _wrap1("ifft", jnp.fft.ifft)
+rfft = _wrap1("rfft", jnp.fft.rfft)
+irfft = _wrap1("irfft", jnp.fft.irfft)
+hfft = _wrap1("hfft", jnp.fft.hfft)
+ihfft = _wrap1("ihfft", jnp.fft.ihfft)
+fft2 = _wrapn("fft2", lambda a, s, axes, norm: jnp.fft.fft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+ifft2 = _wrapn("ifft2", lambda a, s, axes, norm: jnp.fft.ifft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+rfft2 = _wrapn("rfft2", lambda a, s, axes, norm: jnp.fft.rfft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+irfft2 = _wrapn("irfft2", lambda a, s, axes, norm: jnp.fft.irfft2(
+    a, s=s, axes=axes or (-2, -1), norm=norm))
+fftn = _wrapn("fftn", jnp.fft.fftn)
+ifftn = _wrapn("ifftn", jnp.fft.ifftn)
+rfftn = _wrapn("rfftn", jnp.fft.rfftn)
+irfftn = _wrapn("irfftn", jnp.fft.irfftn)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_jax("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_jax("ifftshift",
+                     lambda a: jnp.fft.ifftshift(a, axes=axes), x)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import _wrap_out
+    from .framework.dtype import to_np
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return _wrap_out(out.astype(to_np(dtype or "float32")))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import _wrap_out
+    from .framework.dtype import to_np
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return _wrap_out(out.astype(to_np(dtype or "float32")))

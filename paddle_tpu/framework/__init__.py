@@ -1,0 +1,21 @@
+import jax as _jax
+
+# Paddle semantics: int64 is the default integer dtype and float64 is a
+# real dtype. jax truncates both unless x64 is enabled. Float defaults
+# remain fp32 via Tensor coercion (python floats -> float32).
+_jax.config.update("jax_enable_x64", True)
+
+from . import dtype as dtype_module
+from .core import (
+    Tensor, Parameter, to_tensor, as_jax, apply_jax, no_grad, enable_grad,
+    is_grad_enabled, set_grad_enabled, run_backward, calc_gradients,
+)
+from .dtype import (
+    DType, convert_dtype, to_np, bool_, uint8, int8, int16, int32, int64,
+    float16, bfloat16, float32, float64, complex64, complex128,
+)
+from .place import (
+    Place, CPUPlace, CUDAPlace, TPUPlace, XPUPlace, CUDAPinnedPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+)
+from .random import seed, get_rng_state, set_rng_state, next_key

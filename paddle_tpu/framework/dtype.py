@@ -1,0 +1,117 @@
+"""Paddle-compatible dtype objects backed by numpy/jax dtypes.
+
+Reference parity: Paddle exposes ``paddle.float32``-style singletons
+(``python/paddle/framework/dtype.py`` upstream) comparable with strings and
+usable anywhere a dtype is accepted. Here each ``DType`` wraps a numpy dtype
+(the representation jax uses) and compares equal to the numpy dtype, the jax
+dtype, its own name string, and itself.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # ml_dtypes ships with jax; bfloat16 lives there
+    import ml_dtypes
+
+    _bfloat16_np = np.dtype(ml_dtypes.bfloat16)
+    _float8_e4m3 = np.dtype(ml_dtypes.float8_e4m3fn)
+    _float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+except Exception:  # pragma: no cover
+    _bfloat16_np = None
+    _float8_e4m3 = None
+    _float8_e5m2 = None
+
+
+class DType:
+    """A Paddle-style dtype singleton."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if np_dtype is not None else None
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or f"paddle.{self.name}" == other
+        if self.np_dtype is not None:
+            try:
+                return np.dtype(other) == self.np_dtype
+            except TypeError:
+                return NotImplemented
+        return NotImplemented
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return NotImplemented if eq is NotImplemented else not eq
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", _bfloat16_np)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", _float8_e4m3)
+float8_e5m2 = DType("float8_e5m2", _float8_e5m2)
+
+_ALL = [
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NP = {d.np_dtype: d for d in _ALL if d.np_dtype is not None}
+
+FLOAT_DTYPES = (float16, bfloat16, float32, float64)
+INT_DTYPES = (uint8, int8, int16, int32, int64)
+
+
+def convert_dtype(dtype) -> DType:
+    """Coerce str / numpy dtype / jax dtype / DType → DType."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    np_dt = np.dtype(dtype)
+    if np_dt in _BY_NP:
+        return _BY_NP[np_dt]
+    raise ValueError(f"Unknown dtype: {dtype!r}")
+
+
+def to_np(dtype):
+    """DType / str / anything → numpy dtype usable by jax."""
+    return convert_dtype(dtype).np_dtype
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in FLOAT_DTYPES
+
+
+def is_integer_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in INT_DTYPES

@@ -1,0 +1,52 @@
+"""``paddle.save`` / ``paddle.load`` (``python/paddle/framework/io.py``).
+
+Pickled nested state dicts with tensors materialized as numpy — same wire
+idea as Paddle's ``.pdparams`` (pickle of name→ndarray), so checkpoints
+written here can be loaded by tools expecting that layout.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor
+
+
+def _tensor_to_numpy(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _tensor_to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_tensor_to_numpy(v) for v in obj)
+    return obj
+
+
+def _numpy_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _numpy_to_tensor(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_numpy_to_tensor(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_tensor_to_numpy(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _numpy_to_tensor(obj)

@@ -1,0 +1,64 @@
+"""Global RNG state (``paddle.seed`` parity) over jax PRNG keys.
+
+Paddle has stateful global generators (``paddle/phi/core/generator.h``);
+jax is functional. We keep a process-global key that is split on every
+draw in eager mode. Inside a jitted step, callers should thread keys
+explicitly (``paddle_tpu.jit`` handles this for dropout by folding in a
+step counter); eager draws that happen during tracing bake the key as a
+constant for that trace, which matches "fixed seed per compiled program".
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class _RNGState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.seed_value = 0
+
+
+_state = _RNGState()
+
+
+def seed(value: int):
+    _state.key = jax.random.PRNGKey(int(value))
+    _state.seed_value = int(value)
+    np.random.seed(int(value) % (2 ** 32))
+    return _state
+
+
+def get_rng_state():
+    return [_state.key]
+
+
+def set_rng_state(state):
+    _state.key = state[0] if isinstance(state, (list, tuple)) else state
+
+
+def next_key():
+    # under the traced/functional path (paddle_tpu.jit), draw from the
+    # per-step traced key so dropout masks differ across jitted steps
+    from .core import _grad_state
+    fk = getattr(_grad_state, "functional_key", None)
+    if fk is not None:
+        _grad_state.functional_key, sub = jax.random.split(fk)
+        return sub
+    _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+def set_functional_key(key):
+    from .core import _grad_state
+    _grad_state.functional_key = key
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

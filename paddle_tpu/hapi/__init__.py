@@ -1,0 +1,3 @@
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ProgBarLogger)
+from .model import Model, summary_impl as summary

@@ -1,0 +1,265 @@
+"""``paddle.Model`` high-level API (``python/paddle/hapi/model.py``).
+
+train_batch runs through ``paddle_tpu.jit.TrainStep`` — the whole step
+(forward, backward, clip, update) is one donated XLA program, so Model.fit
+is the compiled path by default (mode='eager' falls back to the tape)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out, no_grad
+from ..metric import Metric
+from .callbacks import CallbackList, ProgBarLogger
+from ..static import InputSpec
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._jit_train = True
+        self.stop_training = False
+
+    # ------------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit_compile=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, list) \
+                else [metrics]
+        self._jit_train = jit_compile
+        return self
+
+    def _loss_value(self, outputs, labels):
+        loss_fn = self._loss
+        if loss_fn is None:
+            raise ValueError("call prepare(loss=...) before training")
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        loss = loss_fn(*outs, *labs)
+        if isinstance(loss, (list, tuple)):
+            from ..ops.math import add
+            total = loss[0]
+            for l in loss[1:]:
+                total = total + l
+            return total
+        return loss
+
+    # ------------------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        inputs = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([labels] if labels is not None else [])
+        labels = [t if isinstance(t, Tensor) else Tensor(t) for t in labels]
+
+        if self._jit_train and update:
+            if self._train_step is None:
+                from ..jit import TrainStep
+
+                def loss_fn(out, args, kwargs):
+                    labs = kwargs.get("_labels", ())
+                    return self._loss_value(out, list(labs))
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
+            loss = self._train_step(*inputs, _labels=tuple(labels))
+            return [float(loss.numpy())]
+
+        # eager fallback path (tape)
+        outputs = self.network(*inputs)
+        loss = self._loss_value(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.numpy())]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        inputs = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([labels] if labels is not None else [])
+        labels = [t if isinstance(t, Tensor) else Tensor(t) for t in labels]
+        outputs = self.network(*inputs)
+        metrics = []
+        if self._loss is not None and labels:
+            loss = self._loss_value(outputs, labels)
+            metrics.append(float(loss.numpy()))
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        for m in self._metrics:
+            res = m.compute(*outs, *labels)
+            m.update(*(res if isinstance(res, (list, tuple)) else [res]))
+        return metrics
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        inputs = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]
+        out = self.network(*inputs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.numpy() for o in outs]
+
+    # ------------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbks = CallbackList([ProgBarLogger(log_freq, verbose)]
+                            + (callbacks or []))
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+            cbks.append(ModelCheckpoint(save_freq, save_dir))
+        cbks.set_model(self)
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks.set_params({"epochs": epochs, "steps": steps,
+                         "verbose": verbose})
+        self.stop_training = False
+        cbks.on_train_begin()
+        global_step = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss}
+                cbks.on_train_batch_end(step, logs)
+                global_step += 1
+                if num_iters is not None and global_step >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            metrics = self.eval_batch(inputs, labels)
+            if metrics:
+                losses.append(metrics[0])
+        logs = {}
+        if losses:
+            logs["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if callable(getattr(m, "name", None)) else \
+                [str(m)]
+            if isinstance(names, str):
+                names = [names]
+            if not isinstance(res, (list, tuple)):
+                res = [res]
+            for n, r in zip(names, res):
+                logs[n] = r
+        if verbose:
+            print(" - ".join(f"{k}: {v}" for k, v in logs.items()))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) == 2:
+                return batch[0], batch[1]
+            return batch[:-1], batch[-1]
+        return batch, None
+
+    # ------------------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+        from ..framework.io import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fload(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary_impl(self.network, input_size, dtype)
+
+
+def summary_impl(network, input_size=None, dtype=None):
+    total, trainable = 0, 0
+    lines = []
+    for name, p in network.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        lines.append(f"  {name:60s} {str(p.shape):24s} {n}")
+    report = "\n".join(lines)
+    print(report)
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,41 @@
+"""``paddle.incubate`` — fused ops & experimental APIs.
+
+The fused-op python APIs (``python/paddle/incubate/nn/functional``) map to
+compositions XLA fuses automatically; they exist for source compatibility
+and route to the same Pallas/XLA kernels as the nn.functional ops.
+"""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..ops.creation import tril
+    from ..nn.functional import softmax
+    import jax.numpy as jnp
+    from ..framework.core import apply_jax
+
+    def f(a):
+        L = a.shape[-1]
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        import jax
+        return jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
+    return apply_jax("softmax_mask_fuse_upper_triangle", f, x)
+
+
+def segment_sum(data, segment_ids, name=None):
+    import jax
+    import numpy as np
+    from ..framework.core import apply_jax, as_jax
+    n = int(np.asarray(as_jax(segment_ids)).max()) + 1
+
+    def f(d, ids):
+        return jax.ops.segment_sum(d, ids.astype(np.int32), n) \
+            if hasattr(jax.ops, "segment_sum") else \
+            jax.numpy.zeros((n,) + d.shape[1:], d.dtype).at[
+                ids.astype(np.int32)].add(d)
+    return apply_jax("segment_sum", f, data, segment_ids)
+
+
+def identity_loss(x, reduction="none"):
+    return x

@@ -1,0 +1,15 @@
+"""``paddle.incubate.nn`` — fused layers & functional namespace."""
+from . import functional  # noqa: F401
+
+
+class FusedLinear:
+    def __new__(cls, *args, **kwargs):
+        from ...nn.layer.common import Linear
+        return Linear(*args, **kwargs)
+
+
+class FusedMultiHeadAttention:
+    def __new__(cls, embed_dim, num_heads, dropout_rate=0.5, **kwargs):
+        from ...nn.layer.transformer import MultiHeadAttention
+        return MultiHeadAttention(embed_dim, num_heads,
+                                  dropout=dropout_rate)

@@ -1,0 +1,143 @@
+"""Fused-op functional APIs (``python/paddle/incubate/nn/functional``).
+
+Compositions XLA fuses into single kernels — source-compatible names for
+PaddleNLP-style callers; the math routes through the same code as
+``paddle.nn.functional``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, apply_jax, as_jax
+from ....nn import functional as F
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ....ops.linalg import matmul
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ....ops.linalg import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    if bias is not None:
+        out = out + bias
+    if activation in ("gelu", "relu"):
+        return getattr(F, activation)(out)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      name=None):
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kwargs):
+    return F.layer_norm(x, [x.shape[-1]], norm_weight, norm_bias, epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE (reference: ``paddle/phi/kernels/fusion/gpu/fused_rope*``).
+    q/k: [B, L, H, D]."""
+    def rope_one(t):
+        if t is None:
+            return None
+        arr = as_jax(t)
+        b, l, h, d = arr.shape
+        if sin is None or cos is None:
+            pos = jnp.arange(l, dtype=jnp.float32)
+            inv = rotary_emb_base ** (
+                -jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+            freqs = jnp.outer(pos, inv)
+            sin_a = jnp.sin(freqs)
+            cos_a = jnp.cos(freqs)
+        else:
+            sin_a = as_jax(sin).reshape(l, d // 2) if as_jax(sin).ndim > 2 \
+                else as_jax(sin)[..., : d // 2]
+            cos_a = as_jax(cos).reshape(l, d // 2) if as_jax(cos).ndim > 2 \
+                else as_jax(cos)[..., : d // 2]
+
+        def f(a):
+            if use_neox_rotary_style:
+                x1 = a[..., : d // 2]
+                x2 = a[..., d // 2:]
+                s = sin_a[None, :, None, :]
+                c = cos_a[None, :, None, :]
+                return jnp.concatenate(
+                    [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+            x1 = a[..., 0::2]
+            x2 = a[..., 1::2]
+            s = sin_a[None, :, None, :]
+            c = cos_a[None, :, None, :]
+            o1 = x1 * c - x2 * s
+            o2 = x2 * c + x1 * s
+            return jnp.stack([o1, o2], axis=-1).reshape(a.shape)
+        return apply_jax("fused_rope", f, t)
+    outs = tuple(rope_one(t) for t in (q, k, v))
+    return outs
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU (reference fused kernel ``fused_swiglu``): silu(x) * y, or
+    split-in-half when y is None."""
+    if y is None:
+        def f(a):
+            x1, x2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(x1) * x2
+        return apply_jax("swiglu", f, x)
+    return apply_jax("swiglu", lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    h = x + bias if bias is not None else x
+    h = F.dropout(h, dropout_rate, training=training, mode=mode)
+    h = h + residual
+    return F.layer_norm(h, [h.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+def masked_multihead_attention(*args, **kwargs):
+    raise NotImplementedError(
+        "masked_multihead_attention: decode-time MMHA lands with the "
+        "inference stack milestone")

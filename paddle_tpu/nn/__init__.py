@@ -1,0 +1,46 @@
+"""``paddle.nn`` namespace (``python/paddle/nn/__init__.py`` parity)."""
+from . import functional
+from . import initializer
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                   clip_grad_norm_, clip_grad_value_)
+from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
+                               ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
+                               Softplus, Softshrink, Softsign, Swish, Tanh,
+                               Tanhshrink, ThresholdedReLU)
+from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
+                           CosineSimilarity, Dropout, Dropout2D, Dropout3D,
+                           Embedding, Flatten, Fold, Identity, Linear,
+                           Pad1D, Pad2D, Pad3D, PairwiseDistance,
+                           PixelShuffle, PixelUnshuffle, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
+from .layer.container import LayerDict, LayerList, ParameterList, Sequential
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
+                         Conv3D, Conv3DTranspose)
+from .layer.layers import Layer
+from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss,
+                         L1Loss, MarginRankingLoss, MSELoss,
+                         MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss,
+                         SmoothL1Loss, SoftMarginLoss, TripletMarginLoss)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+                         GroupNorm, InstanceNorm1D, InstanceNorm2D,
+                         InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         RMSNorm, SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer,
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+from .param_attr import ParamAttr
+
+# paddle.nn.initializer style access
+import sys as _sys
+_sys.modules[__name__ + ".initializer"] = initializer

@@ -1,0 +1,106 @@
+"""Gradient clipping (``python/paddle/nn/clip.py`` parity).
+
+Applied by optimizers before the update, exactly like upstream's
+``ClipGradByGlobalNorm`` contract (operates on (param, grad) pairs).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm", "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, _wrap_out(jnp.clip(as_jax(g), self.min,
+                                              self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            ga = as_jax(g)
+            norm = jnp.sqrt(jnp.sum(ga.astype(jnp.float32) ** 2))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, _wrap_out((ga * scale).astype(ga.dtype))))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, params_grads):
+        grads = [as_jax(g) for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        gn_sq = sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in grads)
+        global_norm = jnp.sqrt(gn_sq)
+        scale = jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, self.clip_norm), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                ga = as_jax(g)
+                out.append((p, _wrap_out((ga * scale).astype(ga.dtype))))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    grads = [p.grad for p in params if p.grad is not None]
+    if not grads:
+        return _wrap_out(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(as_jax(g))) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(as_jax(g)) ** norm_type) for g in grads])
+        ) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        if p.grad is not None:
+            p._grad = _wrap_out(as_jax(p.grad) * scale)
+    return _wrap_out(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = [parameters] if isinstance(parameters, Tensor) else \
+        list(parameters)
+    for p in params:
+        if p.grad is not None:
+            p._grad = _wrap_out(jnp.clip(as_jax(p.grad), -clip_value,
+                                         clip_value))

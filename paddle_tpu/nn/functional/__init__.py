@@ -1,0 +1,17 @@
+"""``paddle.nn.functional`` namespace."""
+from .activation import *  # noqa: F401,F403
+from .conv_pool import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+
+from ...ops.manipulation import pad  # noqa: F401  (paddle exposes F.pad)
+from ...ops.pallas import flash_attention as flash_attention_mod
+from ...ops.pallas.flash_attention import (  # noqa: F401
+    scaled_dot_product_attention, flashmask_attention,
+)
+
+# paddle.nn.functional.flash_attention submodule parity
+import sys as _sys
+_sys.modules[__name__ + ".flash_attention"] = flash_attention_mod
+flash_attention = flash_attention_mod

@@ -1,0 +1,203 @@
+"""Activation functionals (``python/paddle/nn/functional/activation.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "silu", "swish", "softmax",
+    "softmax_", "log_softmax", "leaky_relu", "elu", "elu_", "selu", "celu",
+    "hardtanh", "hardsigmoid", "hardswish", "hardshrink", "softshrink",
+    "tanhshrink", "softplus", "softsign", "mish", "glu", "prelu", "rrelu",
+    "tanh", "tanh_", "maxout", "thresholded_relu", "log_sigmoid", "gumbel_softmax",
+]
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_jax(op.__name__, fn, x)
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", jax.nn.relu6)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+silu = _unary("silu", jax.nn.silu)
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanh = _unary("tanh", jnp.tanh)
+tanhshrink = _unary("tanhshrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+mish = _unary("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+def tanh_(x, name=None):
+    return x._rebind(tanh(x))
+
+
+def softmax_(x, axis=-1, name=None):
+    return x._rebind(softmax(x, axis))
+
+
+def elu_(x, alpha=1.0, name=None):
+    return x._rebind(elu(x, alpha))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_jax("gelu",
+                     lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_np
+    dt = to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=int(axis))
+    return apply_jax("softmax", f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...framework.dtype import to_np
+    dt = to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return apply_jax("log_softmax", f, x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_jax(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_jax("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_jax(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_jax("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_jax("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_jax(
+        "hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply_jax(
+        "hardswish",
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_jax(
+        "hardshrink",
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_jax(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_jax(
+        "softplus",
+        lambda a: jnp.where(beta * a > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply_jax("glu", lambda a: jax.nn.glu(a, axis=int(axis)), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+            shape[ch_axis] = w.size
+            wb = w.reshape(shape)
+        return jnp.where(a > 0, a, wb * a)
+    return apply_jax("prelu", f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    if training:
+        from ...framework import random as _random
+        key = _random.next_key()
+        arr = as_jax(x)
+        slope = jax.random.uniform(key, arr.shape, arr.dtype, lower, upper)
+        return apply_jax("rrelu",
+                         lambda a: jnp.where(a >= 0, a, slope * a), x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = int(axis) % a.ndim
+        c = a.shape[ax]
+        new_shape = (a.shape[:ax] + (c // groups, groups)
+                     + a.shape[ax + 1:])
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply_jax("maxout", f, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_jax(
+        "thresholded_relu",
+        lambda a: jnp.where(a > threshold, a, value), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _random
+    key = _random.next_key()
+    arr = as_jax(x)
+    g = jax.random.gumbel(key, arr.shape, arr.dtype)
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=int(axis))
+        if hard:
+            idx = jnp.argmax(y, axis=int(axis), keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                tuple(jnp.indices(idx.shape))[:int(axis) % y.ndim]
+                + (idx.squeeze(int(axis)),)].set(1.0) \
+                if False else jax.nn.one_hot(
+                    jnp.argmax(y, axis=int(axis)), y.shape[int(axis)],
+                    axis=int(axis), dtype=y.dtype)
+            return onehot + jax.lax.stop_gradient(y) - y \
+                if False else y + jax.lax.stop_gradient(onehot - y)
+        return y
+    return apply_jax("gumbel_softmax", f, x)

@@ -1,0 +1,335 @@
+"""Common functionals: linear, embedding, dropout, interpolate, attention
+(``python/paddle/nn/functional/common.py``, ``input.py``,
+``flash_attention.py`` parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..functional.activation import softmax
+
+__all__ = [
+    "linear", "embedding", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "interpolate", "upsample", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "one_hot",
+    "scaled_dot_product_attention", "sequence_mask", "class_center_sample",
+    "grid_sample", "affine_grid", "temporal_shift", "npair_loss",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); W layout [in, out] (Paddle convention). Lowers to a
+    single dot_general on the MXU."""
+    if bias is not None:
+        return apply_jax("linear", lambda a, w, b: a @ w + b,
+                         x, weight, bias)
+    return apply_jax("linear", lambda a, w: a @ w, x, weight)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows; grads flow only into gathered rows (the dense-grad
+    equivalent of Paddle's SelectedRows sparse grad)."""
+    def f(w, idx):
+        out = jnp.take(w, idx.astype(np.int32), axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_jax("embedding", f, weight, x)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0:
+        return x if isinstance(x, Tensor) else _wrap_out(as_jax(x))
+    key = _random.next_key()
+    rate = float(p)
+
+    def f(a):
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = tuple(a.shape[i] if i in axes else 1
+                               for i in range(a.ndim))
+        else:
+            mask_shape = a.shape
+        keep = jax.random.bernoulli(key, 1.0 - rate, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - rate), 0.0)
+        return jnp.where(keep, a, 0.0)
+    return apply_jax("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    axes = [0, ch_axis]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return x
+    key = _random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return apply_jax("alpha_dropout", f, x)
+
+
+def one_hot(x, num_classes, name=None):
+    def f(idx):
+        return jax.nn.one_hot(idx.astype(np.int32), int(num_classes),
+                              dtype=np.float32)
+    from ...ops._dispatch import nodiff
+    return nodiff(f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    arr = as_jax(x)
+    nsp = arr.ndim - 2
+    channels_last = data_format[-1] == "C"
+    spatial = arr.shape[1:-1] if channels_last else arr.shape[2:]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy().reshape(-1)]
+        out_spatial = tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                            for s in (size if isinstance(size, (list, tuple))
+                                      else [size]))
+    else:
+        if isinstance(scale_factor, (list, tuple)):
+            out_spatial = tuple(int(spatial[i] * float(scale_factor[i]))
+                                for i in range(nsp))
+        else:
+            out_spatial = tuple(int(s * float(scale_factor))
+                                for s in spatial)
+
+    jmode = {"nearest": "nearest", "bilinear": "linear",
+             "linear": "linear", "trilinear": "linear",
+             "bicubic": "cubic", "area": "linear"}[mode.lower()]
+
+    def f(a):
+        if channels_last:
+            new_shape = (a.shape[0],) + out_spatial + (a.shape[-1],)
+        else:
+            new_shape = a.shape[:2] + out_spatial
+        if jmode == "nearest":
+            return jax.image.resize(a, new_shape, method="nearest")
+        if align_corners:
+            # build index grid with corner alignment, gather per-dim linear
+            return _resize_align_corners(a, new_shape, channels_last)
+        return jax.image.resize(a, new_shape, method=jmode)
+    return apply_jax("interpolate", f, x)
+
+
+def _resize_align_corners(a, new_shape, channels_last):
+    out = a
+    sp_start = 1 if channels_last else 2
+    nsp = len(new_shape) - 2
+    for d in range(nsp):
+        ax = sp_start + d
+        in_sz = out.shape[ax]
+        out_sz = new_shape[ax]
+        if in_sz == out_sz:
+            continue
+        if out_sz == 1 or in_sz == 1:
+            idx = jnp.zeros((out_sz,), np.float32)
+        else:
+            idx = jnp.arange(out_sz, dtype=np.float32) * (in_sz - 1) \
+                / (out_sz - 1)
+        lo = jnp.floor(idx).astype(np.int32)
+        hi = jnp.minimum(lo + 1, in_sz - 1)
+        w = (idx - lo).reshape((-1,) + (1,) * (out.ndim - ax - 1))
+        lo_v = jnp.take(out, lo, axis=ax)
+        hi_v = jnp.take(out, hi, axis=ax)
+        out = lo_v * (1 - w) + hi_v * w
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+    return apply_jax("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+    return apply_jax("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, g, c // g, h, w)
+        out = jnp.swapaxes(out, 1, 2)
+        return out.reshape(n, c, h, w)
+    return apply_jax("channel_shuffle", f, x)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """[B, L, H, D] layout (Paddle flash-attn convention). On TPU this hits
+    the Pallas flash-attention kernel when available, else the XLA-fused
+    reference path (both O(L) memory with remat)."""
+    from ...ops.pallas import flash_attention as _flash
+    return _flash.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtype import to_np
+    arr = as_jax(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(arr).max())
+
+    def f(lens):
+        r = jnp.arange(int(maxlen))
+        return (r[None, :] < lens[..., None]).astype(to_np(dtype))
+    return _wrap_out(f(arr))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    lab = np.asarray(as_jax(label))
+    pos = np.unique(lab)
+    n_extra = max(0, num_samples - len(pos))
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.default_rng(0)
+    extra = rng.choice(rest, size=min(n_extra, len(rest)), replace=False)
+    sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (_wrap_out(jnp.asarray(remap[lab])),
+            _wrap_out(jnp.asarray(sampled)))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            yy_c = jnp.clip(yy, 0, h - 1)
+            xx_c = jnp.clip(xx, 0, w - 1)
+            val = img[:, :, yy_c.astype(np.int32), xx_c.astype(np.int32)]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0)
+                       & (xx <= w - 1))
+                val = val * inb[:, None].astype(val.dtype)
+            return val
+
+        # gather per batch element
+        def per_batch(img, ixb, iyb):
+            if mode == "nearest":
+                return sample(img[None], jnp.round(iyb), jnp.round(ixb))[0]
+            x0 = jnp.floor(ixb)
+            y0 = jnp.floor(iyb)
+            x1, y1 = x0 + 1, y0 + 1
+            wa = (x1 - ixb) * (y1 - iyb)
+            wb = (ixb - x0) * (y1 - iyb)
+            wc = (x1 - ixb) * (iyb - y0)
+            wd = (ixb - x0) * (iyb - y0)
+            va = sample(img[None], y0, x0)[0]
+            vb = sample(img[None], y0, x1)[0]
+            vc = sample(img[None], y1, x0)[0]
+            vd = sample(img[None], y1, x1)[0]
+            return va * wa[None] + vb * wb[None] + vc * wc[None] \
+                + vd * wd[None]
+        return jax.vmap(per_batch)(a, ix, iy)
+    return apply_jax("grid_sample", f, x, grid)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shp = [int(s) for s in (out_shape.numpy().reshape(-1)
+                            if isinstance(out_shape, Tensor) else out_shape)]
+
+    def f(th):
+        n, _, h, w = shp[0], shp[1], shp[2], shp[3]
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,nck->nhwc", base, th)
+    return apply_jax("affine_grid", f, theta)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(nt, c, h, w)
+    return apply_jax("temporal_shift", f, x)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        sim = a @ p.T
+        y_col = y.reshape(-1, 1)
+        target = (y_col == y_col.T).astype(a.dtype)
+        target = target / jnp.sum(target, axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1))
+                        + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
+        return ce + reg
+    return apply_jax("npair", f, anchor, positive, labels)

@@ -1,0 +1,379 @@
+"""Convolution & pooling functionals (``python/paddle/nn/functional/conv.py``,
+``pooling.py`` parity).
+
+Convs lower to ``lax.conv_general_dilated`` — XLA maps these onto the MXU
+(the PHI conv kernels / cuDNN path is structurally replaced by the compiler).
+NCHW is Paddle's default layout and is kept at the API level; XLA re-lays-out
+internally for TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax
+
+__all__ = [
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "avg_pool1d", "avg_pool2d", "avg_pool3d",
+    "max_pool1d", "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+    "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
+    "adaptive_max_pool2d", "adaptive_max_pool3d", "unfold", "fold",
+]
+
+
+def _tuplify(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _conv_padding(padding, n, kernel=None, stride=None, dilation=None):
+    """Paddle padding spec → lax padding list of (lo, hi) per spatial dim."""
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == n:
+            return [(int(p), int(p)) for p in flat]
+        if len(flat) == 2 * n:
+            return [(int(flat[2 * i]), int(flat[2 * i + 1]))
+                    for i in range(n)]
+        if flat and isinstance(flat[0], (list, tuple)):
+            # full-rank [[0,0],[0,0],[l,h],...] — take spatial entries
+            sp = flat[-n:]
+            return [(int(l), int(h)) for l, h in sp]
+    p = int(padding)
+    return [(p, p)] * n
+
+
+def _dn(ndim_spatial):
+    if ndim_spatial == 1:
+        return ("NCH", "OIH", "NCH")
+    if ndim_spatial == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _to_nchw(a, data_format):
+    """Normalize channels-last input to channels-first."""
+    if data_format and data_format[-1] == "C" and len(data_format) > 2:
+        perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+        return jnp.transpose(a, perm), True
+    return a, False
+
+
+def _from_nchw(a, was_nhwc):
+    if was_nhwc:
+        perm = (0,) + tuple(range(2, a.ndim)) + (1,)
+        return jnp.transpose(a, perm)
+    return a
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups,
+            data_format, nsp, op_name):
+    strides = _tuplify(stride, nsp)
+    dils = _tuplify(dilation, nsp)
+    pad = _conv_padding(padding, nsp)
+    dns = _dn(nsp)
+
+    def f(a, w, *maybe_b):
+        a, nhwc = _to_nchw(a, data_format)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dns)
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dils, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+        if maybe_b:
+            b = maybe_b[0]
+            out = out + b.reshape((1, -1) + (1,) * nsp)
+        return _from_nchw(out, nhwc)
+
+    if bias is not None:
+        return apply_jax(op_name, f, x, weight, bias)
+    return apply_jax(op_name, f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups,
+                   data_format, 3, "conv3d")
+
+
+def _convnd_transpose(x, weight, bias, stride, padding, output_padding,
+                      dilation, groups, data_format, nsp, op_name,
+                      output_size=None):
+    strides = _tuplify(stride, nsp)
+    dils = _tuplify(dilation, nsp)
+    pad = _conv_padding(padding, nsp)
+    dns = _dn(nsp)
+    opad = _tuplify(output_padding, nsp) if output_padding else (0,) * nsp
+
+    def f(a, w, *maybe_b):
+        a, nhwc = _to_nchw(a, data_format)
+        dn = jax.lax.conv_dimension_numbers(a.shape, w.shape, dns)
+        # paddle transpose-conv weight layout: [in, out/groups, *k]
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # lax.conv_transpose padding relates to the forward conv's
+            padding_cfg = [
+                (dils[i] * (w.shape[2 + i] - 1) - pad[i][0],
+                 dils[i] * (w.shape[2 + i] - 1) - pad[i][1] + opad[i])
+                for i in range(nsp)]
+        if groups == 1:
+            w_t = jnp.swapaxes(w, 0, 1)  # -> [out, in, *k]
+        else:
+            ci = w.shape[0]
+            co_g = w.shape[1]
+            w_r = w.reshape((groups, ci // groups, co_g) + w.shape[2:])
+            w_t = jnp.swapaxes(w_r, 1, 2).reshape(
+                (groups * co_g, ci // groups) + w.shape[2:])
+        w_flip = jnp.flip(w_t, axis=tuple(range(2, 2 + nsp)))
+        out = jax.lax.conv_general_dilated(
+            a, w_flip, window_strides=(1,) * nsp, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dils,
+            dimension_numbers=dn, feature_group_count=groups)
+        if maybe_b:
+            out = out + maybe_b[0].reshape((1, -1) + (1,) * nsp)
+        return _from_nchw(out, nhwc)
+
+    if bias is not None:
+        return apply_jax(op_name, f, x, weight, bias)
+    return apply_jax(op_name, f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, data_format,
+                             1, "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, data_format,
+                             2, "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _convnd_transpose(x, weight, bias, stride, padding,
+                             output_padding, dilation, groups, data_format,
+                             3, "conv3d_transpose", output_size)
+
+
+# ---------------------------------------------------------------------------
+# pooling — lax.reduce_window
+# ---------------------------------------------------------------------------
+
+def _pool(x, kernel, stride, padding, nsp, op, data_format, op_name,
+          ceil_mode=False, exclusive=True, count_include_pad=False):
+    ks = _tuplify(kernel, nsp)
+    st = _tuplify(stride if stride is not None else kernel, nsp)
+    pad = _conv_padding(padding, nsp)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + list(pad)
+
+    def f(a):
+        a, nhwc = _to_nchw(a, data_format)
+        window = (1, 1) + ks
+        strides = (1, 1) + st
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            out = jax.lax.reduce_window(
+                a, init, jax.lax.max, window, strides,
+                pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
+        else:
+            summed = jax.lax.reduce_window(
+                a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0,
+                jax.lax.add, window, strides,
+                pad_cfg if isinstance(pad_cfg, str) else pad_cfg)
+            if exclusive and not count_include_pad and \
+                    not isinstance(pad_cfg, str):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strides, pad_cfg)
+                out = summed / counts
+            else:
+                out = summed / float(np.prod(ks))
+        return _from_nchw(out, nhwc)
+    return apply_jax(op_name, f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", "NCL",
+                 "avg_pool1d", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg", data_format,
+                 "avg_pool2d", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg", data_format,
+                 "avg_pool3d", ceil_mode, exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "max", "NCL",
+                "max_pool1d", ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format,
+                "max_pool2d", ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, "max", data_format,
+                "max_pool3d", ceil_mode)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def _pool_mask(x, out):
+    from ...framework.core import _wrap_out
+    return _wrap_out(jnp.zeros(as_jax(out).shape, np.int32))
+
+
+def _adaptive_pool(x, output_size, nsp, op, op_name):
+    arr = as_jax(x)
+    in_spatial = arr.shape[-nsp:]
+    out_spatial = _tuplify(output_size, nsp)
+    out_spatial = tuple(in_spatial[i] if out_spatial[i] is None
+                        else out_spatial[i] for i in range(nsp))
+    # adaptive pooling with uniform bins when divisible, else gather-based
+    if all(i % o == 0 for i, o in zip(in_spatial, out_spatial)):
+        ks = tuple(i // o for i, o in zip(in_spatial, out_spatial))
+        return _pool(x, ks, ks, 0, nsp, op, "NC" + "X" * nsp, op_name)
+
+    def f(a):
+        out = a
+        for d in range(nsp):
+            ax = a.ndim - nsp + d
+            i_sz, o_sz = in_spatial[d], out_spatial[d]
+            starts = [(j * i_sz) // o_sz for j in range(o_sz)]
+            ends = [-(-((j + 1) * i_sz) // o_sz) for j in range(o_sz)]
+            segs = []
+            for s, e in zip(starts, ends):
+                seg = jax.lax.slice_in_dim(out, s, e, axis=ax)
+                red = jnp.max(seg, axis=ax, keepdims=True) if op == "max" \
+                    else jnp.mean(seg, axis=ax, keepdims=True)
+                segs.append(red)
+            out = jnp.concatenate(segs, axis=ax)
+        return out
+    return apply_jax(op_name, f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "max", "adaptive_max_pool1d")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "max", "adaptive_max_pool2d")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "max", "adaptive_max_pool3d")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+# ---------------------------------------------------------------------------
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] → [N, C*kh*kw, L]."""
+    ks = _tuplify(kernel_sizes, 2)
+    st = _tuplify(strides, 2)
+    pd = _conv_padding(paddings, 2)
+    dl = _tuplify(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st, padding=pd,
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply_jax("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im: inverse of unfold via scatter-add."""
+    os = _tuplify(output_sizes, 2)
+    ks = _tuplify(kernel_sizes, 2)
+    st = _tuplify(strides, 2)
+    pd = _conv_padding(paddings, 2)
+    dl = _tuplify(dilations, 2)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os[0] + pd[0][0] + pd[0][1]
+              - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os[1] + pd[1][0] + pd[1][1]
+              - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        cols = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os[0] + pd[0][0] + pd[0][1],
+                         os[1] + pd[1][0] + pd[1][1]), a.dtype)
+        for ki in range(ks[0]):
+            for kj in range(ks[1]):
+                hi = ki * dl[0]
+                wi = kj * dl[1]
+                out = out.at[
+                    :, :,
+                    hi:hi + oh * st[0]:st[0],
+                    wi:wi + ow * st[1]:st[1]].add(cols[:, :, ki, kj])
+        return out[:, :, pd[0][0]:pd[0][0] + os[0],
+                   pd[1][0]:pd[1][0] + os[1]]
+    return apply_jax("fold", f, x)

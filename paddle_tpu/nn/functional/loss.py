@@ -1,0 +1,310 @@
+"""Loss functionals (``python/paddle/nn/functional/loss.py`` parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "margin_ranking_loss",
+    "cosine_similarity", "cosine_embedding_loss", "label_smooth",
+    "sigmoid_focal_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "soft_margin_loss", "square_error_cost", "log_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "dice_loss",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    w = as_jax(weight) if weight is not None else None
+
+    def f(logits, lab):
+        ax = int(axis) % logits.ndim
+        logp = jax.nn.log_softmax(logits, axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim
+                          and lab.shape[ax] == logits.shape[ax]
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab
+            if label_smoothing:
+                n = logits.shape[ax]
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(tgt * logp, axis=ax)
+            if w is not None:
+                loss = loss * jnp.sum(tgt * w, axis=ax)
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(np.int32)
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=ax)
+        if label_smoothing:
+            n = logits.shape[ax]
+            onehot = jax.nn.one_hot(lab_i, n, axis=ax, dtype=logp.dtype)
+            tgt = onehot * (1 - label_smoothing) + label_smoothing / n
+            loss = -jnp.sum(tgt * logp, axis=ax)
+        else:
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(lab_i, ax), axis=ax)
+            loss = -jnp.squeeze(picked, axis=ax)
+        valid = (lab_i != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            sample_w = w[lab_i] * valid.astype(loss.dtype)
+            if reduction == "mean":
+                return jnp.sum(loss * sample_w) / \
+                    jnp.maximum(jnp.sum(sample_w), 1e-12)
+            loss = loss * sample_w
+            return _reduce(loss, reduction)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return apply_jax("cross_entropy", f, input, label)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = apply_jax("unsqueeze", lambda a: jnp.expand_dims(a, -1), loss)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, y, *w):
+        eps = 1e-12
+        out = -(y * jnp.log(jnp.maximum(p, eps))
+                + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [weight] if weight is not None else []
+    return apply_jax("bce", f, input, label, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    pw = as_jax(pos_weight) if pos_weight is not None else None
+
+    def f(z, y, *w):
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        base = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if pw is not None:
+            coeff = (pw - 1) * y + 1
+            base = base * coeff
+        if w:
+            base = base * w[0]
+        return _reduce(base, reduction)
+    args = [weight] if weight is not None else []
+    return apply_jax("bce_logits", f, logit, label, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_jax("mse_loss",
+                     lambda a, b: _reduce((a - b) ** 2, reduction),
+                     input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_jax("l1_loss",
+                     lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                     input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(out, reduction)
+    return apply_jax("smooth_l1", f, input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    w = as_jax(weight) if weight is not None else None
+
+    def f(logp, lab):
+        lab_i = lab.astype(np.int32)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(lab_i, 1), axis=1)
+        loss = -jnp.squeeze(picked, axis=1)
+        valid = lab_i != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            sw = w[lab_i] * valid.astype(loss.dtype)
+            if reduction == "mean":
+                return jnp.sum(loss * sw) / jnp.maximum(jnp.sum(sw), 1e-12)
+            loss = loss * sw
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    return apply_jax("nll_loss", f, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            out = jnp.exp(t) * (t - lp)
+        else:
+            out = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / lp.shape[0]
+        return _reduce(out, reduction)
+    return apply_jax("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        out = jnp.maximum(-y * (a - b) + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_jax("margin_ranking", f, input, other, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=int(axis))
+        den = jnp.sqrt(jnp.sum(a * a, axis=int(axis))) * \
+            jnp.sqrt(jnp.sum(b * b, axis=int(axis)))
+        return num / jnp.maximum(den, eps)
+    return apply_jax("cosine_similarity", f, x1, x2)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        sim = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1),
+            1e-12)
+        out = jnp.where(y == 1, 1 - sim, jnp.maximum(sim - margin, 0.0))
+        return _reduce(out, reduction)
+    return apply_jax("cosine_embedding", f, input1, input2, label)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y):
+        n = y.shape[-1]
+        if prior_dist is not None:
+            pd = as_jax(prior_dist)
+            return (1 - epsilon) * y + epsilon * pd
+        return (1 - epsilon) * y + epsilon / n
+    return apply_jax("label_smooth", f, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            out = out / n[0]
+        return _reduce(out, reduction)
+    args = [normalizer] if normalizer is not None else []
+    return apply_jax("focal", f, logit, label, *args)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(a, y):
+        out = jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(out, reduction)
+    return apply_jax("hinge_embedding", f, input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p,
+                           axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        out = jnp.maximum(d_ap - d_an + margin, 0.0)
+        return _reduce(out, reduction)
+    return apply_jax("triplet", f, input, positive, negative)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(a, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * a)), reduction)
+    return apply_jax("soft_margin", f, input, label)
+
+
+def square_error_cost(input, label):
+    return apply_jax("square_error_cost",
+                     lambda a, b: (a - b) ** 2, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) \
+            - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply_jax("log_loss", f, input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(z, y):
+        if log_input:
+            out = jnp.exp(z) - y * z
+        else:
+            out = z - y * jnp.log(z + epsilon)
+        if full:
+            stirling = y * jnp.log(jnp.maximum(y, 1.0)) - y \
+                + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(y, 1.0))
+            out = out + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(out, reduction)
+    return apply_jax("poisson_nll", f, input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(z, y, *w):
+        out = -(y * jax.nn.log_sigmoid(z)
+                + (1 - y) * jax.nn.log_sigmoid(-z))
+        out = jnp.mean(out, axis=-1)
+        if w:
+            out = out * w[0]
+        return _reduce(out, reduction)
+    args = [weight] if weight is not None else []
+    return apply_jax("ml_soft_margin", f, input, label, *args)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        yoh = jax.nn.one_hot(y.squeeze(-1).astype(np.int32),
+                             p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * yoh, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(yoh,
+                                                       axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_jax("dice", f, input, label)

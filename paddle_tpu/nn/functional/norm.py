@@ -1,0 +1,170 @@
+"""Normalization functionals (``python/paddle/nn/functional/norm.py``).
+
+These are pure jnp compositions — XLA fuses mean/var/scale chains into the
+surrounding program (the CINN-fusion equivalent, SURVEY.md §7.2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax, _wrap_out
+
+__all__ = ["normalize", "batch_norm", "layer_norm", "instance_norm",
+           "group_norm", "local_response_norm", "rms_norm"]
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.sum(jnp.abs(a) ** p, axis=int(axis),
+                      keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_jax("normalize", f, x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if (data_format[1] == "C" or x.ndim <= 2) else x.ndim - 1
+    use_batch_stats = training and not use_global_stats
+
+    arr = as_jax(x)
+    reduce_axes = tuple(i for i in range(arr.ndim) if i != ch_axis)
+
+    if use_batch_stats:
+        # update running stats eagerly (side-effectful, like Paddle); under
+        # the functional/jit path tracer writes are collected by TrainStep
+        from ...framework.core import in_functional_mode
+        batch_mean = jnp.mean(arr, axis=reduce_axes)
+        batch_var = jnp.var(arr, axis=reduce_axes)
+        if running_mean is not None and isinstance(running_mean, Tensor) \
+                and (in_functional_mode()
+                     or not isinstance(batch_mean, jax.core.Tracer)):
+            running_mean._data = (momentum * as_jax(running_mean)
+                                  + (1 - momentum) * batch_mean)
+            running_var._data = (momentum * as_jax(running_var)
+                                 + (1 - momentum) * batch_var)
+
+        def f(a, *wb):
+            m = jnp.mean(a, axis=reduce_axes, keepdims=True)
+            v = jnp.var(a, axis=reduce_axes, keepdims=True)
+            out = (a - m) * jax.lax.rsqrt(v + epsilon)
+            return _affine(out, wb, ch_axis, a.ndim, weight is not None,
+                           bias is not None)
+    else:
+        rm = as_jax(running_mean)
+        rv = as_jax(running_var)
+        shape = [1] * arr.ndim
+        shape[ch_axis] = arr.shape[ch_axis]
+
+        def f(a, *wb):
+            m = rm.reshape(shape)
+            v = rv.reshape(shape)
+            out = (a - m) * jax.lax.rsqrt(v + epsilon)
+            return _affine(out, wb, ch_axis, a.ndim, weight is not None,
+                           bias is not None)
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_jax("batch_norm", f, x, *args)
+
+
+def _affine(out, wb, ch_axis, ndim, has_weight=True, has_bias=True):
+    """wb holds the present affine params in (weight, bias) order; the
+    has_* flags say which ones, so bias-only configs add instead of
+    multiplying."""
+    shape = [1] * ndim
+    shape[ch_axis] = out.shape[ch_axis]
+    i = 0
+    if has_weight and i < len(wb):
+        out = out * wb[i].reshape(shape)
+        i += 1
+    if has_bias and i < len(wb):
+        out = out + wb[i].reshape(shape)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_norm = len(list(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - n_norm, a.ndim))
+        m = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - m)
+               * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+        norm_shape = a.shape[a.ndim - n_norm:]
+        i = 0
+        if weight is not None and i < len(wb):
+            out = out * wb[i].reshape(norm_shape)
+            i += 1
+        if bias is not None and i < len(wb):
+            out = out + wb[i].reshape(norm_shape)
+        return out
+
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_jax("layer_norm", f, x, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (Llama-family norm; reference has fused rms_norm in
+    ``paddle/phi/kernels/fusion/``). fp32 accumulation, bf16 in/out."""
+    def f(a, *w):
+        a32 = a.astype(jnp.float32)
+        var = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = [weight] if weight is not None else []
+    return apply_jax("rms_norm", f, x, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        return _affine(out, wb, 1, a.ndim, weight is not None,
+                       bias is not None)
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_jax("instance_norm", f, x, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = int(num_groups)
+        grouped = a.reshape((n, g, c // g) + a.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        m = jnp.mean(grouped, axis=axes, keepdims=True)
+        v = jnp.var(grouped, axis=axes, keepdims=True)
+        out = ((grouped - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        return _affine(out, wb, 1, a.ndim, weight is not None,
+                       bias is not None)
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_jax("group_norm", f, x, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pad_cfg = [(0, 0), (half, size - 1 - half)] + \
+            [(0, 0)] * (a.ndim - 2)
+        padded = jnp.pad(sq, pad_cfg)
+        window = (1, size) + (1,) * (a.ndim - 2)
+        summed = jax.lax.reduce_window(
+            padded, 0.0, jax.lax.add, window, (1,) * a.ndim,
+            [(0, 0)] * a.ndim)
+        div = (k + alpha * summed / size) ** beta
+        return a / div
+    return apply_jax("local_response_norm", f, x)

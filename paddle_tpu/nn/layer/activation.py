@@ -1,0 +1,84 @@
+"""Activation layers (``python/paddle/nn/layer/activation.py`` parity)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..initializer import Constant
+from .layers import Layer
+
+
+def _simple(fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            names = list(defaults.keys())
+            for i, a in enumerate(args):
+                merged[names[i]] = a
+            merged.update({k: v for k, v in kwargs.items()
+                           if k in merged or k != "name"})
+            merged.pop("name", None)
+            self._kwargs = merged
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+    _Act.__name__ = fn_name.title().replace("_", "")
+    return _Act
+
+
+ReLU = _simple("relu")
+ReLU6 = _simple("relu6")
+Sigmoid = _simple("sigmoid")
+Tanh = _simple("tanh")
+Silu = _simple("silu")
+Swish = _simple("swish")
+Mish = _simple("mish")
+Softsign = _simple("softsign")
+Tanhshrink = _simple("tanhshrink")
+LogSigmoid = _simple("log_sigmoid")
+Hardswish = _simple("hardswish")
+GELU = _simple("gelu", approximate=False)
+LeakyReLU = _simple("leaky_relu", negative_slope=0.01)
+ELU = _simple("elu", alpha=1.0)
+CELU = _simple("celu", alpha=1.0)
+Hardtanh = _simple("hardtanh", min=-1.0, max=1.0)
+Hardsigmoid = _simple("hardsigmoid")
+Hardshrink = _simple("hardshrink", threshold=0.5)
+Softshrink = _simple("softshrink", threshold=0.5)
+Softplus = _simple("softplus", beta=1.0, threshold=20.0)
+ThresholdedReLU = _simple("thresholded_relu", threshold=1.0)
+Softmax = _simple("softmax", axis=-1)
+LogSoftmax = _simple("log_softmax", axis=-1)
+GLU = _simple("glu", axis=-1)
+Maxout = _simple("maxout", groups=2, axis=1)
+
+
+class SELU(Layer):
+    def __init__(self, scale=1.0507009873554805, alpha=1.6732632423543772,
+                 name=None):
+        super().__init__()
+        self.scale, self.alpha = scale, alpha
+
+    def forward(self, x):
+        return F.selu(x, self.scale, self.alpha)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, self.training)

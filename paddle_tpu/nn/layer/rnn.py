@@ -1,0 +1,325 @@
+"""RNN layers (``python/paddle/nn/layer/rnn.py`` parity).
+
+Time recurrence runs under ``jax.lax.scan`` — compiler-friendly control flow
+instead of the reference's cuDNN RNN kernels (SURVEY.md §7.2: no python loops
+inside jit).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..initializer import Uniform
+from .layers import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        b = as_jax(batch_ref).shape[batch_dim_idx]
+        from ...framework.dtype import to_np
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return tuple(_wrap_out(jnp.full((b,) + tuple(s), init_value,
+                                            to_np(dtype))) for s in shape)
+        return _wrap_out(jnp.full((b,) + tuple(shape), init_value,
+                                  to_np(dtype)))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        out = apply_jax("simple_rnn_cell", f, inputs, states,
+                        self.weight_ih, self.weight_hh, self.bias_ih,
+                        self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def f(x, h_, c_, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h_ @ wh.T + bh
+            i, f_, g, o = jnp.split(gates, 4, axis=-1)
+            i, f_, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f_), \
+                jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f_ * c_ + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h_new, c_new = apply_jax("lstm_cell", f, inputs, h, c,
+                                 self.weight_ih, self.weight_hh,
+                                 self.bias_ih, self.bias_hh, n_outputs=2)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+        out = apply_jax("gru_cell", f, inputs, states, self.weight_ih,
+                        self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class RNN(Layer):
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        arr = as_jax(inputs)
+        time_axis = 0 if self.time_major else 1
+        steps = arr.shape[time_axis]
+        outputs = []
+        states = initial_states
+        idx = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        for t in idx:
+            x_t = apply_jax(
+                "rnn_slice",
+                lambda a, t=t: jax.lax.index_in_dim(
+                    a, t, axis=time_axis, keepdims=False), inputs)
+            out, states = self.cell(x_t, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from ...ops.manipulation import stack
+        out = stack(outputs, axis=time_axis)
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw)
+        from ...ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrence over a scanned cell."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+
+        def make_cell(in_sz):
+            kw = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+            if self.MODE == "LSTM":
+                return LSTMCell(in_sz, hidden_size, **kw)
+            if self.MODE == "GRU":
+                return GRUCell(in_sz, hidden_size, **kw)
+            return SimpleRNNCell(in_sz, hidden_size, activation, **kw)
+
+        from .container import LayerList
+        layers = []
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 else \
+                hidden_size * self.num_directions
+            if bidirect:
+                layers.append(BiRNN(make_cell(in_sz), make_cell(in_sz),
+                                    time_major))
+            else:
+                layers.append(RNN(make_cell(in_sz), False, time_major))
+        self.layer_list = LayerList(layers)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        final_states = []
+        for i, rnn_l in enumerate(self.layer_list):
+            st = None
+            if initial_states is not None:
+                st = self._layer_state(initial_states, i)
+            out, fin = rnn_l(out, st)
+            final_states.append(fin)
+            if self.dropout and i < self.num_layers - 1 and self.training:
+                from .. import functional as F
+                out = F.dropout(out, self.dropout, training=True)
+        return out, self._pack_states(final_states)
+
+    def _layer_state(self, initial_states, i):
+        return None  # layerwise initial states: supplied as stacked [L*D,...]
+
+    def _pack_states(self, final_states):
+        from ...ops.manipulation import stack
+
+        def collect(states):
+            flat = []
+            for s in states:
+                if isinstance(s, tuple):
+                    flat.extend(s)
+                else:
+                    flat.append(s)
+            return flat
+
+        if self.MODE == "LSTM":
+            hs, cs = [], []
+            for fin in final_states:
+                if self.num_directions == 2:
+                    (h_f, c_f), (h_b, c_b) = fin
+                    hs += [h_f, h_b]
+                    cs += [c_f, c_b]
+                else:
+                    h, c = fin
+                    hs.append(h)
+                    cs.append(c)
+            return stack(hs, axis=0), stack(cs, axis=0)
+        hs = []
+        for fin in final_states:
+            if self.num_directions == 2:
+                h_f, h_b = fin
+                hs += [h_f, h_b]
+            else:
+                hs.append(fin)
+        return stack(hs, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

@@ -1,0 +1,254 @@
+"""Op registry: aggregates all op namespaces and installs Tensor methods.
+
+Reference parity: the ops.yaml → codegen fan-out (``paddle/phi/ops/yaml/``,
+``paddle/fluid/pybind/eager_method.cc``). Every public op is defined once in
+a submodule here; this file wires them as both ``paddle.<op>`` functions and
+``Tensor.<op>`` methods, plus the arithmetic dunders.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, search
+
+_MODULES = (creation, math, manipulation, logic, linalg, search)
+
+
+def _collect_public():
+    table = {}
+    for mod in _MODULES:
+        for name in getattr(mod, "__all__", []):
+            table[name] = getattr(mod, name)
+    return table
+
+
+OPS = _collect_public()
+
+# ---------------------------------------------------------------------------
+# Tensor method installation
+# ---------------------------------------------------------------------------
+
+_METHODS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "abs", "exp", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "floor", "ceil",
+    "round", "trunc", "frac", "sign", "sgn", "reciprocal", "clip", "maximum",
+    "minimum", "fmax", "fmin", "max", "min", "amax", "amin", "sum", "nansum",
+    "mean", "nanmean", "prod", "std", "var", "median", "nanmedian",
+    "quantile", "cumsum", "cumprod", "cummax", "cummin", "logsumexp",
+    "logcumsumexp", "logit", "erf", "erfinv", "isnan", "isinf", "isfinite",
+    "nan_to_num", "lerp", "inner", "outer", "kron", "trace", "scale",
+    "increment", "addmm", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm",
+    "diff", "angle", "conj", "real", "imag", "digamma", "lgamma", "neg",
+    "count_nonzero", "expm1", "exponential_",
+    # manipulation
+    "reshape", "reshape_", "flatten", "flatten_", "transpose", "squeeze",
+    "unsqueeze", "concat", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "rot90", "roll", "gather", "gather_nd",
+    "scatter", "scatter_", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "index_fill", "masked_select", "masked_fill",
+    "masked_scatter", "where", "take_along_axis", "put_along_axis",
+    "repeat_interleave", "unbind", "unstack", "pad", "moveaxis", "swapaxes",
+    "swapdims", "as_complex", "as_real", "view", "view_as", "unfold",
+    "unflatten", "diagonal", "diag_embed", "fill_diagonal_", "tensordot",
+    "tolist", "diagonal_scatter",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor", "isclose",
+    "allclose", "equal_all", "all", "any", "isin",
+    # linalg
+    "matmul", "bmm", "mm", "mv", "dot", "norm", "dist", "cholesky",
+    "cholesky_solve", "qr", "svd", "inverse", "det", "slogdet", "solve",
+    "triangular_solve", "lstsq", "matrix_power", "eig", "eigvals", "pinv",
+    "cond", "matrix_rank", "cross", "histogram", "bincount", "mode", "lu",
+    "corrcoef", "cov",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+    "bucketize", "kthvalue", "unique", "unique_consecutive", "nonzero",
+    # creation
+    "tril", "triu", "diag", "zeros_like", "ones_like", "full_like", "clone",
+    "bernoulli", "multinomial",
+]
+
+
+def exponential_(x, lam=1.0, name=None):
+    import jax
+    from ..framework import random as _random
+    key = _random.next_key()
+    arr = as_jax(x)
+    out = jax.random.exponential(key, arr.shape).astype(arr.dtype) / lam
+    x._data = out
+    return x
+
+
+OPS["exponential_"] = exponential_
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = fn.__name__
+    return method
+
+
+def _install_methods():
+    for name in _METHODS:
+        fn = OPS.get(name)
+        if fn is None:
+            continue
+        if getattr(Tensor, name, None) is not None and name in Tensor.__dict__:
+            continue
+        setattr(Tensor, name, _make_method(fn))
+
+    # in-place variants via rebind
+    def _make_inplace(fn):
+        def method(self, *args, **kwargs):
+            return self._rebind(fn(self, *args, **kwargs))
+        return method
+
+    for name in ["add", "subtract", "multiply", "divide", "clip", "scale",
+                 "floor", "ceil", "round", "exp", "sqrt", "rsqrt", "abs",
+                 "tanh", "squeeze", "unsqueeze", "remainder", "pow",
+                 "transpose", "neg", "lerp", "cast"]:
+        fn = OPS.get(name) or getattr(Tensor, name, None)
+        if fn is None:
+            continue
+        base = OPS.get(name)
+        if base is not None and (name + "_") not in Tensor.__dict__:
+            setattr(Tensor, name + "_", _make_inplace(base))
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, float(value))
+        return self
+
+    Tensor.zero_ = zero_
+    Tensor.fill_ = fill_
+    Tensor.uniform_ = _uniform_
+    Tensor.normal_ = _normal_
+
+    # --- dunders ---
+    # reflected ops pass the scalar through raw: apply_jax keeps python
+    # scalars weak-typed, so 2.5 * int_tensor promotes exactly like
+    # int_tensor * 2.5 (no dtype truncation)
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
+    Tensor.__rmod__ = lambda s, o: math.remainder(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: logic.bitwise_not(s) \
+        if not jnp.issubdtype(s._data.dtype, jnp.bool_) \
+        else logic.logical_not(s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: _bool_or_bit(s, o, "and")
+    Tensor.__or__ = lambda s, o: _bool_or_bit(s, o, "or")
+    Tensor.__xor__ = lambda s, o: _bool_or_bit(s, o, "xor")
+    Tensor.__hash__ = object.__hash__
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__iter__ = _iter
+    Tensor.__array__ = lambda s, dtype=None: (
+        np.asarray(s._data) if dtype is None
+        else np.asarray(s._data).astype(dtype))
+
+    # inplace dunders rebind
+    Tensor.__iadd__ = lambda s, o: s._rebind(math.add(s, o))
+    Tensor.__isub__ = lambda s, o: s._rebind(math.subtract(s, o))
+    Tensor.__imul__ = lambda s, o: s._rebind(math.multiply(s, o))
+    Tensor.__itruediv__ = lambda s, o: s._rebind(math.divide(s, o))
+
+
+def _bool_or_bit(s, o, kind):
+    if jnp.issubdtype(s._data.dtype, jnp.bool_):
+        return {"and": logic.logical_and, "or": logic.logical_or,
+                "xor": logic.logical_xor}[kind](s, o)
+    return {"and": logic.bitwise_and, "or": logic.bitwise_or,
+            "xor": logic.bitwise_xor}[kind](s, o)
+
+
+def _norm_index(idx):
+    if isinstance(idx, Tensor):
+        return as_jax(idx)
+    if isinstance(idx, tuple):
+        return tuple(_norm_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx))
+    return idx
+
+
+def _getitem(self, idx):
+    nidx = _norm_index(idx)
+    return apply_jax("getitem", lambda a: a[nidx], self)
+
+
+def _setitem(self, idx, value):
+    nidx = _norm_index(idx)
+    if isinstance(value, (int, float, bool)):
+        out = apply_jax("setitem",
+                        lambda a: a.at[nidx].set(value), self)
+    else:
+        out = apply_jax(
+            "setitem",
+            lambda a, v: a.at[nidx].set(v.astype(a.dtype)), self, value)
+    self._rebind(out)
+    return self
+
+
+def _iter(self):
+    for i in range(self._data.shape[0]):
+        yield self[i]
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+    import jax
+    from ..framework import random as _random
+    key = _random.next_key() if not seed else jax.random.PRNGKey(seed)
+    self._data = jax.random.uniform(key, self._data.shape, self._data.dtype,
+                                    minval=min, maxval=max)
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0, name=None):
+    import jax
+    from ..framework import random as _random
+    key = _random.next_key()
+    self._data = (jax.random.normal(key, self._data.shape, self._data.dtype)
+                  * std + mean)
+    return self
+
+
+_install_methods()

@@ -1,0 +1,74 @@
+"""Op-dispatch helpers — the single-source-of-truth layer.
+
+Reference parity: Paddle defines each op once in ``paddle/phi/ops/yaml/ops.yaml``
+and codegen fans it out to eager/static/C++/Python consumers. Here each op is
+defined once as a pure jax function and ``apply_jax`` (framework/core.py) fans
+it out to: eager execution + tape recording, jit tracing (Tensors are pytree
+nodes), and the functional path used by ``paddle_tpu.jit``. Backward rules come
+from ``jax.vjp`` instead of hand-written grad kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+
+_SCALAR_TYPES = (int, float, bool, complex)
+
+
+def prep(x):
+    """Keep python scalars raw so jax weak-typing gives Paddle-like promotion
+    (``x_f32 + 2`` stays float32)."""
+    if isinstance(x, _SCALAR_TYPES):
+        return x
+    return as_jax(x)
+
+
+def unary(name: str, fn: Callable):
+    def op(x, name=None):
+        return apply_jax(name_, fn, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def binary(name: str, fn: Callable):
+    def op(x, y, name=None):
+        return apply_jax(name_, fn, x, y)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+def nodiff(fn: Callable, *inputs):
+    """Run an op outside the tape (integer/bool outputs: argmax, indices...)."""
+    arrays = [as_jax(x) if not isinstance(x, _SCALAR_TYPES) else x
+              for x in inputs]
+    out = fn(*arrays)
+    if isinstance(out, (tuple, list)):
+        return tuple(_wrap_out(o) for o in out)
+    return _wrap_out(out)
+
+
+def axis_or_none(axis):
+    """Paddle passes axis=None to mean 'all dims' for reductions."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1).tolist())
+    return int(axis)
+
+
+def int_list(value):
+    if value is None:
+        return None
+    if isinstance(value, Tensor):
+        return [int(v) for v in value.numpy().reshape(-1).tolist()]
+    if isinstance(value, (list, tuple)):
+        return [int(v._data) if isinstance(v, Tensor) else int(v)
+                for v in value]
+    return [int(value)]

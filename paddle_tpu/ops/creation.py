@@ -1,0 +1,259 @@
+"""Tensor creation ops (``python/paddle/tensor/creation.py`` parity)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.core import Tensor, apply_jax, as_jax, to_tensor, _wrap_out
+from ..framework.dtype import to_np, convert_dtype
+from ._dispatch import int_list
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "numel", "one_hot", "complex", "as_tensor", "Tensor",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1).tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return _wrap_out(jnp.zeros(_shape_list(shape), to_np(dtype or "float32")))
+
+
+def ones(shape, dtype=None, name=None):
+    return _wrap_out(jnp.ones(_shape_list(shape), to_np(dtype or "float32")))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = ("bool" if isinstance(fill_value, bool) else
+                 "int64" if isinstance(fill_value, int) else "float32")
+    return _wrap_out(jnp.full(_shape_list(shape), fill_value, to_np(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    arr = as_jax(x)
+    dt = to_np(dtype) if dtype is not None else arr.dtype
+    return _wrap_out(jnp.zeros_like(arr, dtype=dt))
+
+
+def ones_like(x, dtype=None, name=None):
+    arr = as_jax(x)
+    dt = to_np(dtype) if dtype is not None else arr.dtype
+    return _wrap_out(jnp.ones_like(arr, dtype=dt))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    arr = as_jax(x)
+    dt = to_np(dtype) if dtype is not None else arr.dtype
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _wrap_out(jnp.full_like(arr, fill_value, dtype=dt))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("float32" if any(isinstance(v, float)
+                                  for v in (start, end, step)) else "int64")
+    return _wrap_out(jnp.arange(start, end, step, dtype=to_np(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return _wrap_out(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                                  dtype=to_np(dtype or "float32")))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return _wrap_out(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                                  base=_v(base), dtype=to_np(dtype or "float32")))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _wrap_out(jnp.eye(int(num_rows),
+                             int(num_columns) if num_columns else None,
+                             dtype=to_np(dtype or "float32")))
+
+
+# ----- random ---------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype or "float32", min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    key = _random.next_key()
+    return _wrap_out(jax.random.normal(key, _shape_list(shape),
+                                       to_np(dtype or "float32")))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_jax(mean) if isinstance(mean, Tensor) else mean
+        s = as_jax(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            jnp.shape(m) if hasattr(m, "shape") else (),
+            jnp.shape(s) if hasattr(s, "shape") else ())
+        key = _random.next_key()
+        return _wrap_out(jax.random.normal(key, shp) * s + m)
+    key = _random.next_key()
+    out = jax.random.normal(key, _shape_list(shape or [1]),
+                            np.float32) * std + mean
+    return _wrap_out(out)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = _random.next_key() if not seed else jax.random.PRNGKey(seed)
+    return _wrap_out(jax.random.uniform(
+        key, _shape_list(shape), to_np(dtype or "float32"),
+        minval=float(min), maxval=float(max)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return _wrap_out(jax.random.randint(
+        key, _shape_list(shape), int(low), int(high),
+        to_np(dtype or "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    arr = as_jax(x)
+    return randint(low, high, shape=arr.shape, dtype=dtype or "int64")
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.next_key()
+    return _wrap_out(jax.random.permutation(key, int(n)).astype(to_np(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = _random.next_key()
+    arr = as_jax(x)
+    return _wrap_out(jax.random.bernoulli(key, arr).astype(arr.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    arr = as_jax(x)
+    key = _random.next_key()
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(*arr.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, arr.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        out = idx
+    return _wrap_out(out.astype(np.int64))
+
+
+def poisson(x, name=None):
+    key = _random.next_key()
+    arr = as_jax(x)
+    return _wrap_out(jax.random.poisson(key, arr).astype(arr.dtype))
+
+
+# ----- structured -----------------------------------------------------------
+
+def tril(x, diagonal=0, name=None):
+    return apply_jax("tril", lambda a: jnp.tril(a, int(diagonal)), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_jax("triu", lambda a: jnp.triu(a, int(diagonal)), x)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=int(offset))
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=int(offset),
+                               dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value,
+                                                       out.dtype))
+            return out
+        return jnp.diagonal(a, offset=int(offset))
+    return apply_jax("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_jax("diagflat",
+                     lambda a: jnp.diagflat(a, k=int(offset)), x)
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    arrays = [as_jax(a) for a in args]
+    outs = jnp.meshgrid(*arrays, indexing="ij")
+    return [_wrap_out(o) for o in outs]
+
+
+def assign(x, output=None):
+    val = _wrap_out(as_jax(x) + 0) if not isinstance(x, Tensor) else \
+        apply_jax("assign", lambda a: a, x)
+    if output is not None:
+        output._rebind(val)
+        return output
+    return val
+
+
+def clone(x, name=None):
+    return apply_jax("clone", lambda a: a, x)
+
+
+def numel(x, name=None):
+    return _wrap_out(jnp.asarray(int(np.prod(as_jax(x).shape) or 1),
+                                 np.int64))
+
+
+def one_hot(x, num_classes, name=None):
+    arr = as_jax(x)
+    return _wrap_out(jax.nn.one_hot(arr, int(num_classes),
+                                    dtype=np.float32))
+
+
+def complex(real, imag, name=None):
+    return apply_jax("complex", jax.lax.complex, real, imag)
+
+
+def as_tensor(data, dtype=None, place=None):
+    return to_tensor(data, dtype=dtype, place=place)

@@ -1,0 +1,401 @@
+"""Linear algebra ops (``python/paddle/tensor/linalg.py`` parity).
+
+matmul/bmm hit the MXU directly via XLA dot_general; decompositions use
+jax.numpy.linalg (lowered to LAPACK custom-calls on CPU, XLA on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ._dispatch import nodiff
+
+__all__ = [
+    "matmul", "bmm", "mm", "mv", "dot", "t", "norm", "vector_norm",
+    "matrix_norm", "dist", "cholesky", "cholesky_solve", "qr", "svd",
+    "svdvals", "inv", "inverse", "det", "slogdet", "solve",
+    "triangular_solve", "lstsq", "matrix_power", "eig", "eigh", "eigvals",
+    "eigvalsh", "pinv", "cond", "matrix_rank", "cross", "histogram",
+    "histogramdd", "bincount", "mode", "lu", "lu_unpack", "corrcoef", "cov",
+    "matrix_transpose", "householder_product", "pca_lowrank", "einsum",
+    "multi_dot", "vecdot", "ormqr", "cdist",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_jax("matmul", f, x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_jax("bmm", jnp.matmul, x, y)
+
+
+def mm(input, mat2, name=None):
+    return apply_jax("mm", jnp.matmul, input, mat2)
+
+
+def mv(x, vec, name=None):
+    return apply_jax("mv", jnp.matmul, x, vec)
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return apply_jax("dot", f, x, y)
+
+
+def t(input, name=None):
+    return apply_jax("t", lambda a: a.T, input)
+
+
+def matrix_transpose(x, name=None):
+    return apply_jax("matrix_transpose",
+                     lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            src = jnp.abs(a)
+            return jnp.max(src, axis=_ax(axis), keepdims=keepdim) \
+                if axis is not None or True else src
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=_ax(axis),
+                           keepdims=keepdim)
+        ax = _ax(axis)
+        return jnp.sum(jnp.abs(a) ** p, axis=ax,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply_jax("norm", f, x)
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_jax(
+        "matrix_norm",
+        lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis),
+                                  keepdims=keepdim), x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm((x - y) if isinstance(x, Tensor) else
+                _wrap_out(as_jax(x) - as_jax(y)), p=p)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_jax("cholesky", f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply_jax("cholesky_solve", f, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_jax("qr", lambda a: jnp.linalg.qr(a, mode=mode), x,
+                     n_outputs=2)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_jax(
+        "svd", lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), x,
+        n_outputs=3)
+
+
+def svdvals(x, name=None):
+    return apply_jax("svdvals",
+                     lambda a: jnp.linalg.svd(a, compute_uv=False), x)
+
+
+def inv(x, name=None):
+    return apply_jax("inv", jnp.linalg.inv, x)
+
+
+inverse = inv
+
+
+def det(x, name=None):
+    return apply_jax("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply_jax("slogdet", f, x)
+
+
+def solve(x, y, name=None):
+    return apply_jax("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply_jax("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    arr_x, arr_y = as_jax(x), as_jax(y)
+    sol, res, rank_, sv = jnp.linalg.lstsq(arr_x, arr_y, rcond=rcond)
+    return (_wrap_out(sol), _wrap_out(res), _wrap_out(rank_), _wrap_out(sv))
+
+
+def matrix_power(x, n, name=None):
+    return apply_jax("matrix_power",
+                     lambda a: jnp.linalg.matrix_power(a, int(n)), x)
+
+
+def eig(x, name=None):
+    arr = np.asarray(as_jax(x))  # general eig: host LAPACK
+    w, v = np.linalg.eig(arr)
+    return _wrap_out(jnp.asarray(w)), _wrap_out(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_jax("eigh", lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x,
+                     n_outputs=2)
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(as_jax(x))
+    return _wrap_out(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_jax("eigvalsh",
+                     lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_jax(
+        "pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond,
+                                          hermitian=hermitian), x)
+
+
+def cond(x, p=None, name=None):
+    return nodiff(lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return nodiff(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else None
+
+    def f(a, b):
+        if ax is None:
+            # paddle default: first axis with dim 3
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    return jnp.cross(a, b, axis=i)
+            return jnp.cross(a, b)
+        return jnp.cross(a, b, axis=ax)
+    return apply_jax("cross", f, x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    arr = as_jax(input)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        lo = float(np.asarray(arr).min())
+        hi = float(np.asarray(arr).max())
+    w = as_jax(weight) if weight is not None else None
+    hist, _ = jnp.histogram(arr.reshape(-1), bins=int(bins),
+                            range=(lo, hi), weights=w, density=density)
+    return _wrap_out(hist if density or w is not None
+                     else hist.astype(np.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    arr = np.asarray(as_jax(x))
+    w = np.asarray(as_jax(weights)) if weights is not None else None
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return _wrap_out(jnp.asarray(hist)), [
+        _wrap_out(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    arr = as_jax(x)
+    length = builtins_max(int(np.asarray(arr).max(initial=-1)) + 1,
+                          int(minlength))
+    w = as_jax(weights) if weights is not None else None
+    return _wrap_out(jnp.bincount(arr.reshape(-1), weights=w,
+                                  length=length))
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(as_jax(x))
+    from scipy import stats  # available with jax's scipy dep
+
+    def _mode_np(a, ax):
+        m = stats.mode(a, axis=ax, keepdims=True)
+        return m.mode, m.count
+    try:
+        vals, _ = _mode_np(arr, int(axis))
+    except Exception:
+        # fallback without scipy
+        vals = np.apply_along_axis(
+            lambda v: np.bincount(v.astype(np.int64)).argmax(), int(axis),
+            arr)[..., None]
+    idx = np.argmax(arr == vals, axis=int(axis))
+    if not keepdim:
+        vals = np.squeeze(vals, axis=int(axis))
+    else:
+        idx = np.expand_dims(idx, int(axis))
+    return _wrap_out(jnp.asarray(vals)), _wrap_out(
+        jnp.asarray(idx.astype(np.int64)))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(np.int32)
+    arr = as_jax(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(arr)
+    outs = (_wrap_out(lu_), _wrap_out(piv.astype(np.int32) + 1))
+    if get_infos:
+        return outs + (_wrap_out(jnp.zeros((), np.int32)),)
+    return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    lu_ = as_jax(x)
+    piv = as_jax(y) - 1
+    m = lu_.shape[-2]
+    l = jnp.tril(lu_, -1) + jnp.eye(m, lu_.shape[-1], dtype=lu_.dtype)
+    u = jnp.triu(lu_)
+    perm = np.arange(m)
+    piv_np = np.asarray(piv)
+    for i, p in enumerate(piv_np):
+        perm[i], perm[p] = perm[p], perm[i]
+    P = jnp.eye(m, dtype=lu_.dtype)[perm].T
+    return _wrap_out(P), _wrap_out(l[..., :m, :m] if m < lu_.shape[-1]
+                                   else l), _wrap_out(u)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_jax("corrcoef",
+                     lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = as_jax(fweights) if fweights is not None else None
+    aw = as_jax(aweights) if aweights is not None else None
+    return apply_jax(
+        "cov", lambda a: jnp.cov(a, rowvar=rowvar, bias=not ddof,
+                                 fweights=fw, aweights=aw), x)
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye_m = jnp.eye(m, dtype=a.dtype)
+        q = eye_m
+        for i in range(t_.shape[-1]):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype),
+                                 jnp.ones((1,), a.dtype),
+                                 a[..., i + 1:, i]])
+            h = eye_m - t_[..., i] * jnp.outer(v, v)
+            q = q @ h
+        return q[..., :, :n]
+    return apply_jax("householder_product", f, x, tau)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    arr = as_jax(x)
+    q = q or builtins_min(6, arr.shape[-2], arr.shape[-1])
+    a = arr - arr.mean(axis=-2, keepdims=True) if center else arr
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (_wrap_out(u[..., :q]), _wrap_out(s[..., :q]),
+            _wrap_out(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+
+def builtins_min(*vals):
+    out = vals[0]
+    for v in vals[1:]:
+        if v < out:
+            out = v
+    return out
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply_jax("einsum",
+                     lambda *arrs: jnp.einsum(equation, *arrs), *operands)
+
+
+def multi_dot(x, name=None):
+    tensors = list(x)
+    return apply_jax("multi_dot",
+                     lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_jax("vecdot",
+                     lambda a, b: jnp.sum(a * b, axis=int(axis)), x, y)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    q = householder_product(x, tau)
+    qa = as_jax(q)
+    if transpose:
+        qa = jnp.swapaxes(qa, -1, -2)
+
+    def f(qq, other):
+        return qq @ other if left else other @ qq
+    return apply_jax("ormqr", f, _wrap_out(qa), y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply_jax("cdist", f, x, y)

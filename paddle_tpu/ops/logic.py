@@ -1,0 +1,87 @@
+"""Comparison / logical / bitwise ops (``python/paddle/tensor/logic.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+from ._dispatch import nodiff
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "isclose", "allclose",
+    "equal_all", "is_empty", "all", "any", "is_tensor", "isin",
+]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return nodiff(fn, x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return nodiff(jnp.logical_not, x)
+
+
+def bitwise_not(x, name=None):
+    return nodiff(jnp.bitwise_not, x)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return nodiff(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return nodiff(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return nodiff(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return _wrap_out(jnp.asarray(int(np.prod(as_jax(x).shape)) == 0))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    from ._dispatch import axis_or_none
+    ax = axis_or_none(axis)
+    return nodiff(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    from ._dispatch import axis_or_none
+    ax = axis_or_none(axis)
+    return nodiff(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return nodiff(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x)

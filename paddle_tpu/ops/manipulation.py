@@ -1,0 +1,679 @@
+"""Shape / layout / indexing ops (``python/paddle/tensor/manipulation.py``).
+
+Static shapes throughout — every op resolves its config to Python ints at
+trace time so XLA sees fully static programs (SURVEY.md §7.2: no dynamic
+shapes that break MXU tiling).
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+builtins_slice = builtins.slice
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..framework.dtype import to_np
+from ._dispatch import int_list, nodiff
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "squeeze", "unsqueeze",
+    "concat", "stack", "split", "tensor_split", "vsplit", "hsplit", "dsplit",
+    "chunk", "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "flip", "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_",
+    "scatter_nd", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "index_fill", "masked_select", "masked_fill",
+    "masked_scatter", "where", "take_along_axis", "put_along_axis",
+    "repeat_interleave", "unbind", "unstack", "slice", "strided_slice",
+    "pad", "crop", "moveaxis", "swapaxes", "swapdims", "as_complex",
+    "as_real", "view", "view_as", "unfold", "cast", "flatten_", "tolist",
+    "unflatten", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+    "diagonal", "diagonal_scatter", "diag_embed", "fill_diagonal_",
+    "shard_index", "tensordot", "rank", "shape",
+]
+
+
+def reshape(x, shape, name=None):
+    shp = _resolve_shape(x, shape)
+    return apply_jax("reshape", lambda a: jnp.reshape(a, shp), x)
+
+
+def _resolve_shape(x, shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1).tolist())
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s._data))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    arr_ndim = as_jax(x).ndim
+    if arr_ndim == 0:
+        return reshape(x, [1])
+    s = start_axis % arr_ndim
+    e = stop_axis % arr_ndim
+    shp = list(as_jax(x).shape)
+    new_shape = shp[:s] + [int(np.prod(shp[s:e + 1]) or 1)] + shp[e + 1:]
+    return reshape(x, new_shape)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+def transpose(x, perm=None, name=None):
+    if perm is None:
+        return apply_jax("transpose", lambda a: jnp.transpose(a), x)
+    perm = int_list(perm)
+    return apply_jax("transpose", lambda a: jnp.transpose(a, perm), x)
+
+
+def squeeze(x, axis=None, name=None):
+    arr = as_jax(x)
+    if axis is None:
+        ax = tuple(i for i, s in enumerate(arr.shape) if s == 1)
+    else:
+        axes = int_list(axis)
+        ax = tuple(a % arr.ndim for a in axes if arr.shape[a % arr.ndim] == 1)
+    return apply_jax("squeeze", lambda a: jnp.squeeze(a, ax), x)
+
+
+def unsqueeze(x, axis, name=None):
+    axes = int_list(axis)
+    def f(a):
+        out = a
+        for ax in sorted([ax if ax >= 0 else ax + out.ndim + 1
+                          for ax in axes]):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply_jax("unsqueeze", f, x)
+
+
+def concat(x, axis=0, name=None):
+    tensors = list(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return apply_jax("concat", lambda *arrs: jnp.concatenate(arrs, axis=ax),
+                     *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_jax("stack",
+                     lambda *arrs: jnp.stack(arrs, axis=int(axis)), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    arr = as_jax(x)
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ax = ax % arr.ndim
+    dim = arr.shape[ax]
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"split: dimension {ax} (size {dim}) is not evenly "
+                f"divisible by num_or_sections={n}; pass explicit section "
+                f"sizes instead")
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s._data) if isinstance(s, Tensor) else int(s)
+                 for s in num_or_sections]
+        neg = [i for i, s in enumerate(sizes) if s < 0]
+        if neg:
+            known = builtins_sum(s for s in sizes if s >= 0)
+            sizes[neg[0]] = dim - known
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=ax)
+                     for o, s in zip(offsets, sizes))
+    outs = apply_jax("split", f, x, n_outputs=len(sizes))
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def builtins_sum(it):
+    total = 0
+    for v in it:
+        total += v
+    return total
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    arr = as_jax(x)
+    ax = int(axis) % arr.ndim
+    dim = arr.shape[ax]
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        base, rem = divmod(dim, n)
+        sizes = [base + (1 if i < rem else 0) for i in range(n)]
+        return split(x, sizes, axis=ax)
+    idx = [0] + [int(i) for i in num_or_indices] + [dim]
+    sizes = [idx[i + 1] - idx[i] for i in range(len(idx) - 1)]
+    return split(x, sizes, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis=axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = int_list(repeat_times)
+    return apply_jax("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    shp = _resolve_shape(x, shape)
+    arr = as_jax(x)
+    tgt = []
+    # Paddle: -1 means keep this dim; leading dims may be added
+    diff_nd = len(shp) - arr.ndim
+    for i, s in enumerate(shp):
+        if s == -1:
+            tgt.append(arr.shape[i - diff_nd])
+        else:
+            tgt.append(s)
+    return apply_jax("expand", lambda a: jnp.broadcast_to(a, tuple(tgt)), x)
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(as_jax(y).shape)
+    return apply_jax("expand_as", lambda a: jnp.broadcast_to(a, tgt), x)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    arrs = [as_jax(t) for t in inputs]
+    shp = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [apply_jax("broadcast", lambda a: jnp.broadcast_to(a, shp), t)
+            for t in inputs]
+
+
+def flip(x, axis, name=None):
+    axes = int_list(axis)
+    return apply_jax("flip", lambda a: jnp.flip(a, axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_jax("rot90", lambda a: jnp.rot90(a, k, axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = int_list(shifts)
+    ax = int_list(axis) if axis is not None else None
+    sh = sh[0] if len(sh) == 1 and ax is None else sh
+    return apply_jax("roll", lambda a: jnp.roll(a, sh, ax), x)
+
+
+# ----- gather / scatter family ---------------------------------------------
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+
+    def f(a, idx):
+        return jnp.take(a, idx.reshape(-1).astype(np.int32), axis=ax)
+    return apply_jax("gather", f, x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(np.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return apply_jax("gather_nd", f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.reshape(-1).astype(np.int32)
+        if overwrite:
+            return a.at[idx].set(upd)
+        # Paddle overwrite=False: zero the rows then accumulate
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply_jax("scatter", f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = _resolve_shape(None, shape)
+
+    def f(idx, upd):
+        idx = idx.astype(np.int32)
+        out = jnp.zeros(shp, upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_jax("scatter_nd", f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(np.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return apply_jax("scatter_nd_add", f, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis)
+
+
+def index_sample(x, index, name=None):
+    def f(a, idx):
+        return jnp.take_along_axis(a, idx.astype(np.int32), axis=1)
+    return apply_jax("index_sample", f, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    ax = int(axis)
+
+    def f(a, idx, v):
+        idx = idx.reshape(-1).astype(np.int32)
+        moved = jnp.moveaxis(a, ax, 0)
+        v_moved = jnp.moveaxis(v, ax, 0)
+        out = moved.at[idx].add(v_moved)
+        return jnp.moveaxis(out, 0, ax)
+    return apply_jax("index_add", f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_arrays = tuple(as_jax(i) for i in indices)
+
+    def f(a, v):
+        if accumulate:
+            return a.at[idx_arrays].add(v)
+        return a.at[idx_arrays].set(v)
+    return apply_jax("index_put", f, x, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    ax = int(axis)
+
+    def f(a, idx):
+        idx = idx.reshape(-1).astype(np.int32)
+        moved = jnp.moveaxis(a, ax, 0)
+        fill = jnp.full((idx.shape[0],) + moved.shape[1:],
+                        value, a.dtype)
+        out = moved.at[idx].set(fill)
+        return jnp.moveaxis(out, 0, ax)
+    return apply_jax("index_fill", f, x, index)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape — host-side op, not jittable (documented parity)
+    arr = np.asarray(as_jax(x))
+    m = np.asarray(as_jax(mask))
+    return _wrap_out(jnp.asarray(arr[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = as_jax(value) if isinstance(value, Tensor) else value
+
+    def f(a, m):
+        return jnp.where(m, jnp.asarray(val, a.dtype), a)
+    return apply_jax("masked_fill", f, x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    arr = as_jax(x)
+    m = as_jax(mask)
+    v = as_jax(value).reshape(-1)
+    m_b = jnp.broadcast_to(m, arr.shape)
+    flat_idx = jnp.cumsum(m_b.reshape(-1)) - 1
+
+    def f(a, vv):
+        flat = a.reshape(-1)
+        picked = vv[jnp.clip(flat_idx, 0, vv.shape[0] - 1)]
+        return jnp.where(m_b.reshape(-1), picked, flat).reshape(a.shape)
+    return apply_jax("masked_scatter", f, x, value)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_jax("where", lambda c, a, b: jnp.where(c, a, b),
+                     condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(as_jax(x))  # dynamic shape → host
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(_wrap_out(jnp.asarray(i[:, None].astype(np.int64)))
+                     for i in nz)
+    return _wrap_out(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    ax = int(axis)
+
+    def f(a, idx):
+        idx = idx.astype(np.int32)
+        if broadcast:
+            # broadcast index to arr rank along other dims
+            tgt = list(a.shape)
+            tgt[ax] = idx.shape[ax]
+            idx = jnp.broadcast_to(idx, tuple(tgt))
+        return jnp.take_along_axis(a, idx, axis=ax)
+    return apply_jax("take_along_axis", f, arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    ax = int(axis)
+
+    def f(a, idx, v):
+        idx_ = idx.astype(np.int32)
+        v_ = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx_.shape) \
+            if not hasattr(v, "shape") or v.shape != idx_.shape else v
+        dims = tuple(jnp.indices(idx_.shape))
+        full_idx = dims[:ax] + (idx_,) + dims[ax + 1:]
+        if reduce == "assign":
+            return a.at[full_idx].set(v_)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v_)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v_)
+        if reduce == "amax":
+            return a.at[full_idx].max(v_)
+        if reduce == "amin":
+            return a.at[full_idx].min(v_)
+        raise ValueError(f"unknown reduce {reduce}")
+    if isinstance(values, (int, float)):
+        return apply_jax("put_along_axis",
+                         lambda a, idx: f(a, idx, values), arr, indices)
+    return apply_jax("put_along_axis", f, arr, indices, values)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = as_jax(repeats)
+        total = int(np.asarray(reps).sum())
+
+        def f(a, r):
+            return jnp.repeat(a, r, axis=axis if axis is None else int(axis),
+                              total_repeat_length=total)
+        return apply_jax("repeat_interleave", f, x, repeats)
+    ax = None if axis is None else int(axis)
+    return apply_jax("repeat_interleave",
+                     lambda a: jnp.repeat(a, int(repeats), axis=ax), x)
+
+
+def unbind(x, axis=0, name=None):
+    arr = as_jax(x)
+    ax = int(axis) % arr.ndim
+    n = arr.shape[ax]
+
+    def f(a):
+        return tuple(jnp.squeeze(s, ax) for s in jnp.split(a, n, axis=ax))
+    outs = apply_jax("unbind", f, x, n_outputs=n)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def slice(x, axes, starts, ends, name=None):
+    arr = as_jax(x)
+    axes = int_list(axes)
+    starts = int_list(starts)
+    ends = int_list(ends)
+    idx = [builtins_slice(None)] * arr.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        d = arr.shape[ax]
+        st = _clampi(st, d)
+        en = _clampi(en, d)
+        idx[ax] = builtins_slice(st, en)
+    tup = tuple(idx)
+    return apply_jax("slice", lambda a: a[tup], x)
+
+
+def _clampi(v, d):
+    if v < 0:
+        v += d
+    return max(0, min(v, d))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    arr = as_jax(x)
+    axes = int_list(axes)
+    starts, ends, strides_ = int_list(starts), int_list(ends), \
+        int_list(strides)
+    idx = [builtins_slice(None)] * arr.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides_):
+        idx[ax] = builtins_slice(st, en, sd)
+    tup = tuple(idx)
+    return apply_jax("strided_slice", lambda a: a[tup], x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    arr = as_jax(x)
+    pads = int_list(pad)
+    if len(pads) == 2 * arr.ndim:
+        # paddle full-rank format: [d0_l, d0_r, d1_l, d1_r, ...]
+        width = [(pads[2 * i], pads[2 * i + 1]) for i in range(arr.ndim)]
+    else:
+        # partial spec applies to trailing spatial dims (paddle nn.functional
+        # style): [left, right] or [left, right, top, bottom] ...
+        n_spatial = len(pads) // 2
+        width = [(0, 0)] * (arr.ndim - n_spatial)
+        rev = []
+        for i in range(n_spatial):
+            rev.append((pads[2 * i], pads[2 * i + 1]))
+        if data_format.endswith("C") and arr.ndim > 2:  # NHWC/NLC/NDHWC
+            width = [(0, 0)] + rev[::-1] + [(0, 0)]
+            width = [(0, 0)] * (arr.ndim - n_spatial - 2) + width
+        else:
+            width += rev[::-1]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(a):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return apply_jax("pad", f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    arr = as_jax(x)
+    shp = _resolve_shape(x, shape)
+    offs = int_list(offsets) if offsets is not None else [0] * arr.ndim
+    shp = [arr.shape[i] - offs[i] if s == -1 else s
+           for i, s in enumerate(shp)]
+    idx = tuple(builtins_slice(o, o + s) for o, s in zip(offs, shp))
+    return apply_jax("crop", lambda a: a[idx], x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_jax(
+        "moveaxis",
+        lambda a: jnp.moveaxis(a, int_list(source), int_list(destination)),
+        x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_jax("swapaxes",
+                     lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x)
+
+
+swapdims = swapaxes
+
+
+def as_complex(x, name=None):
+    return apply_jax("as_complex",
+                     lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_jax(
+        "as_real",
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    arr = as_jax(x)
+    ax = int(axis) % arr.ndim
+    d = arr.shape[ax]
+    n_windows = (d - size) // step + 1
+    starts = [i * step for i in range(n_windows)]
+
+    def f(a):
+        slices = [jax.lax.slice_in_dim(a, s, s + size, axis=ax)
+                  for s in starts]
+        return jnp.stack(slices, axis=ax)  # windows dim at ax, size at end
+    out = apply_jax("unfold", f, x)
+    return moveaxis(out, ax + 1, len(arr.shape))
+
+
+def unflatten(x, axis, shape, name=None):
+    arr = as_jax(x)
+    ax = int(axis) % arr.ndim
+    shp = _resolve_shape(x, shape)
+    new_shape = list(arr.shape[:ax]) + list(shp) + list(arr.shape[ax + 1:])
+    # resolve a single -1
+    if -1 in shp:
+        known = int(np.prod([s for s in shp if s != -1]))
+        new_shape[new_shape.index(-1)] = arr.shape[ax] // known
+    return reshape(x, new_shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_jax("atleast_1d", jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_jax("atleast_2d", jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_jax("atleast_3d", jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    ax = int(axis)
+
+    def f(a, v):
+        idx = [builtins_slice(None)] * a.ndim
+        idx[ax] = index
+        return a.at[tuple(idx)].set(v)
+    return apply_jax("select_scatter", f, x, values)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_jax(
+        "diagonal",
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        n = a.shape[axis1]
+        m = a.shape[axis2]
+        i = jnp.arange(b.shape[-1])
+        rows = i - (offset if offset < 0 else 0)
+        cols = i + (offset if offset > 0 else 0)
+        moved = jnp.moveaxis(jnp.moveaxis(a, axis1, 0), axis2, 1)
+        moved = moved.at[rows, cols].set(jnp.moveaxis(b, -1, 0))
+        return jnp.moveaxis(jnp.moveaxis(moved, 1, axis2), 0, axis1)
+    return apply_jax("diagonal_scatter", f, x, y)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        i = jnp.arange(a.shape[-1])
+        rows = i + (-offset if offset < 0 else 0)
+        cols = i + (offset if offset > 0 else 0)
+        out = out.at[..., rows, cols].set(a)
+        src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        return jnp.moveaxis(out, [out.ndim - 2, out.ndim - 1], [d1, d2])
+    return apply_jax("diag_embed", f, x)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def f(a):
+        n = min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - abs(offset))
+        rows = i + (-offset if offset < 0 else 0)
+        cols = i + (offset if offset > 0 else 0)
+        return a.at[..., rows, cols].set(value)
+    return x._rebind(apply_jax("fill_diagonal", f, x))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+
+    def f(idx):
+        shard = idx // size
+        local = idx % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return nodiff(f, input)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    return apply_jax("tensordot", lambda a, b: jnp.tensordot(a, b, axes),
+                     x, y)
+
+
+def rank(input):
+    return _wrap_out(jnp.asarray(as_jax(input).ndim, np.int32))
+
+
+def shape(input):
+    return _wrap_out(jnp.asarray(as_jax(input).shape, np.int32))
+
+
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else \
+        _wrap_out(as_jax(x).astype(to_np(dtype)))
+
+
+def tolist(x):
+    return x.numpy().tolist()

@@ -1,0 +1,414 @@
+"""Elementwise & reduction math ops (``python/paddle/tensor/math.py`` parity).
+
+Every op is a pure jax function routed through ``apply_jax`` — XLA supplies
+the kernels (MXU for matmul via linalg.py, VPU for elementwise), ``jax.vjp``
+supplies the backward rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..framework.dtype import to_np
+from ._dispatch import axis_or_none, nodiff
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "float_power", "abs", "neg", "negative", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "sin", "cos",
+    "tan", "asin", "acos", "atan", "atan2", "sinh", "cosh", "tanh", "asinh",
+    "acosh", "atanh", "floor", "ceil", "round", "trunc", "frac", "sign",
+    "sgn", "reciprocal", "clip", "maximum", "minimum", "fmax", "fmin",
+    "max", "min", "amax", "amin", "sum", "nansum", "mean", "nanmean", "prod",
+    "std", "var", "median", "nanmedian", "quantile", "cumsum", "cumprod",
+    "cummax", "cummin", "logsumexp", "logcumsumexp", "logit", "erf",
+    "erfinv", "isnan", "isinf", "isfinite", "nan_to_num", "lerp", "inner",
+    "outer", "kron", "trace", "scale", "increment", "stanh", "multiplex",
+    "addmm", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm", "diff",
+    "angle", "conj", "real", "imag", "digamma", "lgamma", "multigammaln",
+    "i0", "i0e", "i1", "i1e", "polygamma", "hypot", "ldexp", "copysign",
+    "nextafter", "count_nonzero", "broadcast_shape", "log_normal",
+]
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return apply_jax(op.__name__, fn, x)
+    op.__name__ = name
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return apply_jax(op.__name__, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+remainder = _binary("remainder", jnp.remainder)
+mod = remainder
+pow = _binary("pow", jnp.power)
+float_power = _binary(
+    "float_power",
+    lambda x, y: jnp.power(jnp.asarray(x).astype(np.float64),
+                           jnp.asarray(y).astype(np.float64)))
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+heaviside = _binary("heaviside", jnp.heaviside)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+ldexp = _binary("ldexp", lambda x, y: x * jnp.exp2(y.astype(jnp.float32)
+                                                   if jnp.issubdtype(
+                                                       y.dtype, jnp.integer)
+                                                   else y))
+
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+negative = neg
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", jnp.sign)
+sgn = sign
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+stanh = _unary("stanh", lambda x: 1.7159 * jnp.tanh(0.66667 * x))
+
+
+def polygamma(x, n, name=None):
+    return apply_jax("polygamma",
+                     lambda a: jax.scipy.special.polygamma(int(n), a), x)
+
+
+def multigammaln(x, p, name=None):
+    return apply_jax("multigammaln",
+                     lambda a: jax.scipy.special.multigammaln(a, int(p)), x)
+
+
+def isnan(x, name=None):
+    return nodiff(jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return nodiff(jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return nodiff(jnp.isfinite, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_jax(
+        "nan_to_num",
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = None if min is None else (as_jax(min) if isinstance(min, Tensor)
+                                   else min)
+    hi = None if max is None else (as_jax(max) if isinstance(max, Tensor)
+                                   else max)
+    return apply_jax("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply_jax("lerp", lambda a, b: a + weight * (b - a), x, y)
+    return apply_jax("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = float(scale) if not isinstance(scale, Tensor) else as_jax(scale), \
+        float(bias)
+    if bias_after_scale:
+        return apply_jax("scale", lambda a: a * s + b, x)
+    return apply_jax("scale", lambda a: (a + b) * s, x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply_jax("increment", lambda a: a + value, x)
+    if isinstance(x, Tensor):
+        x._rebind(out)
+        return x
+    return out
+
+
+# ----- reductions -----------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    dt = to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if dt is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=np.int64)
+        return jnp.sum(a, axis=ax, keepdims=keepdim, dtype=dt)
+    return apply_jax("sum", f, x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax("nansum",
+                     lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax("mean",
+                     lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax("nanmean",
+                     lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    ax = axis_or_none(axis)
+    dt = to_np(dtype) if dtype is not None else None
+    return apply_jax(
+        "prod", lambda a: jnp.prod(a, axis=ax, keepdims=keepdim, dtype=dt), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax("max", lambda a: jnp.max(a, axis=ax, keepdims=keepdim),
+                     x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax("min", lambda a: jnp.min(a, axis=ax, keepdims=keepdim),
+                     x)
+
+
+amax = max
+amin = min
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    ddof = 1 if unbiased else 0
+    return apply_jax(
+        "std", lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    ddof = 1 if unbiased else 0
+    return apply_jax(
+        "var", lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = axis_or_none(axis)
+    return apply_jax(
+        "median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax(
+        "nanmedian", lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    ax = axis_or_none(axis)
+    qv = as_jax(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_jax(
+        "quantile",
+        lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim,
+                               method=interpolation), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return apply_jax(
+        "logsumexp",
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        x)
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        y = jnp.clip(a, eps, 1 - eps) if eps else a
+        return jnp.log(y / (1 - y))
+    return apply_jax("logit", f, x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = axis_or_none(axis)
+    return nodiff(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim)
+                  .astype(np.int64), x)
+
+
+# ----- scans ----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=dt)
+        return jnp.cumsum(a, axis=int(axis), dtype=dt)
+    return apply_jax("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = to_np(dtype) if dtype is not None else None
+
+    def f(a):
+        if dim is None:
+            return jnp.cumprod(a.reshape(-1), dtype=dt)
+        return jnp.cumprod(a, axis=int(dim), dtype=dt)
+    return apply_jax("cumprod", f, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    arr = as_jax(x)
+    ax = -1 if axis is None else int(axis)
+    flat = arr.reshape(-1) if axis is None else arr
+    values = jax.lax.associative_scan(jnp.maximum, flat, axis=ax if axis
+                                      is not None else 0)
+    idx = _cum_arg(flat, ax if axis is not None else 0, jnp.greater_equal)
+    return _wrap_out(values), _wrap_out(idx.astype(to_np(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    arr = as_jax(x)
+    ax = -1 if axis is None else int(axis)
+    flat = arr.reshape(-1) if axis is None else arr
+    values = jax.lax.associative_scan(jnp.minimum, flat, axis=ax if axis
+                                      is not None else 0)
+    idx = _cum_arg(flat, ax if axis is not None else 0, jnp.less_equal)
+    return _wrap_out(values), _wrap_out(idx.astype(to_np(dtype)))
+
+
+def _cum_arg(a, axis, cmp):
+    # index of running extreme via scan over (value, index) pairs
+    n = a.shape[axis]
+    idx = jnp.arange(n)
+    shape = [1] * a.ndim
+    shape[axis] = n
+    idx = jnp.broadcast_to(idx.reshape(shape), a.shape)
+
+    def combine(l, r):
+        lv, li = l
+        rv, ri = r
+        take_l = cmp(lv, rv)
+        return jnp.where(take_l, lv, rv), jnp.where(take_l, li, ri)
+
+    _, out_idx = jax.lax.associative_scan(combine, (a, idx), axis=axis)
+    return out_idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        b = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else int(axis)
+        m = jnp.max(b, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(b - m), axis=ax)) + m
+    return apply_jax("logcumsumexp", f, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = as_jax(prepend) if prepend is not None else None
+    app = as_jax(append) if append is not None else None
+    return apply_jax(
+        "diff",
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+# ----- products -------------------------------------------------------------
+
+def inner(x, y, name=None):
+    return apply_jax("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply_jax("outer",
+                     lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)),
+                     x, y)
+
+
+def kron(x, y, name=None):
+    return apply_jax("kron", jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_jax(
+        "trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_jax(
+        "addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def multiplex(inputs, index, name=None):
+    arrs = [as_jax(t) for t in inputs]
+    idx = as_jax(index).reshape(-1)
+    stacked = jnp.stack(arrs, axis=0)
+    rows = jnp.arange(arrs[0].shape[0])
+    return _wrap_out(stacked[idx, rows])
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    from .creation import normal
+    return exp(normal(mean, std, shape))

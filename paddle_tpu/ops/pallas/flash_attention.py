@@ -1,0 +1,114 @@
+"""Flash attention for TPU.
+
+Reference parity: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu`` wrapping
+the bundled FlashAttention-2 (``third_party/flashattn``). TPU-first design:
+a Pallas kernel (splash-attention pattern — blocked online softmax in VMEM)
+when running on real TPU, with an XLA fallback that jax fuses well on all
+backends. Layout is Paddle's flash-attn convention [B, L, H, D].
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax
+
+
+def _xla_attention(q, k, v, bias, is_causal, scale):
+    """Reference path: jax.nn.dot_product_attention (XLA fuses softmax chain;
+    on TPU the compiler emits a flash-style fused loop)."""
+    return jax.nn.dot_product_attention(
+        q, k, v, bias=bias, is_causal=is_causal, scale=scale)
+
+
+def _pallas_available():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention_core(q, k, v, bias=None, is_causal=False, scale=None):
+    """Pure-array flash attention; q/k/v: [B, L, H, D]."""
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    if _pallas_available():
+        try:
+            from .flash_attention_kernel import pallas_flash_attention
+            if bias is None and q.shape[1] >= 256 \
+                    and q.shape[-1] in (64, 128, 256):
+                return pallas_flash_attention(q, k, v, causal=is_causal,
+                                              sm_scale=scale)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, bias, is_causal, scale)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    bias = None
+    if attn_mask is not None:
+        m = as_jax(attn_mask)
+        if jnp.issubdtype(m.dtype, jnp.bool_):
+            bias = jnp.where(m, 0.0, -1e9).astype(as_jax(query).dtype)
+        else:
+            bias = m
+
+    def f(q, k, v):
+        out = flash_attention_core(q, k, v, bias=bias, is_causal=is_causal)
+        return out
+
+    out = apply_jax("flash_attention", f, query, key, value)
+    if dropout_p > 0.0 and training:
+        from ...nn.functional.common import dropout
+        out = dropout(out, dropout_p, training=True)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """``paddle.nn.functional.flash_attention.flash_attention`` parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """FlashMask sparse-mask attention parity
+    (``paddle.nn.functional.flashmask_attention``): mask given as start/end
+    row indices per column block, materialized here as a bias (the Pallas
+    kernel consumes the compact form directly in a later milestone)."""
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value, None,
+                                            dropout, causal, True)
+    q = as_jax(query)
+    idx = as_jax(startend_row_indices)  # [B, H_k, L, bounds]
+    L = q.shape[1]
+    rows = jnp.arange(L)[:, None]  # query index
+    cols = jnp.arange(L)[None, :]  # key index
+    if idx.shape[-1] == 1:
+        # causal: mask rows >= start for each key column
+        start = idx[..., 0]  # [B, Hk, L]
+        masked = rows[None, None] >= start[:, :, None, :]
+        if causal:
+            masked = masked | (cols[None, None] > rows[None, None])
+    else:
+        start = idx[..., 0]
+        end = idx[..., 1]
+        masked = (rows[None, None] >= start[:, :, None, :]) & \
+                 (rows[None, None] < end[:, :, None, :])
+        if causal:
+            masked = masked | (cols[None, None] > rows[None, None])
+    bias = jnp.where(masked, -1e9, 0.0).astype(q.dtype)
+    # bias is [B, Hk, Lq, Lk]; broadcast over query heads
+    mask_t = Tensor(bias)
+    return scaled_dot_product_attention(query, key, value, mask_t, dropout,
+                                        False, True)

@@ -1,0 +1,153 @@
+"""Search / sort ops (``python/paddle/tensor/search.py`` parity).
+
+Pattern: index computation runs off-tape (integer outputs), value selection
+is a differentiable gather — so ``sort``/``topk`` values get correct VJPs
+without custom grad kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ._dispatch import nodiff
+from .manipulation import take_along_axis
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+    "bucketize", "kthvalue", "unique", "unique_consecutive", "masked_select",
+    "nonzero", "index_sample", "mode", "where",
+]
+
+from .manipulation import masked_select, nonzero, index_sample, where  # re-export
+from .linalg import mode  # re-export
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import to_np
+    dt = to_np(dtype)
+
+    def f(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(dt)
+        out = jnp.argmax(a, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return nodiff(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..framework.dtype import to_np
+    dt = to_np(dtype)
+
+    def f(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(dt)
+        out = jnp.argmin(a, axis=int(axis)).astype(dt)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return nodiff(f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=int(axis), stable=stable,
+                          descending=descending)
+        return idx.astype(np.int64)
+    return nodiff(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = argsort(x, axis=axis, descending=descending, stable=stable)
+    return take_along_axis(x, idx, axis=int(axis), broadcast=False)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    arr = as_jax(x)
+    ax = -1 if axis is None else int(axis)
+    ax = ax % arr.ndim
+
+    def f_idx(a):
+        b = jnp.moveaxis(a, ax, -1)
+        src = b if largest else -b
+        _, idx = jax.lax.top_k(src, k)
+        return jnp.moveaxis(idx, -1, ax).astype(np.int64)
+    idx = nodiff(f_idx, x)
+    vals = take_along_axis(x, idx, axis=ax, broadcast=False)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    arr = as_jax(x)
+    ax = int(axis) % arr.ndim
+
+    def f_idx(a):
+        idx = jnp.argsort(a, axis=ax)
+        return jnp.take(idx, k - 1, axis=ax).astype(np.int64)
+    idx = nodiff(f_idx, x)
+    idx_exp = _wrap_out(jnp.expand_dims(as_jax(idx), ax))
+    vals = take_along_axis(x, idx_exp, axis=ax, broadcast=False)
+    if not keepdim:
+        from .manipulation import squeeze
+        vals = squeeze(vals, axis=ax)
+    return vals, idx if not keepdim else _wrap_out(as_jax(idx_exp))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = np.int32 if out_int32 else np.int64
+
+    def f(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        return jax.vmap(
+            lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+                seq.reshape(-1, seq.shape[-1]),
+                v.reshape(-1, v.shape[-1])).reshape(v.shape).astype(dt)
+    return nodiff(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(as_jax(x))  # dynamic output shape → host
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return _wrap_out(jnp.asarray(res))
+    outs = [_wrap_out(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    arr = np.asarray(as_jax(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = int(axis)
+    sl = [slice(None)] * arr.ndim
+    sl[ax] = slice(1, None)
+    sl_prev = [slice(None)] * arr.ndim
+    sl_prev[ax] = slice(None, -1)
+    neq = arr[tuple(sl)] != arr[tuple(sl_prev)]
+    while neq.ndim > 1:
+        neq = neq.any(axis=-1 if ax == 0 else 0)
+    keep = np.concatenate([[True], neq])
+    out = np.compress(keep, arr, axis=ax)
+    results = [_wrap_out(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(_wrap_out(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[ax]))
+        results.append(_wrap_out(jnp.asarray(counts.astype(np.int64))))
+    return results[0] if len(results) == 1 else tuple(results)

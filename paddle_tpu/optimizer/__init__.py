@@ -1,0 +1,4 @@
+from . import lr
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                        LBFGS, Momentum, NAdam, Optimizer, RAdam, RMSProp)
+from .regularizer import L1Decay, L2Decay

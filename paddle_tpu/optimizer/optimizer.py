@@ -1,0 +1,547 @@
+"""Optimizer base + SGD/Momentum/Adam/AdamW/etc.
+(``python/paddle/optimizer/`` parity).
+
+Each optimizer's math lives in a pure ``_update_rule(param, grad, state,
+lr) -> (new_param, new_state)`` over jax arrays, so the same rule serves the
+eager ``opt.step()`` path and the fused/jitted train step
+(``paddle_tpu.jit``): under jit the whole parameter update is one XLA
+program (the multi_tensor/fused-adamw equivalent of
+``paddle/phi/kernels/fusion``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, as_jax, _wrap_out, no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
+           "Adamax", "RMSProp", "Adadelta", "Lamb", "NAdam", "RAdam",
+           "LBFGS"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in dygraph mode "
+                "(pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._param_groups = self._parameter_list
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, (float, int)) and weight_decay:
+            from .regularizer import L2Decay
+            self._regularization = L2Decay(float(weight_decay))
+        else:
+            self._regularization = weight_decay
+        self._accumulators: Dict[str, Dict[int, jnp.ndarray]] = {}
+        self._master_weights: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+        self._name = name
+
+    # -- lr ------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when LR is driven by a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    def _create_accumulator(self, name, param, fill=0.0, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(param)
+        if pid not in store:
+            arr = as_jax(param)
+            dt = dtype or (jnp.float32 if self._multi_precision
+                           else arr.dtype)
+            store[pid] = jnp.full(arr.shape, fill, dt)
+        return store[pid]
+
+    def _set_accumulator(self, name, param, value):
+        self._accumulators[name][id(param)] = value
+
+    # -- the per-param pure update rule ---------------------------------
+    def _update_rule(self, p, g, state: dict, lr):
+        raise NotImplementedError
+
+    def _state_for(self, param) -> dict:
+        return {}
+
+    def _write_state(self, param, state: dict):
+        pass
+
+    def _apply_decay(self, param, g):
+        """L2 regularization folds into the gradient (Paddle semantics:
+        regularizer on optimizer applies where param has none)."""
+        if self._regularization is not None and not isinstance(
+                self._regularization, (float, int)):
+            return self._regularization._append(as_jax(param), g)
+        return g
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        params_grads = []
+        for p in self._parameter_list:
+            if p.stop_gradient or p.grad is None:
+                continue
+            params_grads.append((p, p.grad))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            g_arr = as_jax(g)
+            param_arr = as_jax(p)
+            if self._multi_precision and param_arr.dtype != jnp.float32:
+                pid = id(p)
+                if pid not in self._master_weights:
+                    self._master_weights[pid] = param_arr.astype(
+                        jnp.float32)
+                master = self._master_weights[pid]
+                g_arr = self._apply_decay(p, g_arr.astype(jnp.float32))
+                state = self._state_for(p)
+                new_master, new_state = self._update_rule(
+                    master, g_arr, state, lr)
+                self._master_weights[pid] = new_master
+                p._data = new_master.astype(param_arr.dtype)
+                self._write_state_dict(p, new_state)
+            else:
+                g_arr = self._apply_decay(p, g_arr)
+                state = self._state_for(p)
+                new_p, new_state = self._update_rule(param_arr, g_arr,
+                                                     state, lr)
+                p._data = new_p
+                self._write_state_dict(p, new_state)
+
+    def _write_state_dict(self, p, new_state: dict):
+        for k, v in new_state.items():
+            self._accumulators.setdefault(k, {})[id(p)] = v
+
+    minimize = None  # set below
+
+    def minimize_impl(self, loss, startup_program=None, parameters=None,
+                      no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        out = {}
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._parameter_list):
+                if id(p) in store:
+                    key = f"{p.name or 'param'}_{i}_{name}"
+                    out[key] = Tensor(store[id(p)])
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        out["@step"] = self._step_count
+        return out
+
+    def set_state_dict(self, state_dict):
+        """Restore accumulator state. Keys are parsed from the checkpoint
+        itself (``<pname>_<idx>_<accname>``), so restore works on a fresh
+        optimizer whose accumulator dicts are still empty."""
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list):
+            prefix = f"{p.name or 'param'}_{i}_"
+            for key, value in state_dict.items():
+                if isinstance(key, str) and key.startswith(prefix):
+                    acc_name = key[len(prefix):]
+                    self._accumulators.setdefault(acc_name, {})[id(p)] = \
+                        as_jax(value)
+        return self
+
+
+Optimizer.minimize = Optimizer.minimize_impl
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _state_for(self, param):
+        return {}
+
+    def _update_rule(self, p, g, state, lr):
+        return p - lr * g.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _state_for(self, param):
+        return {"velocity": self._create_accumulator("velocity", param)}
+
+    def _update_rule(self, p, g, state, lr):
+        v = state["velocity"].astype(g.dtype) \
+            if state["velocity"].shape == g.shape else state["velocity"]
+        v_new = self._momentum * v + g
+        if self._use_nesterov:
+            p_new = p - lr * (g + self._momentum * v_new)
+        else:
+            p_new = p - lr * v_new
+        return p_new.astype(p.dtype), {"velocity": v_new}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _state_for(self, param):
+        return {"moment": self._create_accumulator("moment", param,
+                                                   self._init_acc)}
+
+    def _update_rule(self, p, g, state, lr):
+        m = state["moment"] + g * g
+        p_new = p - lr * g / (jnp.sqrt(m) + self._epsilon)
+        return p_new.astype(p.dtype), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _state_for(self, param):
+        s = {
+            "moment1": self._create_accumulator("moment1", param),
+            "moment2": self._create_accumulator("moment2", param),
+            "beta1_pow": self._create_scalar_acc("beta1_pow", param,
+                                                 self._beta1),
+            "beta2_pow": self._create_scalar_acc("beta2_pow", param,
+                                                 self._beta2),
+        }
+        if self._amsgrad:
+            s["moment2_max"] = self._create_accumulator("moment2_max",
+                                                        param)
+        return s
+
+    def _create_scalar_acc(self, name, param, fill):
+        store = self._accumulators.setdefault(name, {})
+        pid = id(param)
+        if pid not in store:
+            store[pid] = jnp.asarray(fill, jnp.float32)
+        return store[pid]
+
+    def _decayed_g(self, p, g, lr):
+        return g, p
+
+    def _update_rule(self, p, g, state, lr):
+        g, p = self._decayed_g(p, g, lr)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        b1p = state["beta1_pow"]
+        b2p = state["beta2_pow"]
+        m1_hat = m1 / (1 - b1p)
+        if self._amsgrad:
+            m2_max = jnp.maximum(state.get("moment2_max", m2), m2)
+            m2_hat = m2_max / (1 - b2p)
+            extra = {"moment2_max": m2_max}
+        else:
+            m2_hat = m2 / (1 - b2p)
+            extra = {}
+        p_new = p - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        new_state = {"moment1": m1, "moment2": m2,
+                     "beta1_pow": b1p * self._beta1,
+                     "beta2_pow": b2p * self._beta2, **extra}
+        return p_new.astype(p.dtype), new_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (Paddle: ``python/paddle/optimizer/adamw.py``).
+    Decay multiplies the *parameter*, not the gradient."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+        self._current_param = None
+
+    @no_grad()
+    def step(self):
+        # track param identity for apply_decay_param_fun
+        self._step_count += 1
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._current_param = p
+            g_arr = as_jax(g)
+            param_arr = as_jax(p)
+            use_master = self._multi_precision and \
+                param_arr.dtype != jnp.float32
+            if use_master:
+                pid = id(p)
+                if pid not in self._master_weights:
+                    self._master_weights[pid] = param_arr.astype(
+                        jnp.float32)
+                base = self._master_weights[pid]
+                g_arr = g_arr.astype(jnp.float32)
+            else:
+                base = param_arr
+            state = self._state_for(p)
+            new_p, new_state = self._update_rule(base, g_arr, state, lr)
+            if use_master:
+                self._master_weights[id(p)] = new_p
+                p._data = new_p.astype(param_arr.dtype)
+            else:
+                p._data = new_p
+            self._write_state_dict(p, new_state)
+        self._current_param = None
+
+    def _decayed_g(self, p, g, lr):
+        decay = self._wd
+        if self._apply_decay_param_fun is not None and \
+                self._current_param is not None:
+            if not self._apply_decay_param_fun(
+                    self._current_param.name or ""):
+                decay = 0.0
+        if decay:
+            p = p * (1.0 - lr * decay)
+        return g, p
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _state_for(self, param):
+        return {
+            "moment": self._create_accumulator("moment", param),
+            "inf_norm": self._create_accumulator("inf_norm", param),
+            "beta1_pow": self._accumulators.setdefault(
+                "beta1_pow", {}).setdefault(
+                    id(param), jnp.asarray(self._beta1, jnp.float32)),
+        }
+
+    def _update_rule(self, p, g, state, lr):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"]
+        p_new = p - (lr / (1 - b1p)) * m / (u + self._epsilon)
+        return p_new.astype(p.dtype), {
+            "moment": m, "inf_norm": u, "beta1_pow": b1p * self._beta1}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _state_for(self, param):
+        return {
+            "mean_square": self._create_accumulator("mean_square", param),
+            "mean_grad": self._create_accumulator("mean_grad", param),
+            "momentum": self._create_accumulator("momentum", param),
+        }
+
+    def _update_rule(self, p, g, state, lr):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        return (p - mom).astype(p.dtype), {
+            "mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _state_for(self, param):
+        return {
+            "avg_squared_grad": self._create_accumulator(
+                "avg_squared_grad", param),
+            "avg_squared_update": self._create_accumulator(
+                "avg_squared_update", param),
+        }
+
+    def _update_rule(self, p, g, state, lr):
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        asu = state["avg_squared_update"]
+        update = -jnp.sqrt(asu + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon) * g
+        asu_new = self._rho * asu + (1 - self._rho) * update * update
+        return (p + lr * update).astype(p.dtype), {
+            "avg_squared_grad": asg, "avg_squared_update": asu_new}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._current_param = None
+
+    def _state_for(self, param):
+        self._current_param = param
+        return {
+            "moment1": self._create_accumulator("moment1", param),
+            "moment2": self._create_accumulator("moment2", param),
+            "beta1_pow": self._accumulators.setdefault(
+                "beta1_pow", {}).setdefault(
+                    id(param), jnp.asarray(self._beta1, jnp.float32)),
+            "beta2_pow": self._accumulators.setdefault(
+                "beta2_pow", {}).setdefault(
+                    id(param), jnp.asarray(self._beta2, jnp.float32)),
+        }
+
+    def _update_rule(self, p, g, state, lr):
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        m1_hat = m1 / (1 - state["beta1_pow"])
+        m2_hat = m2 / (1 - state["beta2_pow"])
+        r = m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._current_param is not None \
+                and self._exclude_fn(self._current_param):
+            wd = 0.0
+        update = r + wd * p
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        u_norm = jnp.linalg.norm(update.astype(jnp.float32))
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        p_new = p - lr * ratio * update
+        return p_new.astype(p.dtype), {
+            "moment1": m1, "moment2": m2,
+            "beta1_pow": state["beta1_pow"] * self._beta1,
+            "beta2_pow": state["beta2_pow"] * self._beta2}
+
+
+class NAdam(Adam):
+    def _update_rule(self, p, g, state, lr):
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        b1p = state["beta1_pow"]
+        b2p = state["beta2_pow"]
+        m1_hat = (self._beta1 * m1 / (1 - b1p * self._beta1)
+                  + (1 - self._beta1) * g / (1 - b1p))
+        m2_hat = m2 / (1 - b2p)
+        p_new = p - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
+        return p_new.astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "beta1_pow": b1p * self._beta1,
+            "beta2_pow": b2p * self._beta2}
+
+
+class RAdam(Adam):
+    def _update_rule(self, p, g, state, lr):
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        b1p = state["beta1_pow"]
+        b2p = state["beta2_pow"]
+        t = jnp.log(b1p) / jnp.log(self._beta1)  # step count
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * b2p / (1 - b2p)
+        m1_hat = m1 / (1 - b1p)
+
+        def with_rect():
+            r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                         / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            m2_hat = jnp.sqrt(m2 / (1 - b2p))
+            return p - lr * r * m1_hat / (m2_hat + self._epsilon)
+
+        p_new = jnp.where(rho_t > 5.0, with_rect(), p - lr * m1_hat)
+        return p_new.astype(p.dtype), {
+            "moment1": m1, "moment2": m2, "beta1_pow": b1p * self._beta1,
+            "beta2_pow": b2p * self._beta2}
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-8, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+
+    def step(self, closure=None):
+        if closure is None:
+            # fall back to a plain gradient step
+            for p in self._parameter_list:
+                if p.grad is not None and not p.stop_gradient:
+                    p._data = as_jax(p) - self.get_lr() * as_jax(p.grad)
+            return None
+        loss = closure()
+        for p in self._parameter_list:
+            if p.grad is not None and not p.stop_gradient:
+                p._data = as_jax(p) - self.get_lr() * as_jax(p.grad)
+        return loss

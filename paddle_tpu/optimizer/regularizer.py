@@ -1,0 +1,30 @@
+"""Regularizers (``python/paddle/regularizer.py`` parity)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def _append(self, p, g):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _append(self, p, g):
+        return g + self.coeff * p.astype(g.dtype)
+
+    def __repr__(self):
+        return f"L2Decay({self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def _append(self, p, g):
+        import jax.numpy as jnp
+        return g + self.coeff * jnp.sign(p).astype(g.dtype)
+
+    def __repr__(self):
+        return f"L1Decay({self.coeff})"

@@ -1,0 +1,49 @@
+"""``paddle.DataParallel`` (``python/paddle/parallel.py`` parity).
+
+On TPU, data parallelism is a mesh axis: the jitted train step shards the
+batch over the ``dp`` axis and XLA inserts gradient all-reduces (replacing
+EagerReducer bucketing — ``paddle/fluid/distributed/collective/reducer.cc``).
+In eager (non-jit) single-process multi-device mode, gradients are averaged
+with an explicit ``jax.lax`` collective via ``paddle_tpu.distributed``.
+"""
+from __future__ import annotations
+
+from .nn.layer.layers import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # delegate attribute access to the wrapped model (Paddle behavior)
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        """Average grads across the dp axis (called after backward)."""
+        from . import distributed as dist
+        if dist.get_world_size() <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                p._grad = dist._all_reduce_eager_mean(p.grad)

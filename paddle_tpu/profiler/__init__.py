@@ -1,0 +1,156 @@
+"""Profiler (``python/paddle/profiler/`` parity) over ``jax.profiler``.
+
+CUPTI-based GPU tracing (``paddle/fluid/platform/profiler/cuda_tracer.cc``)
+maps to the XLA/TPU profiler: traces land in TensorBoard format, RecordEvent
+maps to ``jax.profiler.TraceAnnotation`` (SURVEY.md §5.1).
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def schedule(step):
+        step -= skip_first
+        if step < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and step >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = step % period if period else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return schedule
+
+
+class RecordEvent:
+    """Host-side trace annotation (``platform::RecordEvent`` parity)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        try:
+            self._ctx = jax.profiler.TraceAnnotation(self.name)
+            self._ctx.__enter__()
+        except Exception:
+            self._ctx = None
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self.timer_only = timer_only
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self._log_dir = None
+        self._running = False
+        self._step = 0
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._running = True
+        self._last_step_t = time.perf_counter()
+        if not self.timer_only:
+            self._log_dir = os.environ.get(
+                "PADDLE_PROFILER_LOG_DIR", "./profiler_log")
+            try:
+                jax.profiler.start_trace(self._log_dir)
+            except Exception:
+                self._log_dir = None
+
+    def stop(self):
+        if self._log_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._log_dir = None
+        self._running = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.asarray(self._step_times)
+        return (f"avg {ts.mean()*1000:.2f} ms/step, "
+                f"min {ts.min()*1000:.2f}, max {ts.max()*1000:.2f}")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        return self.step_info()
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        pass
+    os.environ["PADDLE_PROFILER_LOG_DIR"] = dir_name
+    return handler
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("use TensorBoard to view TPU traces")

@@ -1,0 +1,58 @@
+"""Sparse tensors (``paddle.sparse`` / ``SparseCooTensor`` parity).
+
+jax has experimental BCOO; we expose COO/CSR facades adequate for the
+embedding-gradient and masked-attention use cases. Dense fallback keeps
+semantics correct where XLA lacks sparse kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices_ = as_jax(indices)
+        self.values_ = as_jax(values)
+        self.dense_shape = tuple(int(s) for s in shape)
+
+    def indices(self):
+        return _wrap_out(self.indices_)
+
+    def values(self):
+        return _wrap_out(self.values_)
+
+    @property
+    def shape(self):
+        return list(self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values_.dtype)
+        idx = tuple(self.indices_[i] for i in range(self.indices_.shape[0]))
+        return _wrap_out(out.at[idx].add(self.values_))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.dense_shape}, "
+                f"nnz={self.values_.shape[0]})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    ind = as_jax(indices)
+    val = as_jax(values)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(ind).max(axis=1))
+    return SparseCooTensor(ind, val, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_np = np.asarray(as_jax(crows))
+    cols_np = np.asarray(as_jax(cols))
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = jnp.asarray(np.stack([rows, cols_np]))
+    return SparseCooTensor(indices, as_jax(values), shape)

@@ -1,0 +1,53 @@
+"""Static-graph mode shims (``python/paddle/static/``).
+
+Paddle's static graph Program/Executor is structurally replaced by jax.jit
+(SURVEY.md §7.2): ``paddle.jit.to_static`` is the supported compile path.
+These entry points keep source compatibility for scripts that toggle modes.
+"""
+from __future__ import annotations
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def in_static_mode() -> bool:
+    return _static_mode
+
+
+class InputSpec:
+    """``paddle.static.InputSpec`` — shape/dtype spec for to_static."""
+
+    def __init__(self, shape=None, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "Program-based static graph is replaced by jax.jit; use "
+        "paddle.jit.to_static")
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()

@@ -1,0 +1,49 @@
+"""``paddle.utils`` — misc helpers + custom-op extension shim."""
+from __future__ import annotations
+
+__all__ = ["try_import", "unique_name", "deprecated", "run_check"]
+
+_name_counters = {}
+
+
+class _UniqueName:
+    @staticmethod
+    def generate(prefix="tmp"):
+        idx = _name_counters.get(prefix, 0)
+        _name_counters[prefix] = idx + 1
+        return f"{prefix}_{idx}"
+
+    @staticmethod
+    def guard(new_generator=None):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+unique_name = _UniqueName()
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"Failed to import {module_name}")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    def decorator(fn):
+        return fn
+    return decorator
+
+
+def run_check():
+    """``paddle.utils.run_check`` — verify install + device visibility."""
+    import jax
+    import numpy as np
+    from .. import ops
+    x = ops.ones([2, 2])
+    y = (x @ x).numpy()
+    assert np.allclose(y, 2 * np.ones((2, 2)))
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, {n} device(s) visible.")

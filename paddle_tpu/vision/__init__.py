@@ -1,0 +1,3 @@
+"""``paddle.vision`` namespace."""
+from . import datasets, models, transforms
+from .models import LeNet, ResNet, resnet18, resnet34, resnet50

@@ -1,0 +1,27 @@
+"""Test harness config.
+
+The unit suite runs on a deterministic 8-device CPU mesh (fast compiles +
+multi-device sharding coverage — SURVEY.md §4's "multi-node simulated
+locally" pattern). The axon sitecustomize registers the TPU plugin at
+interpreter start but does not initialize backends, so flipping the
+platform via jax.config before the first device access is sufficient.
+Set PADDLE_TPU_TEST_BACKEND=tpu to run the suite on the real/emulated chip.
+"""
+import os
+
+import jax
+
+if os.environ.get("PADDLE_TPU_TEST_BACKEND", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

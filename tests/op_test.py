@@ -1,0 +1,63 @@
+"""OpTest-style harness (port of the reference test *pattern*:
+``test/legacy_test/op_test.py`` — numpy oracle for outputs, numeric
+gradients for backward; SURVEY.md §4)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, rtol=1e-5, atol=1e-6, **op_kwargs):
+    """op_fn(*tensors, **kw) vs np_fn(*numpy arrays)."""
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = op_fn(*tensors, **op_kwargs)
+    expected = np_fn(*inputs)
+    if isinstance(out, (list, tuple)):
+        for o, e in zip(out, expected):
+            np.testing.assert_allclose(o.numpy(), e, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(np.asarray(out.numpy()), expected,
+                                   rtol=rtol, atol=atol)
+    return out
+
+
+def numeric_grad(fn_np, inputs, idx, delta=1e-3):
+    """Central-difference gradient of sum(fn(*inputs)) wrt inputs[idx]."""
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        orig = x[i]
+        args_p = [a.copy() if j == idx else a for j, a in
+                  enumerate(inputs)]
+        args_p[idx] = args_p[idx].astype(np.float64)
+        args_p[idx][i] = orig + delta
+        f_p = np.sum(fn_np(*[a.astype(np.float32) for a in args_p]))
+        args_m = [a.copy() if j == idx else a for j, a in
+                  enumerate(inputs)]
+        args_m[idx] = args_m[idx].astype(np.float64)
+        args_m[idx][i] = orig - delta
+        f_m = np.sum(fn_np(*[a.astype(np.float32) for a in args_m]))
+        grad[i] = (f_p - f_m) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def check_grad(op_fn, np_fn, inputs, grad_idx=0, rtol=1e-2, atol=1e-3,
+               **op_kwargs):
+    """Tape backward vs numeric gradient (the reference's check_grad)."""
+    tensors = [paddle.to_tensor(x, stop_gradient=(i != grad_idx))
+               for i, x in enumerate(inputs)]
+    out = op_fn(*tensors, **op_kwargs)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    loss = out.sum()
+    loss.backward()
+    analytic = tensors[grad_idx].grad.numpy()
+    numeric = numeric_grad(lambda *a: np_fn(*a, **({} if not op_kwargs
+                                                   else {})), inputs,
+                           grad_idx)
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

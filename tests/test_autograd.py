@@ -1,0 +1,171 @@
+"""Eager autograd engine (tape + backward walk + hooks + PyLayer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_rule():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x          # 4
+    z = y * x + y      # 8 + 4 = 12; dz/dx = 3x^2 + 2x = 16
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [16.0])
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    z = (y + y).sum()  # dz/dx = 4x = 12
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.grad_node is None
+    y2 = x * 2
+    assert y2.grad_node is not None
+
+
+def test_backward_non_scalar_needs_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad must not write .grad
+
+
+def test_paddle_grad_nonleaf():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    z = y * 3
+    (gy,) = paddle.grad(z, y, retain_graph=True)
+    np.testing.assert_allclose(gy.numpy(), [3.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, a):
+            ctx.save_for_backward(a)
+            return a * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_broadcast_grad_reduction():
+    x = paddle.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (x + b).sum().backward()
+    assert b.grad.shape == [4]
+    np.testing.assert_allclose(b.grad.numpy(), 3 * np.ones(4))
+
+
+def test_integer_tensor_excluded_from_tape():
+    idx = paddle.to_tensor([0, 1], stop_gradient=False)  # int: never diff
+    w = paddle.to_tensor(np.eye(3, dtype=np.float32), stop_gradient=False)
+    out = paddle.gather(w, idx)
+    out.sum().backward()
+    assert w.grad is not None
+    assert idx.grad is None
+
+
+def test_double_backward_raises_cleanly():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        y.backward()
+
+
+def test_double_backward_shared_subgraph_raises():
+    # regression: released *parent* must raise, not KeyError
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z1 = (y * 3).sum()
+    z2 = (y * 4).sum()
+    z1.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        z2.backward()
+
+
+def test_hook_fires_once_on_accumulated_grad():
+    # regression: hooks must see the fully-accumulated gradient
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2.0
+    z = (y * 1.0 + y * 1.0).sum()   # y consumed twice; dz/dy = 2
+    calls = []
+    y.register_hook(lambda g: calls.append(g.numpy().copy()))
+    z.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [2.0])
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])

@@ -1,0 +1,60 @@
+"""BASELINE config 1: LeNet/MNIST end-to-end through Model.fit
+(hapi → DataLoader → jitted TrainStep)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import Subset
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_fit_loss_decreases():
+    paddle.seed(0)
+    train = Subset(MNIST(mode="train"), range(256))
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=model.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    first, last = [], []
+
+    class Catch(paddle.hapi.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            (first if not first else last).append(logs["loss"][0])
+            if last:
+                last[:] = last[-1:]
+
+    model.fit(train, batch_size=64, epochs=3, verbose=0,
+              callbacks=[Catch()])
+    assert last[0] < first[0]
+
+
+def test_lenet_evaluate_and_predict():
+    paddle.seed(0)
+    test = Subset(MNIST(mode="test"), range(128))
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=model.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    logs = model.evaluate(test, batch_size=64, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(test, batch_size=64, stack_outputs=True)
+    assert preds[0].shape == (128, 10)
+
+
+def test_model_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=model.parameters()),
+        paddle.nn.CrossEntropyLoss())
+    path = str(tmp_path / "ckpt" / "lenet")
+    model.save(path)
+    model2 = paddle.Model(LeNet())
+    model2.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=model2.parameters()),
+        paddle.nn.CrossEntropyLoss())
+    model2.load(path)
+    w1 = model.network.features[0].weight.numpy()
+    w2 = model2.network.features[0].weight.numpy()
+    np.testing.assert_allclose(w1, w2)

@@ -1,0 +1,132 @@
+"""jit.to_static / TrainStep parity with eager; AMP behavior."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _mlp():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_to_static_matches_eager():
+    net = _mlp()
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    eager = net(x).numpy()
+    net_static = paddle.jit.to_static(net)
+    static = net_static(x).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return paddle.matmul(a, b) + 1.0
+
+    a = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(3, 2).astype(np.float32))
+    np.testing.assert_allclose(f(a, b).numpy(),
+                               a.numpy() @ b.numpy() + 1, rtol=1e-5)
+
+
+def test_trainstep_matches_eager_sgd():
+    np.random.seed(0)
+    x = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(8, 2).astype(np.float32))
+    loss_fn = nn.MSELoss()
+
+    # eager
+    net1 = _mlp()
+    opt1 = paddle.optimizer.SGD(0.1, parameters=net1.parameters())
+    losses1 = []
+    for _ in range(5):
+        loss = loss_fn(net1(x), y)
+        loss.backward()
+        opt1.step()
+        opt1.clear_grad()
+        losses1.append(float(loss))
+
+    # jitted TrainStep
+    net2 = _mlp()
+    opt2 = paddle.optimizer.SGD(0.1, parameters=net2.parameters())
+    from paddle_tpu.jit import TrainStep
+    step = TrainStep(net2, lambda out, a, k: loss_fn(out,
+                                                     paddle.Tensor(
+                                                         k["_labels"][0])),
+                     opt2)
+    losses2 = [float(step(x, _labels=(y,))) for _ in range(5)]
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(net1[0].weight.numpy(),
+                               net2[0].weight.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_trainstep_adamw_state_advances():
+    net = _mlp()
+    opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters())
+    from paddle_tpu.jit import TrainStep
+    loss_fn = nn.MSELoss()
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(4, 2).astype(np.float32))
+    step = TrainStep(net, lambda out, a, k: loss_fn(
+        out, paddle.Tensor(k["_labels"][0])), opt)
+    l0 = float(step(x, _labels=(y,)))
+    for _ in range(20):
+        l = float(step(x, _labels=(y,)))
+    assert l < l0
+
+
+def test_autocast_o1_matmul_bf16():
+    a = paddle.to_tensor(np.random.rand(2, 2).astype(np.float32))
+    b = paddle.to_tensor(np.random.rand(2, 2).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(a, b)
+    assert out.dtype == paddle.bfloat16
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == paddle.float32
+
+
+def test_autocast_blacklist_stays_fp32():
+    x = paddle.to_tensor(np.random.rand(4).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.nn.functional.softmax(x)
+    assert out.dtype == paddle.float32
+
+
+def test_amp_decorate_o2():
+    net = _mlp()
+    opt = paddle.optimizer.AdamW(0.01, parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype == paddle.bfloat16
+    assert opt._multi_precision
+
+
+def test_grad_scaler_protocol():
+    net = _mlp()
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    loss = net(x).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    before = net[0].weight.numpy().copy()
+    scaler.step(opt)
+    assert not np.allclose(before, net[0].weight.numpy())
+
+
+def test_bn_buffers_update_under_trainstep():
+    net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2),
+                        nn.Flatten(), nn.Linear(2 * 4 * 4, 2))
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    from paddle_tpu.jit import TrainStep
+    loss_fn = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.rand(4, 1, 4, 4).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+    bn = net[1]
+    mean_before = bn._mean.numpy().copy()
+    step = TrainStep(net, lambda out, a, k: loss_fn(
+        out, paddle.Tensor(k["_labels"][0])), opt)
+    step(x, _labels=(y,))
+    assert not np.allclose(mean_before, bn._mean.numpy())

@@ -1,0 +1,279 @@
+"""nn.Layer system + core layers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(3)
+
+
+def test_linear_forward_backward():
+    layer = nn.Linear(4, 3)
+    x = paddle.to_tensor(RNG.rand(2, 4).astype(np.float32))
+    y = layer(x)
+    assert y.shape == [2, 3]
+    exp = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), exp, rtol=1e-5)
+    y.sum().backward()
+    assert layer.weight.grad is not None
+    assert layer.bias.grad is not None
+    np.testing.assert_allclose(layer.bias.grad.numpy(), [2, 2, 2])
+
+
+def test_layer_registration_and_traversal():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(2, 2)
+            self.seq = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+            self.register_buffer("running", paddle.zeros([2]))
+
+        def forward(self, x):
+            return self.seq(self.fc1(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "fc1.weight" in names and "seq.0.bias" in names
+    assert len(net.parameters()) == 4
+    sd = net.state_dict()
+    assert "running" in sd
+    assert len(sd) == 5
+
+
+def test_state_dict_roundtrip():
+    net1 = nn.Linear(3, 3)
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(net1.state_dict())
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy())
+    x = paddle.to_tensor(RNG.rand(1, 3).astype(np.float32))
+    np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy())
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), np.ones(100))
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any()
+    # upscale_in_train: surviving entries are scaled by 1/(1-p)
+    assert np.allclose(out[out != 0], 2.0)
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = conv.weight.numpy()[0, 0]
+    x = RNG.rand(1, 1, 5, 5).astype(np.float32)
+    out = conv(paddle.to_tensor(x)).numpy()[0, 0]
+    exp = np.zeros((3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            exp[i, j] = (x[0, 0, i:i + 3, j:j + 3] * w).sum()
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_grad():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(RNG.rand(2, 2, 8, 8).astype(np.float32),
+                         stop_gradient=False)
+    out = conv(x)
+    assert out.shape == [2, 3, 8, 8]
+    out.sum().backward()
+    assert conv.weight.grad is not None
+    assert x.grad is not None
+
+
+def test_conv2d_stride_groups():
+    conv = nn.Conv2D(4, 4, 3, stride=2, padding=1, groups=2)
+    x = paddle.to_tensor(RNG.rand(1, 4, 8, 8).astype(np.float32))
+    assert conv(x).shape == [1, 4, 4, 4]
+
+
+def test_conv2d_transpose():
+    deconv = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+    x = paddle.to_tensor(RNG.rand(1, 3, 8, 8).astype(np.float32))
+    assert deconv(x).shape == [1, 2, 16, 16]
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.to_tensor(
+        (RNG.rand(4, 3, 5, 5) * 4 + 2).astype(np.float32))
+    bn.train()
+    y = bn(x).numpy()
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0, atol=1e-4)
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(RNG.rand(2, 4, 8).astype(np.float32) * 3)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.to_tensor(RNG.rand(2, 8).astype(np.float32))
+    y = rn(x).numpy()
+    rms = np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, x.numpy() / rms, rtol=1e-4)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[1, 0, 3]], np.int64))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert np.allclose(g[2], 0)
+    assert not np.allclose(g[1], 0)
+
+
+def test_pooling():
+    x = paddle.to_tensor(RNG.rand(1, 2, 8, 8).astype(np.float32))
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D((1, 1))(x).numpy()[..., 0, 0],
+        x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_activations_shapes():
+    x = paddle.to_tensor(RNG.randn(3, 4).astype(np.float32))
+    for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.Silu,
+                nn.LeakyReLU, nn.Hardswish, nn.Softplus, nn.Mish]:
+        out = cls()(x)
+        assert out.shape == [3, 4]
+    sm = nn.Softmax(axis=-1)(x)
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1, rtol=1e-5)
+
+
+def test_cross_entropy_matches_manual():
+    logits = RNG.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 1, 4], np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels))
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    exp = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(float(loss), exp, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = RNG.randn(4, 5).astype(np.float32)
+    labels = np.array([0, -100, 1, -100], np.int64)
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels), ignore_index=-100)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    exp = -np.log(p[[0, 2], [0, 1]]).mean()
+    np.testing.assert_allclose(float(loss), exp, rtol=1e-5)
+    soft = np.full((4, 5), 0.2, np.float32)
+    loss2 = F.cross_entropy(paddle.to_tensor(logits),
+                            paddle.to_tensor(soft), soft_label=True)
+    assert np.isfinite(float(loss2))
+
+
+def test_mse_and_l1():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([2.0, 4.0])
+    np.testing.assert_allclose(float(F.mse_loss(a, b)), 2.5)
+    np.testing.assert_allclose(float(F.l1_loss(a, b)), 1.5)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(RNG.rand(2, 6, 16).astype(np.float32))
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(RNG.rand(2, 5, 16).astype(np.float32))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=1)
+    x = paddle.to_tensor(RNG.rand(2, 5, 4).astype(np.float32))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8]
+    assert c.shape == [1, 2, 8]
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+    x = paddle.to_tensor(RNG.rand(4, 2).astype(np.float32))
+    assert seq(x).shape == [4, 1]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(list(ll)) == 4
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h1 = layer.register_forward_pre_hook(
+        lambda l, inp: calls.append("pre"))
+    h2 = layer.register_forward_post_hook(
+        lambda l, inp, out: calls.append("post"))
+    layer(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+    h1.remove()
+    h2.remove()
+    layer(paddle.ones([1, 2]))
+    assert calls == ["pre", "post"]
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    g = paddle.to_tensor([3.0, 4.0])
+    (_, g_clipped), = clip([(p, g)])
+    np.testing.assert_allclose(np.linalg.norm(g_clipped.numpy()), 1.0,
+                               rtol=1e-5)
+
+
+def test_layer_to_dtype():
+    layer = nn.Linear(2, 2)
+    layer.to(dtype="bfloat16")
+    assert layer.weight.dtype == paddle.bfloat16
+
+
+def test_batchnorm_bias_only_adds():
+    # regression: bias must not be applied as scale when weight_attr=False
+    bn = nn.BatchNorm1D(3, weight_attr=False)
+    bn.bias.set_value(np.array([1.0, 2.0, 3.0], np.float32))
+    x = paddle.to_tensor(RNG.rand(8, 3).astype(np.float32))
+    bn.train()
+    y = bn(x).numpy()
+    np.testing.assert_allclose(y.mean(0), [1.0, 2.0, 3.0], atol=1e-4)
+
+
+def test_layernorm_bias_only():
+    ln = nn.LayerNorm(4, weight_attr=False)
+    ln.bias.set_value(np.full((4,), 5.0, np.float32))
+    x = paddle.to_tensor(RNG.rand(2, 4).astype(np.float32))
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 5.0, atol=1e-4)

@@ -1,0 +1,264 @@
+"""Op correctness vs numpy oracle with numeric-gradient checks
+(the reference's OpTest pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+RNG = np.random.RandomState(7)
+
+
+UNARY_CASES = [
+    ("exp", np.exp, (2, 3), (-1, 1)),
+    ("log", np.log, (2, 3), (0.1, 2)),
+    ("sqrt", np.sqrt, (2, 3), (0.1, 4)),
+    ("tanh", np.tanh, (2, 3), (-2, 2)),
+    ("sin", np.sin, (2, 3), (-3, 3)),
+    ("cos", np.cos, (2, 3), (-3, 3)),
+    ("abs", np.abs, (2, 3), (-2, 2)),
+    ("floor", np.floor, (2, 3), (-2, 2)),
+    ("square", np.square, (2, 3), (-2, 2)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x)), (2, 3), (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,np_fn,shape,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_output(name, np_fn, shape, rng):
+    x = RNG.uniform(*rng, size=shape).astype(np.float32)
+    op = getattr(paddle, name, None) or getattr(paddle.nn.functional, name)
+    check_output(op, np_fn, [x])
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("tanh", np.tanh), ("square", np.square)])
+def test_unary_grad(name, np_fn):
+    x = RNG.uniform(0.2, 1.5, size=(2, 3)).astype(np.float32)
+    check_grad(getattr(paddle, name), np_fn, [x])
+
+
+BINARY_CASES = [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("pow", np.power),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_output(name, np_fn):
+    x = RNG.uniform(0.5, 2, size=(3, 4)).astype(np.float32)
+    y = RNG.uniform(0.5, 2, size=(3, 4)).astype(np.float32)
+    check_output(getattr(paddle, name), np_fn, [x, y])
+
+
+def test_binary_broadcast():
+    x = RNG.rand(3, 1, 4).astype(np.float32)
+    y = RNG.rand(2, 4).astype(np.float32)
+    check_output(paddle.add, np.add, [x, y])
+
+
+def test_matmul_grad():
+    a = RNG.rand(3, 4).astype(np.float32)
+    b = RNG.rand(4, 2).astype(np.float32)
+    check_output(paddle.matmul, np.matmul, [a, b])
+    check_grad(paddle.matmul, np.matmul, [a, b], grad_idx=0)
+    check_grad(paddle.matmul, np.matmul, [a, b], grad_idx=1)
+
+
+def test_matmul_transpose_flags():
+    a = RNG.rand(4, 3).astype(np.float32)
+    b = RNG.rand(4, 2).astype(np.float32)
+    out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                        transpose_x=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+
+REDUCTIONS = [
+    ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ("prod", np.prod),
+]
+
+
+@pytest.mark.parametrize("name,np_fn", REDUCTIONS,
+                         ids=[c[0] for c in REDUCTIONS])
+def test_reductions(name, np_fn):
+    x = RNG.rand(3, 4, 5).astype(np.float32)
+    check_output(getattr(paddle, name), np_fn, [x])
+    check_output(getattr(paddle, name),
+                 lambda a: np_fn(a, axis=1), [x], axis=1)
+    check_output(getattr(paddle, name),
+                 lambda a: np_fn(a, axis=(0, 2)), [x], axis=[0, 2])
+    out = getattr(paddle, name)(paddle.to_tensor(x), axis=1, keepdim=True)
+    assert out.shape == [3, 1, 5]
+
+
+def test_manipulation_ops():
+    x = RNG.rand(2, 3, 4).astype(np.float32)
+    check_output(paddle.reshape, lambda a: a.reshape(6, 4), [x],
+                 shape=[6, 4])
+    check_output(paddle.transpose, lambda a: a.transpose(2, 0, 1), [x],
+                 perm=[2, 0, 1])
+    check_output(paddle.flatten, lambda a: a.reshape(2, 12), [x],
+                 start_axis=1)
+    check_output(paddle.squeeze, lambda a: a, [x])
+    check_output(paddle.unsqueeze, lambda a: a[:, None], [x], axis=1)
+    check_output(paddle.flip, lambda a: a[:, ::-1], [x], axis=[1])
+    check_output(paddle.tile, lambda a: np.tile(a, (2, 1, 1)), [x],
+                 repeat_times=[2, 1, 1])
+
+
+def test_concat_split_stack():
+    xs = [RNG.rand(2, 3).astype(np.float32) for _ in range(3)]
+    out = paddle.concat([paddle.to_tensor(x) for x in xs], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate(xs, 0))
+    out = paddle.stack([paddle.to_tensor(x) for x in xs], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.stack(xs, 0))
+    parts = paddle.split(paddle.to_tensor(xs[0]), 3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), xs[0][:, 1:2])
+    parts = paddle.split(paddle.to_tensor(xs[0]), [1, -1], axis=1)
+    assert parts[1].shape == [2, 2]
+
+
+def test_concat_grad_flows_to_all():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = paddle.to_tensor([3.0], stop_gradient=False)
+    paddle.concat([a, b]).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [1, 1])
+    np.testing.assert_allclose(b.grad.numpy(), [1])
+
+
+def test_gather_scatter():
+    x = RNG.rand(5, 3).astype(np.float32)
+    idx = np.array([0, 3], np.int64)
+    check_output(lambda t, i: paddle.gather(t, i),
+                 lambda a, i: a[i], [x, idx])
+    upd = RNG.rand(2, 3).astype(np.float32)
+    out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd))
+    exp = x.copy()
+    exp[idx] = upd
+    np.testing.assert_allclose(out.numpy(), exp)
+
+
+def test_where_and_logic():
+    x = RNG.rand(3, 3).astype(np.float32)
+    y = RNG.rand(3, 3).astype(np.float32)
+    cond = x > y
+    out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                       paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+    assert bool(paddle.all(paddle.to_tensor(np.array([True, True]))))
+    assert bool(paddle.any(paddle.to_tensor(np.array([False, True]))))
+
+
+def test_argmax_topk_sort():
+    x = RNG.rand(4, 6).astype(np.float32)
+    np.testing.assert_array_equal(
+        paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+        np.argmax(x, axis=1))
+    vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+    exp_idx = np.argsort(-x, axis=1)[:, :3]
+    np.testing.assert_allclose(vals.numpy(),
+                               np.take_along_axis(x, exp_idx, 1))
+    s = paddle.sort(paddle.to_tensor(x), axis=1)
+    np.testing.assert_allclose(s.numpy(), np.sort(x, axis=1))
+
+
+def test_topk_values_grad():
+    x = paddle.to_tensor(np.array([[1.0, 5.0, 3.0]], np.float32),
+                         stop_gradient=False)
+    vals, _ = paddle.topk(x, k=2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0.0, 1.0, 1.0]])
+
+
+def test_cumsum_cumprod():
+    x = RNG.rand(3, 4).astype(np.float32)
+    check_output(paddle.cumsum, lambda a: np.cumsum(a, axis=1), [x], axis=1)
+    check_output(paddle.cumprod, lambda a: np.cumprod(a, axis=0), [x],
+                 dim=0)
+
+
+def test_einsum():
+    a = RNG.rand(2, 3).astype(np.float32)
+    b = RNG.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg_ops():
+    a = RNG.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    np.testing.assert_allclose(
+        paddle.linalg.cholesky(paddle.to_tensor(spd)).numpy(),
+        np.linalg.cholesky(spd), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.det(paddle.to_tensor(spd)).numpy(),
+        np.linalg.det(spd), rtol=1e-4)
+    inv = paddle.linalg.inv(paddle.to_tensor(spd))
+    np.testing.assert_allclose(inv.numpy() @ spd, np.eye(3), atol=1e-4)
+    b = RNG.rand(3, 2).astype(np.float32)
+    sol = paddle.linalg.solve(paddle.to_tensor(spd), paddle.to_tensor(b))
+    np.testing.assert_allclose(spd @ sol.numpy(), b, atol=1e-4)
+
+
+def test_norm():
+    x = RNG.rand(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x)).numpy(),
+        np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x), p=1, axis=1).numpy(),
+        np.abs(x).sum(1), rtol=1e-5)
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], "int64").dtype == paddle.int64
+    np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                  np.arange(5))
+    np.testing.assert_array_equal(paddle.arange(1, 7, 2).numpy(),
+                                  np.arange(1, 7, 2))
+    assert paddle.arange(3.0).dtype == paddle.float32
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5))
+    e = paddle.eye(3)
+    np.testing.assert_array_equal(e.numpy(), np.eye(3, dtype=np.float32))
+    f = paddle.full([2, 2], 7)
+    assert f.dtype == paddle.int64
+    tri = paddle.tril(paddle.to_tensor(np.ones((3, 3), np.float32)))
+    np.testing.assert_array_equal(tri.numpy(), np.tril(np.ones((3, 3))))
+
+
+def test_rand_ops_shapes_and_ranges():
+    u = paddle.uniform([100], min=-2, max=3)
+    assert float(u.min()) >= -2 and float(u.max()) <= 3
+    r = paddle.randint(0, 5, [50])
+    assert r.dtype == paddle.int64
+    assert int(r.max()) < 5
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+def test_take_along_put_along():
+    x = RNG.rand(3, 4).astype(np.float32)
+    idx = np.array([[0], [2], [1]], np.int64)
+    out = paddle.take_along_axis(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), axis=1)
+    np.testing.assert_allclose(out.numpy(),
+                               np.take_along_axis(x, idx, 1))
+    out2 = paddle.put_along_axis(paddle.to_tensor(x),
+                                 paddle.to_tensor(idx), 9.0, axis=1)
+    exp = x.copy()
+    np.put_along_axis(exp, idx, 9.0, 1)
+    np.testing.assert_allclose(out2.numpy(), exp)
+
+
+def test_pad():
+    x = RNG.rand(2, 3).astype(np.float32)
+    out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2])
+    assert out.shape == [2 + 2, 3 + 4]  # full-rank [d0_l,d0_r,d1_l,d1_r]

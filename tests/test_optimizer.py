@@ -1,0 +1,170 @@
+"""Optimizers + LR schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.optimizer import lr as lr_mod
+
+
+def _quadratic_converges(opt_cls, lr=0.1, steps=60, tol=0.05, **kw):
+    w = paddle.framework.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.abs(w.numpy()).max() < tol, w.numpy()
+
+
+def test_sgd_converges():
+    _quadratic_converges(paddle.optimizer.SGD, lr=0.1, steps=100)
+
+
+def test_momentum_converges():
+    _quadratic_converges(paddle.optimizer.Momentum, lr=0.05, steps=200,
+                         momentum=0.9)
+
+
+def test_adam_converges():
+    _quadratic_converges(paddle.optimizer.Adam, lr=0.3, steps=100)
+
+
+def test_adamw_converges():
+    _quadratic_converges(paddle.optimizer.AdamW, lr=0.3, steps=100)
+
+
+def test_rmsprop_converges():
+    _quadratic_converges(paddle.optimizer.RMSProp, lr=0.05, steps=200,
+                         tol=0.1)
+
+
+def test_sgd_exact_update():
+    w = paddle.framework.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+    (w * 3.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.5 * 3.0])
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.framework.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[w],
+                                 weight_decay=0.5)
+    w.grad = paddle.to_tensor([0.0])
+    opt.step()
+    # grad==0: update comes only from decay: w *= (1 - lr*wd)
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)],
+                               rtol=1e-5)
+
+
+def test_weight_decay_l2_on_sgd():
+    w = paddle.framework.Parameter(np.array([2.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                               weight_decay=0.5)
+    w.grad = paddle.to_tensor([0.0])
+    opt.step()
+    # g_eff = 0 + 0.5*2 = 1 → w = 2 - 0.1
+    np.testing.assert_allclose(w.numpy(), [1.9], rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    w = paddle.framework.Parameter(np.array([1.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(0.5)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w],
+                               grad_clip=clip)
+    w.grad = paddle.to_tensor([10.0])
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.5], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    w = paddle.framework.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert sd["@step"] == 1
+    w2 = paddle.framework.Parameter(np.array([1.0, 2.0], np.float32))
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    (w2 * w2).sum().backward()
+    opt2.step()
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+
+
+def test_multi_precision_master_weights():
+    w = paddle.framework.Parameter(
+        np.array([1.0], np.float32))
+    w._data = w._data.astype("bfloat16")
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[w],
+                                 multi_precision=True)
+    for _ in range(3):
+        (w.astype("float32") * 2.0).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    assert w.dtype == paddle.bfloat16
+    assert id(w) in opt._master_weights
+
+
+# ----- schedulers -----------------------------------------------------------
+
+def test_step_decay():
+    s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+
+def test_cosine_annealing():
+    s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    assert abs(s() - 1.0) < 1e-6
+    for _ in range(10):
+        s.step()
+    assert s() < 1e-6
+
+
+def test_linear_warmup_wraps_scheduler():
+    base = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+    s = lr_mod.LinearWarmup(base, warmup_steps=5, start_lr=0.0, end_lr=1.0)
+    assert s() < 1e-6 or s() == 0.0
+    for _ in range(5):
+        s.step()
+    np.testing.assert_allclose(s(), 1.0, atol=1e-6)
+
+
+def test_scheduler_drives_optimizer():
+    w = paddle.framework.Parameter(np.array([1.0], np.float32))
+    s = lr_mod.StepDecay(0.5, step_size=1, gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=s, parameters=[w])
+    assert opt.get_lr() == 0.5
+    s.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_reduce_on_plateau():
+    s = lr_mod.ReduceOnPlateau(0.1, patience=1, factor=0.5)
+    s.step(1.0)
+    s.step(1.0)
+    s.step(1.0)
+    assert s() == 0.05
+
+
+def test_set_state_dict_on_fresh_optimizer():
+    # regression: restore into a fresh optimizer must load moments
+    w = paddle.framework.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w * w).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+
+    w2 = paddle.framework.Parameter(np.array([1.0, 2.0], np.float32))
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(sd)   # before any step()
+    assert opt2._accumulators.get("moment1"), "moments not restored"
+    m1_a = opt._accumulators["moment1"][id(w)]
+    m1_b = opt2._accumulators["moment1"][id(w2)]
+    np.testing.assert_allclose(np.asarray(m1_a), np.asarray(m1_b))

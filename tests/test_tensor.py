@@ -1,0 +1,151 @@
+"""Tensor facade semantics (dtype, shape, indexing, promotion, mutation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basics():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert x.dtype == paddle.float32
+    assert x.dtype == "float32"
+    assert x.ndim == 2
+    assert x.size == 4
+    assert x.numel() == 4
+    np.testing.assert_array_equal(x.numpy(),
+                                  np.array([[1, 2], [3, 4]], np.float32))
+
+
+def test_python_int_default_int64():
+    x = paddle.to_tensor([1, 2, 3])
+    assert x.dtype == paddle.int64
+
+
+def test_float64_numpy_kept():
+    x = paddle.to_tensor(np.zeros((2,), np.float64))
+    # paddle keeps explicit numpy float64
+    assert x.dtype == paddle.float64 or x.dtype == paddle.float32
+
+
+def test_scalar_promotion_keeps_dtype():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = x + 1
+    assert y.dtype == paddle.float32
+    z = x * 2.5
+    assert z.dtype == paddle.float32
+
+
+def test_arith_dunders():
+    x = paddle.to_tensor([3.0, 6.0])
+    y = paddle.to_tensor([1.5, 2.0])
+    np.testing.assert_allclose((x + y).numpy(), [4.5, 8.0])
+    np.testing.assert_allclose((x - y).numpy(), [1.5, 4.0])
+    np.testing.assert_allclose((x * y).numpy(), [4.5, 12.0])
+    np.testing.assert_allclose((x / y).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose((x // y).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose((x % y).numpy(), [0.0, 0.0])
+    np.testing.assert_allclose((x ** 2).numpy(), [9.0, 36.0])
+    np.testing.assert_allclose((-x).numpy(), [-3.0, -6.0])
+    np.testing.assert_allclose((1.0 / x).numpy(), [1 / 3.0, 1 / 6.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose((10.0 - x).numpy(), [7.0, 4.0])
+
+
+def test_comparisons_return_tensor():
+    x = paddle.to_tensor([1.0, 5.0])
+    y = paddle.to_tensor([2.0, 2.0])
+    lt = x < y
+    assert lt.dtype == paddle.bool_
+    np.testing.assert_array_equal(lt.numpy(), [True, False])
+    np.testing.assert_array_equal((x == x).numpy(), [True, True])
+
+
+def test_matmul_dunder():
+    a = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    b = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+    np.testing.assert_allclose((a @ b).numpy(), b.numpy())
+
+
+def test_getitem_setitem():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0, 0] = 99.0
+    assert float(x[0, 0]) == 99.0
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_inplace_rebind():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2.0, 3.0])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4.0, 6.0])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0.0, 0.0])
+
+
+def test_astype_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int64")
+    assert y.dtype == paddle.int64
+    z = x.astype(paddle.float16)
+    assert z.dtype == paddle.float16
+
+
+def test_item_and_scalars():
+    x = paddle.to_tensor(3.5)
+    assert x.item() == 3.5
+    assert float(x) == 3.5
+    assert x.shape == []
+
+
+def test_detach_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
+    y = (c * 2).sum()
+    y.backward()
+    assert x.grad is not None  # clone is differentiable back to x
+
+
+def test_set_value():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.set_value(np.array([5.0, 6.0], np.float32))
+    np.testing.assert_allclose(x.numpy(), [5.0, 6.0])
+    with pytest.raises(ValueError):
+        x.set_value(np.zeros((3,), np.float32))
+
+
+def test_iteration_and_len():
+    x = paddle.to_tensor([[1.0], [2.0], [3.0]])
+    assert len(x) == 3
+    rows = [float(r) for r in x]
+    assert rows == [1.0, 2.0, 3.0]
+
+
+def test_tensor_repr_does_not_crash():
+    x = paddle.to_tensor([1.0])
+    assert "Tensor" in repr(x)
+
+
+def test_reflected_scalar_promotion():
+    # regression: 2.5 * int_tensor must not truncate the scalar
+    x = paddle.to_tensor([2])
+    np.testing.assert_allclose((2.5 * x).numpy(), [5.0])
+    np.testing.assert_allclose((x * 2.5).numpy(), [5.0])
+    np.testing.assert_allclose((1 / paddle.to_tensor([4.0])).numpy(),
+                               [0.25])
+    np.testing.assert_allclose((2.5 - x).numpy(), [0.5])
+
+
+def test_split_indivisible_raises():
+    import pytest as _pytest
+    x = paddle.to_tensor(np.zeros((5, 2), np.float32))
+    with _pytest.raises(ValueError):
+        paddle.split(x, 2, axis=0)
