@@ -11,6 +11,26 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          broadcast, broadcast_object_list, get_group,
                          isend, irecv, new_group, recv, reduce_scatter,
                          scatter, send, wait, _all_reduce_eager_mean)
+from . import fleet
+from . import auto_parallel
+from . import checkpoint
+from . import sharding as sharding_mod
+from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,
+                            Replicate, Shard, Strategy, dtensor_from_fn,
+                            reshard, shard_layer, shard_optimizer,
+                            shard_tensor, to_static, unshard_dtensor)
+from .checkpoint import load_state_dict, save_state_dict
+from .moe import MoELayer
+from .pipeline import pipeline_apply, stack_stage_params
+from .recompute import recompute, recompute_sequential
+from .ring_attention import RingFlashAttention, ring_flash_attention
+from .shard_utils import constraint as shard_op_constraint
+from .sharding import group_sharded_parallel, save_group_sharded_model
+
+# paddle.distributed.sharding submodule path parity
+import sys as _sys
+_sys.modules[__name__ + ".sharding"] = sharding_mod
+sharding = sharding_mod
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

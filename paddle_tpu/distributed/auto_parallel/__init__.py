@@ -1,0 +1,297 @@
+"""Semi-auto parallel API (``python/paddle/distributed/auto_parallel/``
++ C++ DistTensor machinery parity).
+
+TPU-first mapping (SURVEY.md §7.2): ``DistTensor + SPMD rules + reshard``
+ARE ``jax.sharding.NamedSharding`` + GSPMD propagation + resharding
+``device_put``. This module supplies the API parity layer: ProcessMesh,
+Shard/Replicate/Partial placements, shard_tensor, reshard, shard_layer,
+shard_optimizer. SPMD rule inference per-op is the compiler's job here —
+XLA's sharding propagation replaces ``phi/infermeta/spmd_rules/``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...framework.core import Tensor, as_jax, _wrap_out
+from .. import env as _env
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "shard_optimizer", "unshard_dtensor", "get_mesh", "set_mesh",
+           "Strategy", "to_static", "DistAttr", "DistModel"]
+
+
+class ProcessMesh:
+    """N-d logical mesh over device ids (``paddle.distributed.ProcessMesh``
+    parity, backed by a jax Mesh)."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._shape = list(arr.shape)
+        devices = jax.devices()
+        dev_arr = np.array([devices[i % len(devices)]
+                            for i in self._process_ids]).reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dims={self._dim_names})"
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements, ndim: int):
+    """placements[i] describes mesh dim i → build a PartitionSpec over
+    tensor dims."""
+    tensor_axes: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            cur = tensor_axes[pl.dim]
+            if cur is None:
+                tensor_axes[pl.dim] = name
+            elif isinstance(cur, tuple):
+                tensor_axes[pl.dim] = cur + (name,)
+            else:
+                tensor_axes[pl.dim] = (cur, name)
+    return P(*tensor_axes)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 place=None, stop_gradient=None):
+    """``dist.shard_tensor`` — place onto the mesh with NamedSharding."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    spec = _placements_to_spec(mesh, placements, t.ndim)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    if not isinstance(t._data, jax.core.Tracer):
+        t._data = jax.device_put(t._data, sharding)
+    else:
+        t._data = jax.lax.with_sharding_constraint(t._data, sharding)
+    t.dist_spec = spec
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    return t
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """``dist.reshard`` — XLA moves the data (the reshard/ function zoo
+    s_to_r/r_to_s/p_to_r collapses into one device_put)."""
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    arr = as_jax(dist_tensor)
+    full = jax.device_put(
+        arr, NamedSharding(_single_mesh(), P()))
+    out = _wrap_out(full)
+    out.stop_gradient = dist_tensor.stop_gradient \
+        if isinstance(dist_tensor, Tensor) else True
+    return out
+
+
+def _single_mesh():
+    return Mesh(np.array(jax.devices()[:1]), ("x",))
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """``dist.shard_layer``: apply shard_fn(name, layer, mesh) to place
+    each sublayer's params; default replicates onto the mesh."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is not None:
+                    ndim = p.ndim
+                    shard_tensor(p, mesh,
+                                 [Replicate()] * len(mesh.shape))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """``dist.shard_optimizer``: optimizer states inherit each param's
+    sharding automatically (accumulators are created like the param);
+    shard_fn can override per-state placement."""
+    if shard_fn is not None:
+        optimizer._dist_shard_fn = shard_fn
+    return optimizer
+
+
+class DistAttr:
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+
+class Strategy:
+    """``dist.Strategy`` (auto-parallel strategy mirror)."""
+
+    class _Sub:
+        def __init__(self, **kw):
+            self.__dict__.update(kw)
+            self.enable = False
+
+    def __init__(self, config=None):
+        self.sharding = Strategy._Sub(degree=1, stage=1)
+        self.fused_passes = Strategy._Sub(fused_passes_list=[])
+        self.gradient_merge = Strategy._Sub(k_steps=1, avg=True)
+        self.pipeline = Strategy._Sub(schedule_mode="1F1B",
+                                      micro_batch_size=1,
+                                      accumulate_steps=1)
+        self.amp = Strategy._Sub(dtype="bfloat16", level="O1")
+        self.recompute = Strategy._Sub(checkpoints=[])
+
+
+class DistModel:
+    """Result of ``dist.to_static``: jitted dist train/eval step."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        self._train_step = None
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def __call__(self, *batch):
+        inputs = [b if isinstance(b, Tensor) else Tensor(b)
+                  for b in batch]
+        if self._mode == "train" and self._optimizer is not None \
+                and self._loss is not None:
+            if self._train_step is None:
+                from ...jit import TrainStep
+                self._train_step = TrainStep(
+                    self.network,
+                    lambda out, a, k: self._loss(
+                        out, *[Tensor(x) for x in k["_labels"]]),
+                    self._optimizer)
+            *feats, label = inputs
+            return self._train_step(*feats, _labels=(label,))
+        out = self.network(*inputs[:-1] if self._loss else inputs)
+        if self._loss is not None:
+            return self._loss(out, inputs[-1])
+        return out
+
+    def state_dict(self, mode="all"):
+        return self.network.state_dict()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """``dist.to_static`` parity — returns (DistModel, loader)."""
+    dm = DistModel(layer, loader, loss, optimizer, strategy)
+    return dm, loader
+
+
+def get_mesh():
+    m = _env.get_mesh()
+    return m
+
+
+def set_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        _env.set_mesh(mesh.jax_mesh())
+    else:
+        _env.set_mesh(mesh)

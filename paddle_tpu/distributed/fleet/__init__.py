@@ -1,0 +1,146 @@
+"""``paddle.distributed.fleet`` API (``python/paddle/distributed/fleet/``).
+
+``fleet.init`` builds the hybrid mesh (pp, dp, sharding, sep, mp) from
+DistributedStrategy degrees; ``distributed_model``/``distributed_optimizer``
+place parameters/optimizer state onto it. Collectives are emitted by
+GSPMD in the jitted step rather than by per-group NCCL communicators.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...framework.core import Tensor
+from .. import env as _env
+from ..shard_utils import mesh_axis_size, place_param
+from .distributed_strategy import DistributedStrategy
+from .meta_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
+                            SharedLayerDesc, ShardingParallel,
+                            TensorParallel, get_rng_state_tracker)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from . import sequence_parallel_utils
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       get_hcg, set_hcg)
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "CommunicateTopology", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "worker_num", "worker_index", "is_first_worker", "barrier_worker",
+           "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "get_rng_state_tracker"]
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
+    global _fleet_initialized, _strategy
+    _strategy = strategy or DistributedStrategy()
+    _env.init_parallel_env()
+    hcg = HybridCommunicateGroup(strategy=_strategy)
+    set_hcg(hcg)
+    _fleet_initialized = True
+    return None
+
+
+def is_initialized():
+    return _fleet_initialized
+
+
+def get_hybrid_communicate_group():
+    return get_hcg()
+
+
+def _place_model_params(model):
+    for p in model.parameters():
+        place_param(p)
+    return model
+
+
+def distributed_model(model):
+    """Wrap per the active parallel mode (``fleet.distributed_model``)."""
+    hcg = get_hcg()
+    _place_model_params(model)
+    if hcg is None:
+        return model
+    if isinstance(model, PipelineLayer) or \
+            hcg.get_pipe_parallel_world_size() > 1:
+        if isinstance(model, PipelineLayer):
+            return PipelineParallel(model, hcg, _strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, _strategy)
+    if hcg.get_data_parallel_world_size() > 1 or \
+            hcg.get_sharding_parallel_world_size() > 1:
+        from ...parallel import DataParallel
+        return DataParallel(model)
+    return model
+
+
+class HybridParallelOptimizer:
+    """``fleet.distributed_optimizer`` result: delegates to the inner
+    optimizer; hybrid grad sync happens in the jitted step via GSPMD."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner = optimizer
+        self._hcg = hcg
+        if strategy is not None and strategy.sharding_configs.get(
+                "stage", 1) >= 1 and mesh_axis_size("sharding") > 1:
+            from ..sharding import shard_optimizer_states
+            shard_optimizer_states(optimizer)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+    def step(self):
+        self._inner.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self._inner.step()
+        return None, None
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, get_hcg(),
+                                   strategy or _strategy)
+
+
+# worker info -----------------------------------------------------------
+
+def worker_num():
+    return _env.get_world_size()
+
+
+def worker_index():
+    return _env.get_rank()
+
+
+def is_first_worker():
+    return _env.get_rank() == 0
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+class UtilBase:
+    def all_reduce(self, input, mode="sum"):
+        return input
+
+    def barrier(self):
+        barrier_worker()
+
+
+util = UtilBase()
+
+
+# expose as fleet.fleet for `from paddle.distributed.fleet import fleet`
+import sys as _sys
+fleet = _sys.modules[__name__]
+utils = sequence_parallel_utils

@@ -1,0 +1,58 @@
+"""``DistributedStrategy`` (``python/paddle/distributed/fleet/base/
+distributed_strategy.py`` parity — protobuf replaced by dataclass state)."""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 32768.0, "use_pure_fp16": False,
+            "use_fp16_guard": True, "custom_white_list": [],
+            "custom_black_list": [], "dtype": "bfloat16",
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {
+            "sharding_degree": 1, "stage": 1, "offload": False,
+        }
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B",
+        }
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {
+            "tensor_parallel_degree": 1,
+        }
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.a_sync = False
+
+    def __deepcopy__(self, memo):
+        new = DistributedStrategy()
+        for k, v in self.__dict__.items():
+            setattr(new, k, copy.deepcopy(v, memo))
+        return new
+
+    def __repr__(self):
+        lines = ["DistributedStrategy("]
+        for k, v in sorted(self.__dict__.items()):
+            lines.append(f"  {k}={v!r},")
+        return "\n".join(lines) + "\n)"

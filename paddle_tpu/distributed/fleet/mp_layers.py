@@ -1,0 +1,130 @@
+"""Megatron-style tensor-parallel layers
+(``python/paddle/distributed/fleet/layers/mpu/mp_layers.py`` parity).
+
+TPU-first: instead of per-rank weight shards + explicit
+identity/allreduce autograd ops, each layer holds the FULL logical weight
+annotated with a PartitionSpec over the ``mp`` mesh axis; GSPMD partitions
+the matmul onto the MXU of each chip and inserts the all-reduce /
+all-gather over ICI that the reference performs via ProcessGroupNCCL
+(``mp_ops.py`` _c_identity/_c_allreduce pairs).
+"""
+from __future__ import annotations
+
+import math
+
+from ...framework.core import Tensor
+from ...nn import functional as F
+from ...nn.initializer import Constant, Normal, XavierNormal
+from ...nn.layer.layers import Layer
+from ..shard_utils import annotate_param, constraint, mesh_axis_size
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy"]
+
+
+class ColumnParallelLinear(Layer):
+    """Y = XW, W sharded on the output (column) dim over ``mp``."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = mesh_axis_size("mp")
+        if out_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"out_features={out_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, (None, "mp"))
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+            annotate_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            y = constraint(y, *([None] * (y.ndim)))  # replicated
+        else:
+            y = constraint(y, *([None] * (y.ndim - 1) + ["mp"]))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Y = XW, W sharded on the input (row) dim over ``mp``; GSPMD emits
+    the partial-sum all-reduce the reference codes explicitly."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = mesh_axis_size("mp")
+        if in_features % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"in_features={in_features} not divisible by mp degree "
+                f"{self.world_size}")
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, ("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter(
+                [out_features], attr=None, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = constraint(x, *([None] * (x.ndim - 1) + ["mp"]))
+        y = F.linear(x, self.weight, None)
+        y = constraint(y, *([None] * y.ndim))  # forces the mp reduce
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on the vocab dim over ``mp``."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.world_size = mesh_axis_size("mp")
+        if num_embeddings % max(self.world_size, 1) != 0:
+            raise ValueError(
+                f"num_embeddings={num_embeddings} not divisible by mp "
+                f"degree {self.world_size}")
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0))
+        annotate_param(self.weight, ("mp", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return constraint(out, *([None] * out.ndim))
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over class-dim-sharded logits
+    (``mp_ops._c_softmax_with_cross_entropy`` parity): GSPMD partitions
+    the log-softmax reduction over ``mp``."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        loss = F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+        from ...ops.manipulation import unsqueeze
+        return unsqueeze(loss, -1)

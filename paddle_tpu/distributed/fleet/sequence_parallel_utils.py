@@ -1,0 +1,103 @@
+"""Megatron-style sequence parallelism
+(``python/paddle/distributed/fleet/utils/sequence_parallel_utils.py``).
+
+Activations between TP blocks are sharded on the sequence dim over the
+``mp`` axis. The reference's ScatterOp/GatherOp autograd pairs become
+sharding constraints: GSPMD emits the reduce-scatter / all-gather pair
+(which is the bandwidth-optimal form of the identity/allreduce pair).
+Layout convention matches Paddle: [s, b, h] with seq first.
+"""
+from __future__ import annotations
+
+from ...framework.core import Tensor
+from ...nn import functional as F
+from ...nn.initializer import XavierNormal
+from ...nn.layer.layers import Layer
+from ..shard_utils import annotate_param, constraint, mesh_axis_size
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+class ScatterOp:
+    """Split activations along seq dim across mp (static: a constraint)."""
+
+    @staticmethod
+    def apply(x):
+        return constraint(x, "mp", *([None] * (x.ndim - 1)))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x):
+        return constraint(x, *([None] * x.ndim))
+
+
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def scatter(x):
+    return ScatterOp.apply(x)
+
+
+def all_gather(x):
+    return GatherOp.apply(x)
+
+
+class ColumnSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, (None, "mp"))
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([out_features], attr=None,
+                                              is_bias=True)
+            annotate_param(self.bias, ("mp",))
+
+    def forward(self, x):
+        # input seq-sharded [s/mp, b, h] -> gather seq, shard hidden
+        x = GatherOp.apply(x)
+        y = F.linear(x, self.weight, self.bias)
+        return constraint(y, *([None] * (y.ndim - 1) + ["mp"]))
+
+
+class RowSequenceParallelLinear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierNormal())
+        annotate_param(self.weight, ("mp", None))
+        self.bias = self.create_parameter([out_features], attr=None,
+                                          is_bias=True) if has_bias \
+            else None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, None)
+        # output reduce-scattered onto seq dim
+        y = ScatterOp.apply(y)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """SP-parameter grad all-reduce is emitted by GSPMD in the jitted
+    step; the hook registration is kept for source compatibility."""
+    return model

@@ -1,0 +1,211 @@
+"""Hybrid-parallel topology (``python/paddle/distributed/fleet/base/
+topology.py`` parity).
+
+The reference builds cartesian NCCL process groups in axis order
+(pp, dp, sharding, sep, mp). Here the same degrees define a
+``jax.sharding.Mesh`` with those named axes — each "communication group"
+is a mesh axis, and XLA emits the collectives over ICI (SURVEY.md §5.8).
+
+On a single-controller jax runtime every process sees all devices, so the
+"rank in group" notions are derived from the mesh coordinates of the
+process's first local device — they exist for API parity and for
+device-count bookkeeping in schedules.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from ..collective import Group
+from .. import env as _env
+
+_AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names
+                                    or ["pipe", "data", "sharding", "sep",
+                                        "model"])
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._world_size = int(np.prod(self._dims))
+        self._coords = np.arange(self._world_size).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs[name] for name in self._parallel_names]
+        return int(self._coords[tuple(coord)])
+
+    def get_coord(self, rank):
+        idx = np.argwhere(self._coords == rank)[0]
+        import collections
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*[int(i) for i in idx])
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        sl = [slice(None)] * len(self._dims)
+        sl[axis] = index
+        return sorted(int(r) for r in self._coords[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._coords, axis, -1)
+        return [list(map(int, row))
+                for row in moved.reshape(-1, self._dims[axis])]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology = None, strategy=None):
+        if topology is None and strategy is not None:
+            cfg = strategy.hybrid_configs
+            dims = [cfg.get("pp_degree", 1), cfg.get("dp_degree", 1),
+                    cfg.get("sharding_degree", 1),
+                    cfg.get("sep_degree", 1), cfg.get("mp_degree", 1)]
+            topology = CommunicateTopology(
+                ["pipe", "data", "sharding", "sep", "model"], dims)
+        self._topo = topology
+        dims = self._topo._dims
+        self._dp_degree = self._topo.get_dim("data")
+        self._mp_degree = self._topo.get_dim("model")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep")
+
+        n_needed = self._topo.world_size()
+        devices = jax.devices()
+        if n_needed > len(devices):
+            raise ValueError(
+                f"hybrid topology needs {n_needed} devices, "
+                f"{len(devices)} available")
+        mesh_devices = np.array(devices[:n_needed]).reshape(dims)
+        self._mesh = jax.sharding.Mesh(mesh_devices, _AXIS_ORDER)
+        _env.set_mesh(self._mesh)
+
+        self.global_rank = _env.get_rank()
+        coord = self._topo.get_coord(min(self.global_rank, n_needed - 1))
+        self._dp_rank = coord.data
+        self._mp_rank = coord.model
+        self._pp_rank = coord.pipe
+        self._sharding_rank = coord.sharding
+        self._sep_rank = coord.sep
+
+        self._dp_group = Group(
+            self._topo.get_axis_list("data", 0), axis_name="dp")
+        self._mp_group = Group(
+            self._topo.get_axis_list("model", 0), axis_name="mp")
+        self._pp_group = Group(
+            self._topo.get_axis_list("pipe", 0), axis_name="pp")
+        self._sharding_group = Group(
+            self._topo.get_axis_list("sharding", 0), axis_name="sharding")
+        self._sep_group = Group(
+            self._topo.get_axis_list("sep", 0), axis_name="sep")
+
+    # mesh access (TPU-native extension point)
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        return self._mesh
+
+    def topology(self):
+        return self._topo
+
+    # paddle API parity -------------------------------------------------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding_parallel"
+        if self._mp_degree > 1:
+            return "tensor_parallel"
+        return "data_parallel"
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_rank(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sep_parallel_rank(self):
+        return self._sep_rank
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_p2p_groups(self):
+        return None
+
+    def is_first_stage(self):
+        return self._pp_rank == 0
+
+    def is_last_stage(self):
+        return self._pp_rank == self._pp_degree - 1
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hcg(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hcg() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,68 @@
+"""``python -m paddle_tpu.distributed.launch`` (``python/paddle/
+distributed/launch/`` parity).
+
+The reference spawns one process per GPU with PADDLE_TRAINER_* env and an
+HTTP/etcd master. Single-controller jax on TPU usually wants ONE process
+per host seeing all local chips, so the default is nprocs=1 with the env
+set for rank bookkeeping; ``--nproc_per_node`` > 1 spawns the reference's
+multi-process layout for emulation/tests (each proc gets the same device
+view; collectives still run via the mesh).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def parse_args(argv):
+    import argparse
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--devices", "--gpus", "--xpus", default=None,
+                   dest="devices")
+    p.add_argument("--nnodes", default="1")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--master", default=None)
+    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs="...")
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    nprocs = args.nproc_per_node or 1
+    os.makedirs(args.log_dir, exist_ok=True)
+    endpoints = ",".join(f"127.0.0.1:{6170 + i}" for i in range(nprocs))
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
+            "PADDLE_MASTER": args.master or "127.0.0.1:6170",
+            "FLAGS_selected_gpus": str(rank),
+        })
+        log = open(os.path.join(args.log_dir,
+                                f"workerlog.{rank}"), "w")
+        cmd = [sys.executable, args.training_script] + \
+            list(args.training_script_args)
+        procs.append((subprocess.Popen(
+            cmd, env=env,
+            stdout=log if rank != 0 else None,
+            stderr=subprocess.STDOUT if rank != 0 else None), log))
+    code = 0
+    for p, log in procs:
+        rc = p.wait()
+        log.close()
+        code = code or rc
+    if code:
+        raise SystemExit(code)
+
+
+def main():
+    launch()

@@ -1,0 +1,204 @@
+"""Mixture-of-Experts with expert parallelism
+(``python/paddle/incubate/distributed/models/moe/moe_layer.py`` +
+``gate/*.py`` parity).
+
+TPU-first (SURVEY.md §7.4): GShard-style static-capacity dispatch. Expert
+weights are stacked with a leading expert dim sharded over the expert
+axis; dispatch/combine are einsums against one-hot capacity masks, so the
+all-to-all the reference codes against ProcessGroup appears as GSPMD
+collectives when the expert dim is mesh-sharded. Static shapes throughout
+(capacity padding), as jit requires.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn import functional as F
+from ..nn.layer.layers import Layer
+from .shard_utils import annotate_param, constraint, mesh_axis_size
+
+__all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
+           "moe_dispatch_combine"]
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_expert):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.loss = None
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(d_model, num_expert)
+        from ..nn.layer.common import Linear
+        self.gate = Linear(d_model, num_expert)
+        self.top_k = topk
+
+    def forward(self, x):
+        return self.gate(x)
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), group=None, gate_bias=True):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity_factor = capacity[0]
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.capacity_factor = capacity[0]
+
+
+def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
+                         capacity_factor=1.25, expert_fn=None,
+                         expert_axis=None):
+    """Pure-array GShard dispatch → expert_fn → combine.
+
+    x: [tokens, d]; gate_logits: [tokens, e]. expert_fn(inputs[e, c, d])
+    -> [e, c, d]. Returns (y [tokens, d], aux_loss scalar).
+    """
+    s, d = x.shape
+    e = num_expert
+    c = max(int(math.ceil(capacity_factor * s * top_k / e)), 1)
+
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    # top-k selection
+    topk_prob, topk_idx = jax.lax.top_k(probs, top_k)  # [s, k]
+
+    # position of each (token, k) within its expert's queue
+    onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [s, k, e]
+    flat = onehot.reshape(s * top_k, e)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(
+        s, top_k, e)  # [s, k, e]
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [s, k]
+    keep = pos < c
+
+    # load-balancing aux loss (GShard eq.: e * sum(me * ce))
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(onehot[:, 0].astype(jnp.float32), axis=0)
+    aux = e * jnp.sum(me * ce)
+
+    gates = topk_prob / jnp.maximum(
+        jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    gates = jnp.where(keep, gates, 0.0).astype(x.dtype)
+
+    # dispatch mask [s, k, e, c]
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, c), c + 1,
+                            dtype=x.dtype)[..., :c]
+    disp = onehot.astype(x.dtype)[..., None] * pos_oh[:, :, None, :]
+    disp = jnp.sum(disp, axis=1)               # [s, e, c]
+    comb = jnp.einsum("sk,ske,skc->sec", gates,
+                      onehot.astype(x.dtype), pos_oh)
+
+    expert_in = jnp.einsum("sec,sd->ecd", disp, x)
+    if expert_axis is not None:
+        expert_in = _ep_constraint(expert_in, expert_axis)
+    expert_out = expert_fn(expert_in)          # [e, c, d_out]
+    if expert_axis is not None:
+        expert_out = _ep_constraint(expert_out, expert_axis)
+    y = jnp.einsum("sec,ecd->sd", comb, expert_out)
+    return y, aux
+
+
+def _ep_constraint(arr, axis):
+    from . import env as _env
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = _env.get_mesh()
+    if mesh is None or mesh.shape.get(axis, 1) <= 1:
+        return arr
+    spec = P(*([axis] + [None] * (arr.ndim - 1)))
+    try:
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
+    except Exception:
+        return arr
+
+
+class MoELayer(Layer):
+    """``MoELayer`` parity. experts: list of Layers (one per local
+    expert) with identical structure; their params are stacked into
+    [e, ...] arrays sharded over ``moe_axis``."""
+
+    def __init__(self, d_model, experts: List[Layer] = None, gate=None,
+                 moe_group=None, mp_group=None, recompute_interval=0,
+                 top_k=2, capacity_factor=1.25, moe_axis="dp", **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        from ..nn.layer.container import LayerList
+        self.experts = LayerList(experts or [])
+        self.num_expert = len(self.experts)
+        if gate is None or isinstance(gate, dict):
+            cfg = gate or {}
+            gtype = cfg.get("type", "gshard")
+            topk = cfg.get("top_k", top_k)
+            cls = {"naive": NaiveGate, "gshard": GShardGate,
+                   "switch": SwitchGate}[gtype]
+            gate = cls(d_model, self.num_expert, topk=topk)
+        self.gate = gate
+        self.top_k = getattr(gate, "top_k", top_k)
+        self.capacity_factor = capacity_factor
+        self.moe_axis = moe_axis
+        # stacked expert params: [e, ...] (template = expert 0)
+        self._template = self.experts[0] if self.num_expert else None
+
+    def _flat_params(self):
+        """All expert params expert-major, as the live Tensor objects (so
+        the tape records grads against each expert's own parameters)."""
+        items = [list(exp.named_parameters()) for exp in self.experts]
+        n_per = len(items[0])
+        flat = [p for exp_items in items for _, p in exp_items]
+        return n_per, flat
+
+    def forward(self, x):
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        from ..ops.manipulation import reshape
+        x2 = reshape(x, [-1, d])
+        logits = self.gate(x2)
+        n_per, flat_params = self._flat_params()
+        e = self.num_expert
+        template = self._template
+        param_objs = [p for _, p in template.named_parameters()]
+
+        def f(x_arr, logit_arr, *flat):
+            # restack [e, ...] per param position from the flat operands
+            stk = [jnp.stack([flat[i * n_per + j] for i in range(e)],
+                             axis=0) for j in range(n_per)]
+
+            def efn(expert_in):
+                def one(args):
+                    params_i, xi = args
+                    saved = [p._data for p in param_objs]
+                    try:
+                        for p, arr in zip(param_objs, params_i):
+                            p._data = arr
+                        from ..framework.core import no_grad, \
+                            functional_mode
+                        with functional_mode(), no_grad():
+                            out = template(Tensor(xi))
+                        return as_jax(out)
+                    finally:
+                        for p, arr in zip(param_objs, saved):
+                            p._data = arr
+                return jax.lax.map(one, (tuple(stk), expert_in))
+            y, aux = moe_dispatch_combine(
+                x_arr, logit_arr, self.num_expert, self.top_k,
+                self.capacity_factor, efn, self.moe_axis)
+            return y, aux
+
+        y, aux = apply_jax("moe", f, x2, logits, *flat_params,
+                           n_outputs=2)
+        self.gate.loss = aux
+        self._aux_loss = aux
+        return reshape(y, list(orig_shape))

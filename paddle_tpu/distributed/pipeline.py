@@ -1,0 +1,96 @@
+"""Pipeline parallelism on TPU: GPipe/1F1B as shard_map + collective_permute.
+
+Reference parity: ``python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py`` (PipelineParallel.train_batch, FThenB/1F1B
+schedules) + ``pp_utils/p2p_communication.py`` (batched NCCL send/recv).
+
+TPU-first design (SURVEY.md §5.8, §7.4): there is no NCCL p2p — stage
+activations ride ``jax.lax.ppermute`` over the ``pp`` mesh axis inside a
+``shard_map``; the fill-drain schedule is a ``lax.scan`` over ticks, so
+XLA sees one static program and overlaps the permute with stage compute.
+All stages execute the same homogeneous stage function with their own
+weight shard (stacked params, leading dim sharded over ``pp``), which is
+how GSPMD-style pipelining wants it. Backward is just ``jax.grad``
+through the scan — ppermute transposes to the reverse permute, giving the
+backward pipeline for free (no hand-written 1F1B bookkeeping).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from . import env as _env
+
+__all__ = ["pipeline_apply", "stack_stage_params", "PipelineStageFn"]
+
+PipelineStageFn = Callable[[Any, jnp.ndarray], jnp.ndarray]
+
+
+def stack_stage_params(per_stage_params: List[Any]):
+    """[stage0_tree, stage1_tree, ...] → one tree with leading pp dim."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
+                   microbatches, mesh: Mesh = None, axis: str = "pp",
+                   extra_inputs=None):
+    """Run the pipelined forward.
+
+    stage_fn(params_local, x, *extra) -> y  — one stage's compute; must
+        be shape-preserving on x (homogeneous stages).
+    stacked_params: pytree, leaves [pp, ...] (will be sharded over axis).
+    microbatches: [n_micro, mb, ...] array; fed to stage 0 in order.
+    Returns [n_micro, mb, ...] outputs (valid on every device — the last
+    stage's results are broadcast over the pp axis).
+    """
+    mesh = mesh or _env.get_mesh()
+    pp = mesh.shape[axis]
+    n_micro = microbatches.shape[0]
+    n_ticks = n_micro + pp - 1
+    extra = extra_inputs if extra_inputs is not None else ()
+
+    in_spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+    other_axes = tuple(a for a in mesh.axis_names if a != axis)
+
+    def per_device(params_block, mbs, *extra_args):
+        # params_block leaves: [1, ...] (this stage's slice)
+        params_local = jax.tree_util.tree_map(
+            lambda x: x[0], params_block)
+        stage_idx = jax.lax.axis_index(axis)
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+
+        mb_shape = mbs.shape[1:]
+        y0 = jnp.zeros(mb_shape, mbs.dtype)
+
+        def tick(carry, t):
+            recv = carry
+            feed = jnp.where(t < n_micro, t, 0)
+            x_in = jnp.where(stage_idx == 0, mbs[feed], recv)
+            y = stage_fn(params_local, x_in, *extra_args)
+            send = jax.lax.ppermute(y, axis, perm_fwd)
+            # output from the last stage this tick (microbatch t-pp+1)
+            out = jnp.where(stage_idx == pp - 1, y,
+                            jnp.zeros_like(y))
+            return send, out
+
+        _, outs = jax.lax.scan(tick, y0, jnp.arange(n_ticks))
+        # outs: [n_ticks, mb...]; last stage's valid range is
+        # ticks [pp-1, pp-1+n_micro). psum over pp broadcasts them
+        # (all other stages contributed zeros).
+        valid = jax.lax.dynamic_slice_in_dim(outs, pp - 1, n_micro, axis=0)
+        return jax.lax.psum(valid, axis)
+
+    from .shard_utils import shard_map_compat
+    mapped = shard_map_compat(
+        per_device, mesh,
+        (in_spec_params, P(*([None] * microbatches.ndim)),
+         *[P(*([None] * jnp.ndim(e))) for e in extra]),
+        P(*([None] * microbatches.ndim)))
+    return mapped(stacked_params, microbatches, *extra)

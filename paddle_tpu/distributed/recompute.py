@@ -1,0 +1,87 @@
+"""Activation recompute (``python/paddle/distributed/fleet/recompute/
+recompute.py`` parity).
+
+The reference replays forward under saved RNG state inside a PyLayer.
+TPU-first: ``jax.checkpoint`` (remat) — XLA rematerializes activations in
+backward, trading FLOPs for HBM exactly as the reference does, but
+compiler-scheduled. Works in both the eager tape (via jax.vjp over the
+remat-wrapped function) and the jitted step.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import Tensor, apply_jax, as_jax
+
+__all__ = ["recompute", "recompute_sequential", "RecomputeFunction"]
+
+
+def recompute(function, *args, **kwargs):
+    """``paddle.distributed.fleet.utils.recompute`` parity.
+
+    When ``function`` is a Layer, its parameters are passed as explicit
+    VJP inputs (bound by array-swap during the remat call) so the tape
+    records their gradients — closed-over params would be invisible."""
+    kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+
+    tensor_args = []
+    spec = []
+    for a in args:
+        if isinstance(a, Tensor):
+            spec.append(len(tensor_args))
+            tensor_args.append(a)
+        else:
+            spec.append(a)
+    n_act = len(tensor_args)
+
+    params = []
+    if hasattr(function, "parameters"):
+        params = [p for p in function.parameters()
+                  if not p.stop_gradient]
+
+    @jax.checkpoint
+    def inner(*arrays):
+        rebuilt = []
+        for s in spec:
+            if isinstance(s, int):
+                rebuilt.append(Tensor(arrays[s]))
+            else:
+                rebuilt.append(s)
+        saved = [p._data for p in params]
+        try:
+            for p, arr in zip(params, arrays[n_act:]):
+                p._data = arr
+            from ..framework.core import no_grad
+            with no_grad():
+                out = function(*rebuilt, **kwargs)
+        finally:
+            for p, arr in zip(params, saved):
+                p._data = arr
+        if isinstance(out, (tuple, list)):
+            return tuple(as_jax(o) for o in out)
+        return as_jax(out)
+
+    return apply_jax("recompute", inner, *tensor_args, *params)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """``recompute_sequential`` parity: chunk a Sequential and remat each
+    segment."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    chunk = max(len(layers) // max(segments, 1), 1)
+    x = args[0]
+    for i in range(0, len(layers), chunk):
+        seg = layers[i:i + chunk]
+
+        def run_seg(t, seg=seg):
+            out = t
+            for l in seg:
+                out = l(out)
+            return out
+        x = recompute(run_seg, x)
+    return x
+
+
+RecomputeFunction = recompute

@@ -1,0 +1,110 @@
+"""Ring (context-parallel) attention
+(PaddleNLP ``paddlenlp/transformers/ring_flash_attention.py`` parity —
+the reference lives out-of-tree; SURVEY.md §5.7 mechanism 3).
+
+TPU-first: sequence is sharded over the ``sep`` mesh axis; KV blocks ride
+a ``ppermute`` ring inside shard_map while each step folds a partial
+attention into online-softmax accumulators (m, l, o). Causality is
+handled per source-block: blocks strictly in the future are skipped via
+masking, the diagonal block gets the triangular mask. Backward is
+``jax.grad`` through the scan (ppermute transposes to the reverse ring).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..framework.core import Tensor, apply_jax, as_jax
+from . import env as _env
+
+__all__ = ["ring_flash_attention", "RingFlashAttention"]
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """One partial attention: returns (o_partial, m, l) for online
+    softmax. q: [B, Lq, H, D]; k/v: [B, Lk, H, D]."""
+    s = jnp.einsum("blhd,bmhd->bhlm", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -1e9)
+    m = jnp.max(s, axis=-1)                       # [B, H, Lq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                       # [B, H, Lq]
+    o = jnp.einsum("bhlm,bmhd->blhd", p, v)
+    return o, m, l
+
+
+def ring_flash_attention(q, k, v, mesh: Mesh = None, axis: str = "sep",
+                         causal: bool = False, scale=None):
+    """q/k/v: [B, L, H, D] with L globally sharded over ``axis``.
+    Returns [B, L, H, D] with the same sharding."""
+    mesh = mesh or _env.get_mesh()
+    q_arr, k_arr, v_arr = as_jax(q), as_jax(k), as_jax(v)
+    if scale is None:
+        scale = 1.0 / np.sqrt(q_arr.shape[-1])
+    scale = float(scale)  # keep weak-typed under x64
+    sp = mesh.shape[axis] if mesh is not None else 1
+    if mesh is None or sp <= 1:
+        out = jax.nn.dot_product_attention(q_arr, k_arr, v_arr,
+                                           is_causal=causal, scale=scale)
+        return Tensor(out) if isinstance(q, Tensor) else out
+
+    def per_device(ql, kl, vl):
+        my = jax.lax.axis_index(axis)
+        L = ql.shape[1]
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        rows = jnp.arange(L)[:, None]
+        cols = jnp.arange(L)[None, :]
+
+        def step(carry, t):
+            kt, vt, o_acc, m_acc, l_acc = carry
+            src = (my - t) % sp  # which global block this kv is
+            if causal:
+                tri = rows >= cols
+                mask = jnp.where(src == my, tri,
+                                 jnp.broadcast_to(src < my, tri.shape))
+                mask = mask[None, None]
+            else:
+                mask = None
+            o_p, m_p, l_p = _block_attn(ql, kt, vt, scale, mask)
+            m_new = jnp.maximum(m_acc, m_p)
+            alpha = jnp.exp(m_acc - m_new)
+            beta = jnp.exp(m_p - m_new)
+            l_new = l_acc * alpha + l_p * beta
+            o_new = (o_acc * alpha.transpose(0, 2, 1)[..., None]
+                     + o_p * beta.transpose(0, 2, 1)[..., None])
+            kn = jax.lax.ppermute(kt, axis, perm)
+            vn = jax.lax.ppermute(vt, axis, perm)
+            return (kn, vn, o_new, m_new, l_new), None
+
+        B, L_, H, D = ql.shape
+        o0 = jnp.zeros_like(ql)
+        m0 = jnp.full((B, H, L_), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((B, H, L_), jnp.float32)
+        (k_f, v_f, o, m, l), _ = jax.lax.scan(
+            step, (kl, vl, o0, m0.astype(ql.dtype),
+                   l0.astype(ql.dtype)), jnp.arange(sp))
+        return o / jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+
+    from .shard_utils import shard_map_compat
+    spec = P(None, axis, None, None)
+    mapped = shard_map_compat(per_device, mesh, (spec, spec, spec), spec)
+
+    def f(qa, ka, va):
+        return mapped(qa, ka, va)
+
+    if isinstance(q, Tensor):
+        return apply_jax("ring_flash_attention", f, q, k, v)
+    return mapped(q_arr, k_arr, v_arr)
+
+
+class RingFlashAttention:
+    """Class facade matching PaddleNLP's RingFlashAttention.apply."""
+
+    @staticmethod
+    def apply(q, k, v, group=None, causal=False, **kw):
+        axis = getattr(group, "axis_name", None) or "sep"
+        return ring_flash_attention(q, k, v, axis=axis, causal=causal)

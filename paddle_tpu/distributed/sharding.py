@@ -1,0 +1,92 @@
+"""ZeRO-style sharded data parallel (``python/paddle/distributed/fleet/
+meta_parallel/sharding/`` + ``group_sharded_parallel`` parity).
+
+TPU mapping (SURVEY.md §7.2): the ``sharding`` mesh axis is an fsdp axis.
+  - stage 1/2 (optimizer-state / +grad shard): parameters stay replicated,
+    optimizer accumulators are sharded over the axis (XLA keeps the
+    reduce-scatter + gathered update local to each shard).
+  - stage 3 (parameter shard): parameters themselves are annotated
+    ``P("sharding", ...)`` on dim 0; GSPMD all-gathers just-in-time for
+    each layer's compute — the pre-fetch/release hook machinery of
+    GroupShardedStage3 is the compiler's job here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor
+from . import env as _env
+from .shard_utils import annotate_param, mesh_axis_size
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "ShardingOptimizerStage2", "shard_optimizer_states"]
+
+
+def _shardable_dim0(param, degree):
+    return param.shape and param.shape[0] % degree == 0
+
+
+def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """``paddle.distributed.sharding.group_sharded_parallel`` parity.
+    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
+    degree = mesh_axis_size("sharding")
+    if degree <= 1:
+        return model, optimizer, scaler
+    if level == "p_g_os":
+        for p in model.parameters():
+            if _shardable_dim0(p, degree) and getattr(
+                    p, "dist_spec", None) is None:
+                spec = ["sharding"] + [None] * (len(p.shape) - 1)
+                annotate_param(p, spec)
+    shard_optimizer_states(optimizer, degree)
+    return model, optimizer, scaler
+
+
+def shard_optimizer_states(optimizer, degree=None):
+    """Make optimizer accumulators shard over the ``sharding`` axis
+    (stage-1 semantics). Works with both eager step() and TrainStep."""
+    degree = degree or mesh_axis_size("sharding")
+    mesh = _env.get_mesh()
+    if mesh is None or degree <= 1:
+        return optimizer
+    orig_create = optimizer._create_accumulator
+
+    def sharded_create(name, param, fill=0.0, dtype=None):
+        acc = orig_create(name, param, fill, dtype)
+        if hasattr(acc, "shape") and acc.shape and \
+                acc.shape[0] % degree == 0:
+            spec = P(*(["sharding"] + [None] * (acc.ndim - 1)))
+            try:
+                acc = jax.device_put(acc, NamedSharding(mesh, spec))
+                optimizer._accumulators[name][id(param)] = acc
+            except Exception:
+                pass
+        return acc
+
+    optimizer._create_accumulator = sharded_create
+    return optimizer
+
+
+class ShardingOptimizerStage2:
+    """GroupShardedOptimizerStage2 facade."""
+
+    def __init__(self, params, optim, group=None, offload=False, **kw):
+        self._optim = shard_optimizer_states(optim)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_optim"], name)
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ..framework.io import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

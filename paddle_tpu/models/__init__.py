@@ -1,0 +1,7 @@
+"""Model zoo (PaddleNLP-parity transformer families + vision models via
+``paddle_tpu.vision.models``)."""
+from . import bert, gpt, llama
+from .bert import BertConfig, BertForSequenceClassification, BertModel
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    LlamaPretrainingCriterion)

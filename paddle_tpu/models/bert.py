@@ -1,0 +1,149 @@
+"""BERT (PaddleNLP ``paddlenlp/transformers/bert/modeling.py`` parity) —
+BASELINE config 3 (SST-2 finetune): encoder + pooler + classifier head."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import (TransformerEncoder,
+                                    TransformerEncoderLayer)
+from ..distributed.shard_utils import batch_shard
+
+__all__ = ["BertConfig", "BertModel", "BertForSequenceClassification",
+           "BertForPretraining"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    num_labels: int = 2
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=128, layers=2, heads=4):
+        return BertConfig(vocab_size=vocab, hidden_size=hidden,
+                          num_hidden_layers=layers,
+                          num_attention_heads=heads,
+                          intermediate_size=hidden * 4)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        l = input_ids.shape[1]
+        from ..ops.creation import arange, zeros_like
+        if position_ids is None:
+            position_ids = arange(l, dtype="int64")
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden_states):
+        first = hidden_states[:, 0]
+        from ..ops.math import tanh
+        return tanh(self.dense(first))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        input_ids = batch_shard(input_ids)
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        encoded = self.encoder(emb, attention_mask)
+        return encoded, self.pooler(encoded)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig = None, num_classes=None):
+        super().__init__()
+        config = config or BertConfig()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size,
+                                 num_classes or config.num_labels)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class BertForPretraining(Layer):
+    def __init__(self, config: BertConfig = None):
+        super().__init__()
+        config = config or BertConfig()
+        self.bert = BertModel(config)
+        self.mlm_head = Linear(config.hidden_size, config.vocab_size)
+        self.nsp_head = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, labels=None,
+                next_sentence_label=None):
+        encoded, pooled = self.bert(input_ids, token_type_ids)
+        mlm_logits = self.mlm_head(encoded)
+        nsp_logits = self.nsp_head(pooled)
+        if labels is not None:
+            loss = F.cross_entropy(mlm_logits, labels, ignore_index=-100)
+            if next_sentence_label is not None:
+                loss = loss + F.cross_entropy(nsp_logits,
+                                              next_sentence_label)
+            return loss
+        return mlm_logits, nsp_logits

@@ -1,0 +1,151 @@
+"""GPT model family (PaddleNLP ``paddlenlp/transformers/gpt/modeling.py``
+parity) with TP annotations."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding
+from ..nn.layer.container import LayerList
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..distributed.shard_utils import batch_shard, constraint
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=128, layers=2, heads=4):
+        return GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                         num_hidden_layers=layers, num_attention_heads=heads,
+                         intermediate_size=hidden * 4,
+                         max_position_embeddings=512)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size,
+            input_is_parallel=True)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x):
+        b, l, d = x.shape
+        qkv = self.qkv_proj(x)
+
+        def attn(a):
+            q, k, v = jnp.split(a, 3, axis=-1)
+            qh = q.reshape(b, l, self.num_heads, self.head_dim)
+            kh = k.reshape(b, l, self.num_heads, self.head_dim)
+            vh = v.reshape(b, l, self.num_heads, self.head_dim)
+            from ..ops.pallas.flash_attention import flash_attention_core
+            out = flash_attention_core(qh, kh, vh, is_causal=True)
+            return out.reshape(b, l, d)
+        ctx = apply_jax("gpt_attention", attn, qkv)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.out_proj(ctx)
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              config.layer_norm_epsilon)
+        self.linear1 = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            gather_output=False)
+        self.linear2 = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            input_is_parallel=True)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        h = self.linear2(F.gelu(self.linear1(self.ln_2(x)),
+                                approximate=True))
+        return x + self.dropout(h)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = VocabParallelEmbedding(config.vocab_size,
+                                                 config.hidden_size)
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTDecoderLayer(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        input_ids = batch_shard(input_ids)
+        l = input_ids.shape[1]
+        if position_ids is None:
+            from ..ops.creation import arange
+            position_ids = arange(l, dtype="int64")
+        h = self.embeddings(input_ids) + \
+            self.position_embeddings(position_ids)
+        h = self.dropout(h)
+        for layer in self.h:
+            h = layer(h)
+        return self.ln_f(h)
+
+
+class GPTPretrainingCriterion(Layer):
+    def forward(self, logits, labels):
+        def f(lg, lb):
+            lg = lg[:, :-1, :]
+            lb = lb[:, 1:].astype(jnp.int32)
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(logp, lb[..., None],
+                                         axis=-1)[..., 0]
+            return -jnp.mean(picked)
+        return apply_jax("gpt_ce", f, logits, labels)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(config)
+        self.criterion = GPTPretrainingCriterion()
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        from ..ops.linalg import matmul
+        logits = matmul(h, self.gpt.embeddings.weight, transpose_y=True)
+        if labels is not None:
+            return self.criterion(logits, labels)
+        return logits

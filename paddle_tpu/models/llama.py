@@ -1,0 +1,274 @@
+"""Llama model family (PaddleNLP ``paddlenlp/transformers/llama/
+modeling.py`` parity) — BASELINE config 4 flagship.
+
+TPU-first 4D parallel layout:
+  - TP: q/k/v/gate/up projections are ColumnParallel, o/down are
+    RowParallel, embeddings VocabParallel — all via PartitionSpec
+    annotations on the ``mp`` mesh axis (GSPMD inserts the collectives).
+  - SP (Megatron): activation constraints on the seq dim when
+    ``sequence_parallel=True``.
+  - CP (ring attention): when the ``sep`` axis is >1, attention runs the
+    ppermute ring (``distributed/ring_attention.py``).
+  - DP/sharding: batch dim constraint + fsdp param specs (stage 3).
+  - PP: homogeneous decoder layers — pipelined via
+    ``distributed/pipeline.py`` through ``LlamaForCausalLMPipe``.
+  - remat: per-decoder-layer jax.checkpoint when config.recompute.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..distributed.shard_utils import batch_shard, constraint, \
+    mesh_axis_size
+from ..incubate.nn.functional import (fused_rotary_position_embedding,
+                                      swiglu)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaPretrainingCriterion"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    sequence_parallel: bool = False
+    recompute: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            rope_theta=500000.0)
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=256, layers=2, heads=8, kv_heads=4,
+             ffn=512):
+        return LlamaConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=ffn,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, max_position_embeddings=2048)
+
+
+def _rope_tables(seq_len, head_dim, theta):
+    pos = np.arange(seq_len, dtype=np.float32)
+    inv = theta ** (-np.arange(0, head_dim, 2,
+                               dtype=np.float32) / head_dim)
+    freqs = np.outer(pos, inv)
+    return np.cos(freqs), np.sin(freqs)
+
+
+def _apply_rope(x, cos, sin):
+    # x: [B, L, H, D]; neox style halves. Tables stay fp32 for precision;
+    # output is cast back so bf16 activations remain bf16.
+    d = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    x1 = xf[..., : d // 2]
+    x2 = xf[..., d // 2:]
+    c = cos[None, :, None, :].astype(jnp.float32)
+    s = sin[None, :, None, :].astype(jnp.float32)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        init = Normal(0.0, config.initializer_range)
+        self.q_proj = ColumnParallelLinear(
+            self.hidden_size, self.num_heads * self.head_dim,
+            weight_attr=None, has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(
+            self.hidden_size, self.num_kv_heads * self.head_dim,
+            has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(
+            self.hidden_size, self.num_kv_heads * self.head_dim,
+            has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(
+            self.num_heads * self.head_dim, self.hidden_size,
+            has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden_states, rope_cos, rope_sin,
+                attention_mask=None):
+        b, l, _ = hidden_states.shape
+        q = self.q_proj(hidden_states)
+        k = self.k_proj(hidden_states)
+        v = self.v_proj(hidden_states)
+
+        def attn(q_a, k_a, v_a, cos, sin):
+            qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
+            kh = k_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            qh = _apply_rope(qh, cos, sin)
+            kh = _apply_rope(kh, cos, sin)
+            if mesh_axis_size("sep") > 1:
+                from ..distributed.ring_attention import \
+                    ring_flash_attention
+                rep = self.num_heads // self.num_kv_heads
+                kh = jnp.repeat(kh, rep, axis=2)
+                vh = jnp.repeat(vh, rep, axis=2)
+                out = ring_flash_attention(qh, kh, vh, causal=True)
+            else:
+                from ..ops.pallas.flash_attention import \
+                    flash_attention_core
+                rep = self.num_heads // self.num_kv_heads
+                if rep > 1:
+                    kh = jnp.repeat(kh, rep, axis=2)
+                    vh = jnp.repeat(vh, rep, axis=2)
+                out = flash_attention_core(qh, kh, vh, is_causal=True)
+            return out.reshape(b, l, self.num_heads * self.head_dim)
+
+        ctx = apply_jax("llama_attention", attn, q, k, v, rope_cos,
+                        rope_sin)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size, has_bias=False,
+            gather_output=False)
+        self.down_proj = RowParallelLinear(
+            config.intermediate_size, config.hidden_size, has_bias=False,
+            input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden_states, rope_cos, rope_sin,
+                attention_mask=None):
+        residual = hidden_states
+        h = self.input_layernorm(hidden_states)
+        h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
+        h = residual + h
+        residual = h
+        h2 = self.post_attention_layernorm(h)
+        h2 = self.mlp(h2)
+        return residual + h2
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        from ..nn.layer.container import LayerList
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(config.max_position_embeddings, head_dim,
+                                config.rope_theta)
+        self._rope_cos = Tensor(cos)
+        self._rope_sin = Tensor(sin)
+
+    def forward(self, input_ids, attention_mask=None, position_ids=None):
+        input_ids = batch_shard(input_ids)
+        h = self.embed_tokens(input_ids)
+        l = h.shape[1]
+        cos = _wrap_out(as_jax(self._rope_cos)[:l])
+        sin = _wrap_out(as_jax(self._rope_sin)[:l])
+        from ..distributed.recompute import recompute
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h = recompute(layer, h, cos, sin, attention_mask)
+            else:
+                h = layer(h, cos, sin, attention_mask)
+        return self.norm(h)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Shift-labels cross entropy (PaddleNLP criterion parity)."""
+
+    def __init__(self, config: LlamaConfig = None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        def f(lg, lb):
+            lg = lg[:, :-1, :]
+            lb = lb[:, 1:]
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            lb_i = lb.astype(jnp.int32)
+            picked = jnp.take_along_axis(
+                logp, jnp.clip(lb_i, 0)[..., None], axis=-1)[..., 0]
+            valid = lb_i != self.ignore_index
+            loss = -jnp.where(valid, picked, 0.0)
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return apply_jax("llama_ce", f, logits, labels)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        self.criterion = LlamaPretrainingCriterion(config)
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                position_ids=None):
+        h = self.llama(input_ids, attention_mask, position_ids)
+        if self.config.tie_word_embeddings:
+            from ..ops.linalg import matmul
+            logits = matmul(h, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is not None:
+            return self.criterion(logits, labels)
+        return logits
