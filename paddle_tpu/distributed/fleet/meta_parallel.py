@@ -153,19 +153,22 @@ class PipelineParallel(Layer):
         n_micro = self.accumulate_steps
         bsz = inputs.shape[0]
         mb = max(bsz // n_micro, 1)
-        total = None
+        total = 0.0
         loss_fn = getattr(self._layers, "_loss_fn", None)
         for i in range(0, bsz, mb):
             x = inputs[i:i + mb]
             y = labels[i:i + mb]
+            # weight by the actual slice size so a ragged tail microbatch
+            # contributes proportionally, not double
+            w = x.shape[0] / bsz
             out = self._layers(x)
             loss = loss_fn(out, y) if loss_fn is not None else out
-            scaled = loss * (mb / bsz)
+            scaled = loss * w
             if scaler is not None:
                 scaler.scale(scaled).backward()
             else:
                 scaled.backward()
-            total = float(loss) if total is None else total + float(loss)
+            total += float(loss) * w
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -173,7 +176,7 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return _wrap_out(jnp.asarray(total / max(n_micro, 1)))
+        return _wrap_out(jnp.asarray(total))
 
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data

@@ -62,11 +62,13 @@ class SwitchGate(NaiveGate):
 
 def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
                          capacity_factor=1.25, expert_fn=None,
-                         expert_axis=None):
+                         expert_axis=None, normalize_gates=True):
     """Pure-array GShard dispatch → expert_fn → combine.
 
     x: [tokens, d]; gate_logits: [tokens, e]. expert_fn(inputs[e, c, d])
     -> [e, c, d]. Returns (y [tokens, d], aux_loss scalar).
+    ``normalize_gates=False`` combines with the raw softmax probs of the
+    selected experts (Qwen2-MoE/DeepSeek ``norm_topk_prob=False``).
     """
     s, d = x.shape
     e = num_expert
@@ -89,8 +91,11 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     ce = jnp.mean(onehot[:, 0].astype(jnp.float32), axis=0)
     aux = e * jnp.sum(me * ce)
 
-    gates = topk_prob / jnp.maximum(
-        jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    if normalize_gates:
+        gates = topk_prob / jnp.maximum(
+            jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    else:
+        gates = topk_prob
     gates = jnp.where(keep, gates, 0.0).astype(x.dtype)
 
     # dispatch mask [s, k, e, c]

@@ -1,0 +1,213 @@
+"""DeepSeek-MoE model family (PaddleNLP ``paddlenlp/transformers/
+deepseek_v2/modeling.py`` fine-grained-expert lineage) — BASELINE
+config 5 second entry.
+
+Architecture signatures vs Qwen2-MoE: the first ``first_k_dense_replace``
+layers use a dense MLP; sparse layers combine fine-grained routed experts
+(softmax-then-topk scoring, optionally normalized) with
+``n_shared_experts`` always-on shared experts added UNGATED to the routed
+output. Expert storage/dispatch reuses the stacked-expert einsum path
+(``qwen2_moe.StackedExpertsMLP`` + ``distributed/moe.py``) so expert
+parallelism is a mesh-axis sharding, not hand-coded all-to-alls.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           VocabParallelEmbedding)
+from ..distributed.moe import moe_dispatch_combine
+from ..distributed.shard_utils import batch_shard
+from .llama import (LlamaAttention, LlamaPretrainingCriterion,
+                    _rope_tables)
+from .qwen2_moe import StackedExpertsMLP, _DenseMLP
+
+__all__ = ["DeepseekMoeConfig", "DeepseekMoeModel",
+           "DeepseekMoeForCausalLM"]
+
+
+@dataclass
+class DeepseekMoeConfig:
+    vocab_size: int = 102400
+    hidden_size: int = 2048
+    intermediate_size: int = 10944          # dense-layer MLP width
+    moe_intermediate_size: int = 1408       # fine-grained expert width
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    n_routed_experts: int = 64
+    n_shared_experts: int = 2
+    num_experts_per_tok: int = 6
+    first_k_dense_replace: int = 1
+    moe_layer_freq: int = 1
+    norm_topk_prob: bool = False
+    router_aux_loss_coef: float = 0.001
+    capacity_factor: float = 1.25
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False                  # DeepSeek attention: no bias
+    recompute: bool = False
+    expert_axis: str = "dp"
+    dtype: str = "float32"
+
+    # attention config shim so Qwen2MoeAttention is reusable
+    @property
+    def num_experts(self):
+        return self.n_routed_experts
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=128, layers=3, heads=4, kv_heads=4,
+             moe_ffn=64, dense_ffn=192, experts=8, shared=2, topk=2):
+        return DeepseekMoeConfig(
+            vocab_size=vocab, hidden_size=hidden,
+            intermediate_size=dense_ffn, moe_intermediate_size=moe_ffn,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, n_routed_experts=experts,
+            n_shared_experts=shared, num_experts_per_tok=topk,
+            max_position_embeddings=512)
+
+
+class DeepseekMoeBlock(Layer):
+    """Routed fine-grained experts + ungated shared experts."""
+
+    def __init__(self, config: DeepseekMoeConfig):
+        super().__init__()
+        from ..nn.layer.common import Linear
+        self.config = config
+        self.gate = Linear(config.hidden_size, config.n_routed_experts,
+                           bias_attr=False)
+        self.experts = StackedExpertsMLP(
+            config.n_routed_experts, config.hidden_size,
+            config.moe_intermediate_size, config.expert_axis,
+            config.initializer_range)
+        self.shared_experts = _DenseMLP(
+            config.hidden_size,
+            config.n_shared_experts * config.moe_intermediate_size,
+            config.initializer_range)
+
+    def forward(self, x):
+        cfg = self.config
+        b, l, d = x.shape
+        from ..ops.manipulation import reshape
+        x2 = reshape(x, [-1, d])
+        logits = self.gate(x2)
+
+        def f(x_arr, logit_arr, gate_up, down):
+            efn = self.experts.expert_fn(gate_up, down)
+            return moe_dispatch_combine(
+                x_arr, logit_arr, cfg.n_routed_experts,
+                top_k=cfg.num_experts_per_tok,
+                capacity_factor=cfg.capacity_factor, expert_fn=efn,
+                expert_axis=cfg.expert_axis,
+                normalize_gates=cfg.norm_topk_prob)
+
+        y, aux = apply_jax("deepseek_moe_block", f, x2, logits,
+                           self.experts.gate_up_proj,
+                           self.experts.down_proj, n_outputs=2)
+        from ..ops.math import add
+        out = add(y, self.shared_experts(x2))
+        return reshape(out, [b, l, d]), aux
+
+
+class DeepseekMoeDecoderLayer(Layer):
+    def __init__(self, config: DeepseekMoeConfig, layer_idx: int):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        sparse = (layer_idx >= config.first_k_dense_replace and
+                  layer_idx % config.moe_layer_freq == 0)
+        if sparse:
+            self.mlp = DeepseekMoeBlock(config)
+        else:
+            self.mlp = _DenseMLP(config.hidden_size,
+                                 config.intermediate_size,
+                                 config.initializer_range)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden_states, rope_cos, rope_sin,
+                attention_mask=None):
+        h = self.input_layernorm(hidden_states)
+        h = hidden_states + self.self_attn(h, rope_cos, rope_sin,
+                                           attention_mask)
+        h2 = self.post_attention_layernorm(h)
+        m = self.mlp(h2)
+        if isinstance(m, tuple):
+            m, aux = m
+        else:
+            import jax.numpy as jnp
+            aux = _wrap_out(jnp.zeros((), jnp.float32))
+        return h + m, aux
+
+
+class DeepseekMoeModel(Layer):
+    def __init__(self, config: DeepseekMoeConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        from ..nn.layer.container import LayerList
+        self.layers = LayerList(
+            [DeepseekMoeDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(config.max_position_embeddings, head_dim,
+                                config.rope_theta)
+        self._rope_cos = Tensor(cos)
+        self._rope_sin = Tensor(sin)
+
+    def forward(self, input_ids, attention_mask=None):
+        input_ids = batch_shard(input_ids)
+        h = self.embed_tokens(input_ids)
+        l = h.shape[1]
+        cos = _wrap_out(as_jax(self._rope_cos)[:l])
+        sin = _wrap_out(as_jax(self._rope_sin)[:l])
+        from ..distributed.recompute import recompute
+        from ..ops.math import add
+        aux_total = None
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h, aux = recompute(layer, h, cos, sin, attention_mask)
+            else:
+                h, aux = layer(h, cos, sin, attention_mask)
+            aux_total = aux if aux_total is None else add(aux_total, aux)
+        return self.norm(h), aux_total
+
+
+class DeepseekMoeForCausalLM(Layer):
+    def __init__(self, config: DeepseekMoeConfig):
+        super().__init__()
+        self.config = config
+        self.deepseek = DeepseekMoeModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        self.criterion = LlamaPretrainingCriterion()
+
+    def _logits(self, h):
+        if self.config.tie_word_embeddings:
+            from ..ops.linalg import matmul
+            return matmul(h, self.deepseek.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        h, aux_total = self.deepseek(input_ids, attention_mask)
+        logits = self._logits(h)
+        if labels is None:
+            return logits
+        loss = self.criterion(logits, labels)
+        if aux_total is not None and self.config.router_aux_loss_coef:
+            from ..ops.math import add, scale
+            loss = add(loss, scale(
+                aux_total, self.config.router_aux_loss_coef))
+        return loss

@@ -98,6 +98,10 @@ def _apply_rope(x, cos, sin):
 
 
 class LlamaAttention(Layer):
+    """GQA attention, shared by the Llama/Qwen2-MoE/DeepSeek families —
+    ``config.qkv_bias`` (default False) is the only signature difference
+    between them (Qwen2 adds bias to q/k/v)."""
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -106,15 +110,16 @@ class LlamaAttention(Layer):
         self.num_kv_heads = config.num_key_value_heads
         self.head_dim = config.hidden_size // config.num_attention_heads
         init = Normal(0.0, config.initializer_range)
+        qkv_bias = getattr(config, "qkv_bias", False)
         self.q_proj = ColumnParallelLinear(
             self.hidden_size, self.num_heads * self.head_dim,
-            weight_attr=None, has_bias=False, gather_output=False)
+            weight_attr=None, has_bias=qkv_bias, gather_output=False)
         self.k_proj = ColumnParallelLinear(
             self.hidden_size, self.num_kv_heads * self.head_dim,
-            has_bias=False, gather_output=False)
+            has_bias=qkv_bias, gather_output=False)
         self.v_proj = ColumnParallelLinear(
             self.hidden_size, self.num_kv_heads * self.head_dim,
-            has_bias=False, gather_output=False)
+            has_bias=qkv_bias, gather_output=False)
         self.o_proj = RowParallelLinear(
             self.num_heads * self.head_dim, self.hidden_size,
             has_bias=False, input_is_parallel=True)

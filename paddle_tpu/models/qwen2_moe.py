@@ -1,0 +1,275 @@
+"""Qwen2-MoE model family (PaddleNLP ``paddlenlp/transformers/qwen2_moe/
+modeling.py`` parity) — BASELINE config 5.
+
+TPU-first expert parallelism: each sparse block holds its experts as
+STACKED arrays ``[e, d, m]`` annotated with a PartitionSpec on the expert
+mesh axis. Dispatch/combine are the GShard einsums from
+``distributed/moe.py``; when the expert dim is mesh-sharded, GSPMD lowers
+the dispatch einsum into the all-to-all the reference implements by hand
+over its expert ProcessGroup. All shapes static (capacity padding) so the
+whole step stays inside one jit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn.initializer import Normal
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import RMSNorm
+from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..distributed.moe import moe_dispatch_combine
+from ..distributed.shard_utils import batch_shard
+from ..incubate.nn.functional import swiglu
+from .llama import (LlamaAttention, LlamaPretrainingCriterion,
+                    _rope_tables)
+
+__all__ = ["Qwen2MoeConfig", "Qwen2MoeModel", "Qwen2MoeForCausalLM",
+           "StackedExpertsMLP"]
+
+
+@dataclass
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 3584
+    intermediate_size: int = 18944          # dense-layer MLP width
+    moe_intermediate_size: int = 2560       # per-expert MLP width
+    shared_expert_intermediate_size: int = 20480
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 28
+    num_key_value_heads: int = 4
+    num_experts: int = 64
+    num_experts_per_tok: int = 8
+    decoder_sparse_step: int = 1            # every k-th layer is sparse
+    norm_topk_prob: bool = False
+    router_aux_loss_coef: float = 0.001
+    capacity_factor: float = 1.25
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = True                   # the Qwen2 signature detail
+    recompute: bool = False
+    expert_axis: str = "dp"                 # mesh axis experts shard over
+    dtype: str = "float32"
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=128, layers=2, heads=4, kv_heads=2,
+             moe_ffn=96, shared_ffn=192, experts=8, topk=2):
+        return Qwen2MoeConfig(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=shared_ffn,
+            moe_intermediate_size=moe_ffn,
+            shared_expert_intermediate_size=shared_ffn,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, num_experts=experts,
+            num_experts_per_tok=topk, max_position_embeddings=512)
+
+
+class StackedExpertsMLP(Layer):
+    """E SwiGLU experts held as stacked ``[e, ...]`` parameters.
+
+    The reference keeps a python list of per-expert Linears and loops /
+    all-to-alls between them; on TPU a stacked layout turns the expert
+    compute into three batched einsums (one MXU call each) and makes the
+    expert dim an ordinary shardable array axis.
+    """
+
+    def __init__(self, num_experts, d_model, d_ffn, expert_axis="dp",
+                 initializer_range=0.02):
+        super().__init__()
+        init = Normal(0.0, initializer_range)
+        self.num_experts = num_experts
+        self.gate_up_proj = self.create_parameter(
+            [num_experts, d_model, 2 * d_ffn], default_initializer=init)
+        self.down_proj = self.create_parameter(
+            [num_experts, d_ffn, d_model], default_initializer=init)
+        from ..distributed.shard_utils import annotate_param
+        annotate_param(self.gate_up_proj, (expert_axis, None, "mp"))
+        annotate_param(self.down_proj, (expert_axis, "mp", None))
+
+    def expert_fn(self, gate_up, down):
+        """Pure-jax fn: expert_in [e, c, d] -> [e, c, d]."""
+        def f(expert_in):
+            gu = jnp.einsum("ecd,edm->ecm", expert_in,
+                            gate_up.astype(expert_in.dtype))
+            g, u = jnp.split(gu, 2, axis=-1)
+            h = jax.nn.silu(g) * u
+            return jnp.einsum("ecm,emd->ecd", h,
+                              down.astype(expert_in.dtype))
+        return f
+
+
+class Qwen2MoeSparseBlock(Layer):
+    """Router + stacked routed experts + always-on shared expert."""
+
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        from ..nn.layer.common import Linear
+        self.config = config
+        self.gate = Linear(config.hidden_size, config.num_experts,
+                           bias_attr=False)
+        self.experts = StackedExpertsMLP(
+            config.num_experts, config.hidden_size,
+            config.moe_intermediate_size, config.expert_axis,
+            config.initializer_range)
+        self.shared_expert = _DenseMLP(
+            config.hidden_size, config.shared_expert_intermediate_size,
+            config.initializer_range)
+        self.shared_expert_gate = Linear(config.hidden_size, 1,
+                                         bias_attr=False)
+
+    def forward(self, x):
+        """Returns ``(out, aux_loss)`` — aux travels by value so it
+        survives ``jax.checkpoint`` retracing (a value stored on ``self``
+        inside the remat trace would leak the inner tracer)."""
+        cfg = self.config
+        b, l, d = x.shape
+        from ..ops.manipulation import reshape
+        x2 = reshape(x, [-1, d])
+        logits = self.gate(x2)
+
+        def f(x_arr, logit_arr, gate_up, down):
+            efn = self.experts.expert_fn(gate_up, down)
+            y, aux = moe_dispatch_combine(
+                x_arr, logit_arr, cfg.num_experts,
+                top_k=cfg.num_experts_per_tok,
+                capacity_factor=cfg.capacity_factor, expert_fn=efn,
+                expert_axis=cfg.expert_axis,
+                normalize_gates=cfg.norm_topk_prob)
+            return y, aux
+
+        y, aux = apply_jax("qwen2_moe_block", f, x2, logits,
+                           self.experts.gate_up_proj,
+                           self.experts.down_proj, n_outputs=2)
+
+        shared = self.shared_expert(x2)
+        from ..ops.math import multiply, add
+        from ..nn.functional import sigmoid
+        sg = sigmoid(self.shared_expert_gate(x2))
+        out = add(y, multiply(shared, sg))
+        return reshape(out, [b, l, d]), aux
+
+
+class _DenseMLP(Layer):
+    def __init__(self, d_model, d_ffn, initializer_range=0.02):
+        super().__init__()
+        self.gate_proj = ColumnParallelLinear(
+            d_model, d_ffn, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(
+            d_model, d_ffn, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(
+            d_ffn, d_model, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+# Same GQA attention as Llama; config.qkv_bias=True is the only delta.
+Qwen2MoeAttention = LlamaAttention
+
+
+class Qwen2MoeDecoderLayer(Layer):
+    def __init__(self, config: Qwen2MoeConfig, layer_idx: int):
+        super().__init__()
+        self.self_attn = Qwen2MoeAttention(config)
+        sparse = (config.num_experts > 0 and
+                  (layer_idx + 1) % config.decoder_sparse_step == 0)
+        if sparse:
+            self.mlp = Qwen2MoeSparseBlock(config)
+        else:
+            self.mlp = _DenseMLP(config.hidden_size,
+                                 config.intermediate_size,
+                                 config.initializer_range)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden_states, rope_cos, rope_sin,
+                attention_mask=None):
+        """Returns ``(h, aux_loss)`` uniformly (zero aux for dense
+        layers) so the remat and non-remat paths carry the router loss
+        identically."""
+        h = self.input_layernorm(hidden_states)
+        h = hidden_states + self.self_attn(h, rope_cos, rope_sin,
+                                           attention_mask)
+        h2 = self.post_attention_layernorm(h)
+        m = self.mlp(h2)
+        if isinstance(m, tuple):
+            m, aux = m
+        else:
+            aux = _wrap_out(jnp.zeros((), jnp.float32))
+        return h + m, aux
+
+
+class Qwen2MoeModel(Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size)
+        from ..nn.layer.container import LayerList
+        self.layers = LayerList(
+            [Qwen2MoeDecoderLayer(config, i)
+             for i in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        head_dim = config.hidden_size // config.num_attention_heads
+        cos, sin = _rope_tables(config.max_position_embeddings, head_dim,
+                                config.rope_theta)
+        self._rope_cos = Tensor(cos)
+        self._rope_sin = Tensor(sin)
+
+    def forward(self, input_ids, attention_mask=None):
+        """Returns ``(h, total_aux_loss)``."""
+        input_ids = batch_shard(input_ids)
+        h = self.embed_tokens(input_ids)
+        l = h.shape[1]
+        cos = _wrap_out(as_jax(self._rope_cos)[:l])
+        sin = _wrap_out(as_jax(self._rope_sin)[:l])
+        from ..distributed.recompute import recompute
+        from ..ops.math import add
+        aux_total = None
+        for layer in self.layers:
+            if self.config.recompute and self.training:
+                h, aux = recompute(layer, h, cos, sin, attention_mask)
+            else:
+                h, aux = layer(h, cos, sin, attention_mask)
+            aux_total = aux if aux_total is None else add(aux_total, aux)
+        return self.norm(h), aux_total
+
+
+class Qwen2MoeForCausalLM(Layer):
+    def __init__(self, config: Qwen2MoeConfig):
+        super().__init__()
+        self.config = config
+        self.qwen2_moe = Qwen2MoeModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        self.criterion = LlamaPretrainingCriterion()
+
+    def _logits(self, h):
+        if self.config.tie_word_embeddings:
+            from ..ops.linalg import matmul
+            return matmul(h, self.qwen2_moe.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        h, aux_total = self.qwen2_moe(input_ids, attention_mask)
+        logits = self._logits(h)
+        if labels is None:
+            return logits
+        loss = self.criterion(logits, labels)
+        if aux_total is not None and self.config.router_aux_loss_coef:
+            from ..ops.math import add, scale
+            loss = add(loss, scale(
+                aux_total, self.config.router_aux_loss_coef))
+        return loss
