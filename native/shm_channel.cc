@@ -1,0 +1,260 @@
+// Shared-memory message channel for DataLoader worker → trainer tensor
+// transport.
+//
+// TPU-native counterpart of the reference's mmap tensor transport
+// (paddle/fluid/memory/allocation/mmap_allocator.cc + the dataloader
+// worker shm path): a POSIX shm ring buffer with a process-shared
+// mutex/condvar pair, carrying length-prefixed pickled batches. One
+// channel per worker (SPSC); blocking push/pop with timeouts.
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <new>
+#include <string>
+
+namespace {
+
+struct RingHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t nonempty;
+  pthread_cond_t nonfull;
+  uint64_t capacity;  // data bytes
+  uint64_t head;      // read offset (monotonic, mod capacity)
+  uint64_t tail;      // write offset (monotonic, mod capacity)
+  uint32_t closed;
+  uint32_t magic;
+};
+
+constexpr uint32_t kMagic = 0x53484d43;  // "SHMC"
+
+struct Channel {
+  RingHeader* hdr = nullptr;
+  char* data = nullptr;
+  size_t total = 0;
+  std::string name;
+  bool owner = false;
+};
+
+uint64_t used(const RingHeader* h) { return h->tail - h->head; }
+
+void copy_in(Channel* ch, uint64_t pos, const void* src, uint64_t n) {
+  uint64_t off = pos % ch->hdr->capacity;
+  uint64_t first = std::min(n, ch->hdr->capacity - off);
+  memcpy(ch->data + off, src, first);
+  if (n > first)
+    memcpy(ch->data, static_cast<const char*>(src) + first, n - first);
+}
+
+void copy_out(Channel* ch, uint64_t pos, void* dst, uint64_t n) {
+  uint64_t off = pos % ch->hdr->capacity;
+  uint64_t first = std::min(n, ch->hdr->capacity - off);
+  memcpy(dst, ch->data + off, first);
+  if (n > first)
+    memcpy(static_cast<char*>(dst) + first, ch->data, n - first);
+}
+
+bool abs_deadline(timespec* ts, int64_t timeout_ms) {
+  if (timeout_ms <= 0) return false;
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// capacity: ring data size in bytes. Returns handle or nullptr.
+void* shmch_create(const char* name, uint64_t capacity) {
+  size_t total = sizeof(RingHeader) + capacity;
+  shm_unlink(name);  // stale ring from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<RingHeader*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->nonempty, &ca);
+  pthread_cond_init(&hdr->nonfull, &ca);
+  hdr->capacity = capacity;
+  hdr->head = hdr->tail = 0;
+  hdr->closed = 0;
+  hdr->magic = kMagic;
+  auto* ch = new Channel();
+  ch->hdr = hdr;
+  ch->data = static_cast<char*>(mem) + sizeof(RingHeader);
+  ch->total = total;
+  ch->name = name;
+  ch->owner = true;
+  return ch;
+}
+
+void* shmch_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 ||
+      st.st_size < static_cast<off_t>(sizeof(RingHeader))) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<RingHeader*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* ch = new Channel();
+  ch->hdr = hdr;
+  ch->data = static_cast<char*>(mem) + sizeof(RingHeader);
+  ch->total = static_cast<size_t>(st.st_size);
+  ch->name = name;
+  return ch;
+}
+
+static int lock_robust(RingHeader* h) {
+  int r = pthread_mutex_lock(&h->mu);
+  if (r == EOWNERDEAD) {  // peer died holding the lock
+    pthread_mutex_consistent(&h->mu);
+    return 0;
+  }
+  return r;
+}
+
+// 0 ok, -2 timeout, -4 closed, -5 message larger than ring, -1 error.
+int shmch_push(void* handle, const void* buf, uint64_t len,
+               int64_t timeout_ms) {
+  auto* ch = static_cast<Channel*>(handle);
+  RingHeader* h = ch->hdr;
+  uint64_t need = len + 8;
+  if (need > h->capacity) return -5;
+  timespec ts;
+  bool timed = abs_deadline(&ts, timeout_ms);
+  if (lock_robust(h) != 0) return -1;
+  while (h->capacity - used(h) < need && !h->closed) {
+    int r = timed ? pthread_cond_timedwait(&h->nonfull, &h->mu, &ts)
+                  : pthread_cond_wait(&h->nonfull, &h->mu);
+    if (r == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -4;
+  }
+  copy_in(ch, h->tail, &len, 8);
+  copy_in(ch, h->tail + 8, buf, len);
+  h->tail += need;
+  pthread_cond_signal(&h->nonempty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Returns message length and copies up to cap bytes into out;
+// -2 timeout, -4 closed-and-drained, -1 error. cap < len drops the
+// tail (callers size via shmch_peek_len first).
+int64_t shmch_pop(void* handle, void* out, uint64_t cap,
+                  int64_t timeout_ms) {
+  auto* ch = static_cast<Channel*>(handle);
+  RingHeader* h = ch->hdr;
+  timespec ts;
+  bool timed = abs_deadline(&ts, timeout_ms);
+  if (lock_robust(h) != 0) return -1;
+  while (used(h) == 0 && !h->closed) {
+    int r = timed ? pthread_cond_timedwait(&h->nonempty, &h->mu, &ts)
+                  : pthread_cond_wait(&h->nonempty, &h->mu);
+    if (r == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+  }
+  if (used(h) == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -4;
+  }
+  uint64_t len;
+  copy_out(ch, h->head, &len, 8);
+  copy_out(ch, h->head + 8, out, std::min(cap, len));
+  h->head += len + 8;
+  pthread_cond_signal(&h->nonfull);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+// Length of the next message without consuming it; -2 timeout, -4 closed.
+int64_t shmch_peek_len(void* handle, int64_t timeout_ms) {
+  auto* ch = static_cast<Channel*>(handle);
+  RingHeader* h = ch->hdr;
+  timespec ts;
+  bool timed = abs_deadline(&ts, timeout_ms);
+  if (lock_robust(h) != 0) return -1;
+  while (used(h) == 0 && !h->closed) {
+    int r = timed ? pthread_cond_timedwait(&h->nonempty, &h->mu, &ts)
+                  : pthread_cond_wait(&h->nonempty, &h->mu);
+    if (r == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -2;
+    }
+  }
+  if (used(h) == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -4;
+  }
+  uint64_t len;
+  copy_out(ch, h->head, &len, 8);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+void shmch_close_write(void* handle) {  // producer EOF
+  auto* ch = static_cast<Channel*>(handle);
+  if (lock_robust(ch->hdr) == 0) {
+    ch->hdr->closed = 1;
+    pthread_cond_broadcast(&ch->hdr->nonempty);
+    pthread_cond_broadcast(&ch->hdr->nonfull);
+    pthread_mutex_unlock(&ch->hdr->mu);
+  }
+}
+
+void shmch_free(void* handle) {
+  auto* ch = static_cast<Channel*>(handle);
+  if (ch->hdr) munmap(ch->hdr, ch->total);
+  if (ch->owner) shm_unlink(ch->name.c_str());
+  delete ch;
+}
+
+}  // extern "C"

@@ -1,0 +1,367 @@
+// TCPStore: blocking key-value rendezvous over TCP.
+//
+// TPU-native counterpart of the reference's bootstrap store
+// (paddle/fluid/distributed/store/tcp_store.cc): rank0 hosts the store,
+// every rank set()s its endpoint and get()s peers'; get blocks until the
+// key exists, add() is the atomic barrier counter. Exposed as a C API for
+// ctypes (no pybind11 in this image).
+//
+// Server: one accept loop + thread-per-connection; state is a
+// mutex-guarded map with a condition_variable so blocking gets/waits
+// park inside their connection thread.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t {
+  kSet = 1,
+  kGet = 2,      // blocking until key exists
+  kAdd = 3,
+  kWait = 4,     // blocking until key exists, no value returned
+  kDelete = 5,
+  kNumKeys = 6,
+  kTryGet = 7,   // non-blocking get
+};
+
+enum Status : uint8_t { kOk = 0, kTimeout = 1, kMissing = 2, kErr = 3 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
+  std::mutex conns_mu;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<char>> kv;
+
+  ~Server() { shutdown(); }
+
+  void shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR), ::close(listen_fd);
+    cv.notify_all();
+    {
+      // unblock handler threads parked in recv on live client sockets
+      std::lock_guard<std::mutex> g(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(conns_mu);
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      uint32_t klen = 0;
+      if (!recv_all(fd, &klen, 4) || klen > (1u << 20)) break;
+      std::string key(klen, '\0');
+      if (klen && !recv_all(fd, key.data(), klen)) break;
+
+      if (cmd == kSet) {
+        uint64_t vlen = 0;
+        if (!recv_all(fd, &vlen, 8) || vlen > (1ull << 32)) break;
+        std::vector<char> val(vlen);
+        if (vlen && !recv_all(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t st = kOk;
+        if (!send_all(fd, &st, 1)) break;
+      } else if (cmd == kGet || cmd == kWait || cmd == kTryGet) {
+        int64_t timeout_ms = 0;
+        if (!recv_all(fd, &timeout_ms, 8)) break;
+        std::unique_lock<std::mutex> lk(mu);
+        auto ready = [&] { return stop.load() || kv.count(key) > 0; };
+        bool ok;
+        if (cmd == kTryGet) {
+          ok = kv.count(key) > 0;
+        } else if (timeout_ms <= 0) {
+          cv.wait(lk, ready);
+          ok = kv.count(key) > 0;
+        } else {
+          ok = cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                           ready) &&
+               kv.count(key) > 0;
+        }
+        if (!ok) {
+          lk.unlock();
+          uint8_t st = (cmd == kTryGet) ? kMissing : kTimeout;
+          if (!send_all(fd, &st, 1)) break;
+          continue;
+        }
+        std::vector<char> val = kv[key];
+        lk.unlock();
+        uint8_t st = kOk;
+        uint64_t vlen = (cmd == kWait) ? 0 : val.size();
+        if (!send_all(fd, &st, 1)) break;
+        if (cmd != kWait) {
+          if (!send_all(fd, &vlen, 8)) break;
+          if (vlen && !send_all(fd, val.data(), vlen)) break;
+        }
+      } else if (cmd == kAdd) {
+        int64_t delta = 0;
+        if (!recv_all(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::vector<char> v(8);
+          memcpy(v.data(), &cur, 8);
+          kv[key] = std::move(v);
+          result = cur;
+        }
+        cv.notify_all();
+        uint8_t st = kOk;
+        if (!send_all(fd, &st, 1) || !send_all(fd, &result, 8)) break;
+      } else if (cmd == kDelete) {
+        size_t n;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          n = kv.erase(key);
+        }
+        uint8_t st = n ? kOk : kMissing;
+        if (!send_all(fd, &st, 1)) break;
+      } else if (cmd == kNumKeys) {
+        int64_t n;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          n = static_cast<int64_t>(kv.size());
+        }
+        uint8_t st = kOk;
+        if (!send_all(fd, &st, 1) || !send_all(fd, &n, 8)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stop.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> g(conns_mu);
+      conn_fds.push_back(fd);
+      conns.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns the bound port (>0) on success (port=0 picks a free one),
+// negative errno on failure. *out_handle receives the server.
+int64_t tcps_server_start(int port, void** out_handle) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    int e = errno;
+    ::close(fd);
+    return -e;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  *out_handle = s;
+  return ntohs(addr.sin_port);
+}
+
+void tcps_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  delete s;  // ~Server joins everything
+}
+
+void* tcps_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms
+                                                           : 30000);
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) < 0) {
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void tcps_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+static bool send_req_header(Client* c, uint8_t cmd, const char* key) {
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  return send_all(c->fd, &cmd, 1) && send_all(c->fd, &klen, 4) &&
+         send_all(c->fd, key, klen);
+}
+
+int tcps_set(void* h, const char* key, const void* val, uint64_t len) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_req_header(c, kSet, key) || !send_all(c->fd, &len, 8) ||
+      (len && !send_all(c->fd, val, len)))
+    return -1;
+  uint8_t st;
+  return recv_all(c->fd, &st, 1) && st == kOk ? 0 : -1;
+}
+
+// Returns value length (copied into out up to cap), -1 error,
+// -2 timeout, -3 missing (try_get only).
+int64_t tcps_get_impl(Client* c, uint8_t cmd, const char* key, void* out,
+                      uint64_t cap, int64_t timeout_ms) {
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_req_header(c, cmd, key) ||
+      !send_all(c->fd, &timeout_ms, 8))
+    return -1;
+  uint8_t st;
+  if (!recv_all(c->fd, &st, 1)) return -1;
+  if (st == kTimeout) return -2;
+  if (st == kMissing) return -3;
+  if (st != kOk) return -1;
+  if (cmd == kWait) return 0;
+  uint64_t vlen;
+  if (!recv_all(c->fd, &vlen, 8)) return -1;
+  std::vector<char> val(vlen);
+  if (vlen && !recv_all(c->fd, val.data(), vlen)) return -1;
+  if (out && cap) memcpy(out, val.data(), std::min(cap, vlen));
+  return static_cast<int64_t>(vlen);
+}
+
+int64_t tcps_get(void* h, const char* key, void* out, uint64_t cap,
+                 int64_t timeout_ms) {
+  return tcps_get_impl(static_cast<Client*>(h), kGet, key, out, cap,
+                       timeout_ms);
+}
+
+int64_t tcps_try_get(void* h, const char* key, void* out, uint64_t cap) {
+  return tcps_get_impl(static_cast<Client*>(h), kTryGet, key, out, cap, 0);
+}
+
+int tcps_wait(void* h, const char* key, int64_t timeout_ms) {
+  int64_t r = tcps_get_impl(static_cast<Client*>(h), kWait, key, nullptr,
+                            0, timeout_ms);
+  return r >= 0 ? 0 : static_cast<int>(r);
+}
+
+int64_t tcps_add(void* h, const char* key, int64_t delta) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_req_header(c, kAdd, key) || !send_all(c->fd, &delta, 8))
+    return INT64_MIN;
+  uint8_t st;
+  int64_t result;
+  if (!recv_all(c->fd, &st, 1) || st != kOk ||
+      !recv_all(c->fd, &result, 8))
+    return INT64_MIN;
+  return result;
+}
+
+int tcps_delete(void* h, const char* key) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_req_header(c, kDelete, key)) return -1;
+  uint8_t st;
+  if (!recv_all(c->fd, &st, 1)) return -1;
+  return st == kOk ? 0 : (st == kMissing ? -3 : -1);
+}
+
+int64_t tcps_num_keys(void* h) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (!send_req_header(c, kNumKeys, "")) return -1;
+  uint8_t st;
+  int64_t n;
+  if (!recv_all(c->fd, &st, 1) || st != kOk || !recv_all(c->fd, &n, 8))
+    return -1;
+  return n;
+}
+
+}  // extern "C"

@@ -21,6 +21,15 @@ from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,
                             shard_tensor, to_static, unshard_dtensor)
 from .checkpoint import load_state_dict, save_state_dict
 from .moe import MoELayer
+
+
+def __getattr__(name):
+    # TCPStore is native (ctypes over native/tcp_store.cc); import lazily
+    # so `import paddle_tpu` works before the lib is first built.
+    if name == "TCPStore":
+        from ..native import TCPStore
+        return TCPStore
+    raise AttributeError(name)
 from .pipeline import pipeline_apply, stack_stage_params
 from .recompute import recompute, recompute_sequential
 from .ring_attention import RingFlashAttention, ring_flash_attention

@@ -1,17 +1,24 @@
 """``paddle.io`` — Dataset/DataLoader (``python/paddle/io/`` parity).
 
 The reference's multiprocess worker + shared-memory tensor transport
-(``dataloader_iter.py`` + ``mmap_allocator.cc``) maps to a thread-based
-prefetch pipeline here: on TPU the device is fed asynchronously by the
-runtime, so the loader's job is batching + host-side prefetch overlap.
-num_workers>0 selects a threaded prefetcher (XLA releases the GIL during
-device compute, so threads overlap host decode with device step).
+(``dataloader_iter.py`` + ``mmap_allocator.cc``): num_workers>0 with
+use_shared_memory=True forks worker processes that push collated batches
+through the native shm ring (``native/shm_channel.cc`` via
+``paddle_tpu.native.ShmChannel``) — decode happens off the trainer
+process exactly as in the reference. With use_shared_memory=False (or if
+the native lib is unavailable) a threaded prefetcher is used instead:
+XLA releases the GIL during device compute, so threads still overlap
+host decode with the device step.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import queue
+import time
 import threading
+import traceback
+import uuid
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -274,6 +281,28 @@ def get_worker_info():
     return _worker_info
 
 
+def _tree_to_numpy(obj):
+    """Tensor-tree → picklable numpy-tree for shm worker transport."""
+    if isinstance(obj, Tensor):
+        return ("__pt_tensor__", np.asarray(obj.numpy()))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    return obj
+
+
+def _tree_from_numpy(obj):
+    if (isinstance(obj, tuple) and len(obj) == 2
+            and obj[0] == "__pt_tensor__"):
+        return _wrap_out(obj[1])
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_from_numpy(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _tree_from_numpy(v) for k, v in obj.items()}
+    return obj
+
+
 def default_collate_fn(batch):
     """Stack samples into batch tensors (paddle default_collate parity)."""
     sample = batch[0]
@@ -305,6 +334,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_ds = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -347,10 +379,150 @@ class DataLoader:
             batch = [self.dataset[i] for i in indices]
             yield self.collate_fn(batch)
 
+    def _mp_iter(self):
+        """Forked worker processes push collated batches through the
+        native shm ring. Worker w owns batches w, w+n, w+2n…, so the
+        parent preserves sampler order by round-robin popping."""
+        from ..native import ShmChannel
+        n = self.num_workers
+        uid = uuid.uuid4().hex[:8]
+        cap = int(os.environ.get("FLAGS_dataloader_shm_size",
+                                 64 * 1024 * 1024))
+        channels = [ShmChannel(f"/ptdl_{os.getpid()}_{uid}_{i}",
+                               capacity=cap, create=True)
+                    for i in range(n)]
+        pids = []
+        try:
+            for w in range(n):
+                pid = os.fork()
+                if pid == 0:  # worker
+                    code = 0
+                    try:
+                        global _worker_info
+                        _worker_info = _WorkerInfo(w, n, self.dataset)
+                        if self.worker_init_fn is not None:
+                            self.worker_init_fn(w)
+                        if (self.batch_sampler is not None
+                                and not self._iterable_ds):
+                            # map-style: skip foreign batches BEFORE
+                            # touching the dataset (no wasted decode)
+                            def my_batches():
+                                for b, idxs in enumerate(
+                                        self.batch_sampler):
+                                    if b % n == w:
+                                        yield self.collate_fn(
+                                            [self.dataset[i]
+                                             for i in idxs])
+                            it = my_batches()
+                        elif self._iterable_ds:
+                            # iterable: sharding is the dataset's job via
+                            # get_worker_info() (torch/paddle semantics);
+                            # an extra b%n filter here would drop data
+                            # from datasets that DO shard themselves
+                            it = self._raw_iter()
+                        else:
+                            it = (item for b, item in
+                                  enumerate(self._raw_iter())
+                                  if b % n == w)
+                        for item in it:
+                            channels[w].put(
+                                ("ok", _tree_to_numpy(item)),
+                                timeout=self.timeout)
+                    except BaseException:
+                        code = 1
+                        try:
+                            channels[w].put(
+                                ("error", traceback.format_exc()),
+                                timeout=self.timeout)
+                        except BaseException:
+                            pass
+                    finally:
+                        channels[w].close_write()
+                        os._exit(code)  # skip parent atexit/jax teardown
+                pids.append(pid)
+
+            reaped = {}
+
+            def _alive(i):
+                if pids[i] in reaped:
+                    return False
+                try:
+                    p, status = os.waitpid(pids[i], os.WNOHANG)
+                except ChildProcessError:
+                    reaped[pids[i]] = None
+                    return False
+                if p == pids[i]:
+                    reaped[pids[i]] = status
+                    return False
+                return True
+
+            done = [False] * n
+            w = 0
+            while not all(done):
+                if done[w]:
+                    w = (w + 1) % n
+                    continue
+                # poll in 1s slices so a SIGKILLed worker (which never
+                # reaches close_write) is detected instead of hanging
+                deadline = (time.monotonic() + self.timeout
+                            if self.timeout else None)
+                while True:
+                    try:
+                        kind, payload = channels[w].get(timeout=1.0)
+                        break
+                    except TimeoutError:
+                        if not _alive(w):
+                            try:  # a final racing message may exist
+                                kind, payload = channels[w].get(
+                                    timeout=0.05)
+                                break
+                            except (TimeoutError, EOFError):
+                                raise RuntimeError(
+                                    f"DataLoader worker {w} (pid "
+                                    f"{pids[w]}) exited unexpectedly")
+                        if (deadline is not None
+                                and time.monotonic() > deadline):
+                            raise TimeoutError(
+                                f"DataLoader worker {w} produced no "
+                                f"batch within {self.timeout}s")
+                    except EOFError:
+                        kind = "eof"
+                        break
+                if kind == "eof":
+                    done[w] = True
+                    w = (w + 1) % n
+                    continue
+                if kind == "error":
+                    raise RuntimeError(
+                        f"DataLoader worker {w} failed:\n{payload}")
+                yield _tree_from_numpy(payload)
+                w = (w + 1) % n
+        finally:
+            # unblock workers parked in push BEFORE reaping, then a
+            # bounded blocking wait so early loop exit leaves no zombies
+            for ch in channels:
+                ch.close_write()
+            for pid in pids:
+                try:
+                    for _ in range(100):  # <=5s per worker
+                        p, _st = os.waitpid(pid, os.WNOHANG)
+                        if p == pid:
+                            break
+                        time.sleep(0.05)
+                except ChildProcessError:
+                    pass
+            for ch in channels:
+                ch.close()
+
     def __iter__(self):
         if self.num_workers == 0:
             yield from self._raw_iter()
             return
+        if self.use_shared_memory and hasattr(os, "fork"):
+            from .. import native
+            if native.is_available():
+                yield from self._mp_iter()
+                return
         # threaded prefetch: decode-ahead while the device runs
         q: "queue.Queue" = queue.Queue(
             maxsize=self.prefetch_factor * max(1, self.num_workers))
@@ -359,7 +531,9 @@ class DataLoader:
 
         def producer():
             global _worker_info
-            _worker_info = _WorkerInfo(0, self.num_workers, self.dataset)
+            # single producer thread IS the whole worker pool here — a
+            # worker_info-sharding dataset must see 1 worker, not 1-of-n
+            _worker_info = _WorkerInfo(0, 1, self.dataset)
             try:
                 for item in self._raw_iter():
                     q.put(item)

@@ -56,11 +56,6 @@ class DeepseekMoeConfig:
     expert_axis: str = "dp"
     dtype: str = "float32"
 
-    # attention config shim so Qwen2MoeAttention is reusable
-    @property
-    def num_experts(self):
-        return self.n_routed_experts
-
     @staticmethod
     def tiny(vocab=1024, hidden=128, layers=3, heads=4, kv_heads=4,
              moe_ffn=64, dense_ffn=192, experts=8, shared=2, topk=2):

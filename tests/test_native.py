@@ -1,0 +1,202 @@
+"""Native C++ runtime components: TCPStore rendezvous
+(native/tcp_store.cc — paddle/fluid/distributed/store/tcp_store.cc
+parity) and the shm DataLoader transport (native/shm_channel.cc —
+mmap_allocator.cc parity)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.native import ShmChannel, TCPStore, ensure_built
+
+
+def test_build():
+    path = ensure_built()
+    assert os.path.exists(path)
+
+
+def test_tcp_store_set_get_add():
+    master = TCPStore(is_master=True, port=0)
+    client = TCPStore(port=master.port)
+    client.set("ep/1", b"10.0.0.2:8711")
+    assert master.get("ep/1") == b"10.0.0.2:8711"
+    assert master.add("barrier", 1) == 1
+    assert client.add("barrier", 1) == 2
+    assert master.num_keys() == 2
+    assert client.delete_key("ep/1")
+    assert not client.delete_key("ep/1")
+
+
+def test_tcp_store_blocking_get():
+    """get() blocks until another rank set()s the key (the rendezvous
+    primitive the launch bootstrap depends on)."""
+    master = TCPStore(is_master=True, port=0)
+    client = TCPStore(port=master.port)
+    result = {}
+
+    def getter():
+        result["v"] = client.get("late-key")
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()  # still blocked
+    master.set("late-key", b"now")
+    t.join(timeout=5)
+    assert result["v"] == b"now"
+
+
+def test_tcp_store_wait_timeout():
+    master = TCPStore(is_master=True, port=0, timeout=0.3)
+    with pytest.raises(TimeoutError):
+        master.wait("never-set", timeout=0.3)
+
+
+def test_tcp_store_exposed_on_distributed():
+    import paddle_tpu.distributed as dist
+    assert dist.TCPStore is TCPStore
+
+
+def test_shm_channel_roundtrip_large():
+    prod = ShmChannel("/pt_t_rt", capacity=1 << 22, create=True)
+    cons = ShmChannel("/pt_t_rt", create=False)
+    try:
+        arr = np.random.RandomState(0).randn(256, 1024).astype(np.float32)
+        for _ in range(5):  # forces ring wrap-around (5*1MB > 4MB ring)
+            prod.put([arr, {"labels": np.arange(7)}])
+            out = cons.get()
+            np.testing.assert_array_equal(out[0], arr)
+            np.testing.assert_array_equal(out[1]["labels"], np.arange(7))
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_shm_channel_eof():
+    prod = ShmChannel("/pt_t_eof", capacity=1 << 16, create=True)
+    cons = ShmChannel("/pt_t_eof", create=False)
+    try:
+        prod.put("last")
+        prod.close_write()
+        assert cons.get() == "last"   # drains queued data first
+        with pytest.raises(EOFError):
+            cons.get()
+    finally:
+        cons.close()
+        prod.close()
+
+
+def test_shm_channel_cross_process():
+    prod = ShmChannel("/pt_t_xproc", capacity=1 << 20, create=True)
+    pid = os.fork()
+    if pid == 0:
+        try:
+            child = ShmChannel("/pt_t_xproc", create=False)
+            for i in range(10):
+                child.put(np.full((100,), i, np.int32))
+            child.close_write()
+            os._exit(0)
+        except BaseException:
+            os._exit(1)
+    try:
+        for i in range(10):
+            np.testing.assert_array_equal(
+                prod.get(timeout=10), np.full((100,), i, np.int32))
+        _, status = os.waitpid(pid, 0)
+        assert os.waitstatus_to_exitcode(status) == 0
+    finally:
+        prod.close()
+
+
+class _SlowDataset(paddle.io.Dataset):
+    def __init__(self, n=64):
+        self.n = n
+
+    def __getitem__(self, i):
+        return (np.full((4, 4), i, np.float32),
+                np.asarray(i % 10, np.int64))
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_multiprocess_workers():
+    """num_workers>0 + use_shared_memory spawns fork workers over the shm
+    ring; batches come back in sampler order."""
+    ds = _SlowDataset(64)
+    loader = paddle.io.DataLoader(ds, batch_size=8, num_workers=2,
+                                  shuffle=False, use_shared_memory=True)
+    batches = list(loader)
+    assert len(batches) == 8
+    for b, (x, y) in enumerate(batches):
+        # sampler order preserved: batch b holds items 8b..8b+7
+        np.testing.assert_array_equal(
+            x.numpy()[:, 0, 0], np.arange(8 * b, 8 * b + 8, dtype=np.float32))
+        assert x.shape == [8, 4, 4]
+
+
+def test_dataloader_mp_worker_error_propagates():
+    class Bad(paddle.io.Dataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise ValueError("boom at 5")
+            return np.zeros((2,), np.float32)
+
+        def __len__(self):
+            return 8
+
+    loader = paddle.io.DataLoader(Bad(), batch_size=2, num_workers=2,
+                                  use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="boom at 5"):
+        list(loader)
+
+
+def test_dataloader_mp_killed_worker_raises():
+    """A SIGKILLed worker (OOM-killer scenario) must raise, not hang."""
+    import signal
+
+    class Hang(paddle.io.Dataset):
+        def __getitem__(self, i):
+            if i >= 4:
+                os.kill(os.getpid(), signal.SIGKILL)  # worker dies hard
+            return np.zeros((2,), np.float32)
+
+        def __len__(self):
+            return 64
+
+    loader = paddle.io.DataLoader(Hang(), batch_size=2, num_workers=2,
+                                  use_shared_memory=True)
+    with pytest.raises(RuntimeError, match="exited unexpectedly"):
+        list(loader)
+
+
+def test_dataloader_mp_iterable_worker_sharding():
+    """IterableDataset shards itself via get_worker_info(); the loader
+    must not filter again on top (no double-sharding)."""
+
+    class Sharded(paddle.io.IterableDataset):
+        def __iter__(self):
+            info = paddle.io.get_worker_info()
+            wid = info.id if info else 0
+            nw = info.num_workers if info else 1
+            for i in range(wid, 32, nw):
+                yield np.asarray([i], np.int64)
+
+    loader = paddle.io.DataLoader(Sharded(), batch_size=4,
+                                  num_workers=2, use_shared_memory=True)
+    seen = sorted(int(v) for b in loader for v in b.numpy().ravel())
+    assert seen == list(range(32))
+
+
+def test_dataloader_mp_matches_serial():
+    ds = _SlowDataset(40)
+    serial = list(paddle.io.DataLoader(ds, batch_size=8, num_workers=0))
+    mp = list(paddle.io.DataLoader(ds, batch_size=8, num_workers=3,
+                                   use_shared_memory=True))
+    assert len(serial) == len(mp)
+    for (sx, sy), (mx, my) in zip(serial, mp):
+        np.testing.assert_array_equal(sx.numpy(), mx.numpy())
+        np.testing.assert_array_equal(sy.numpy(), my.numpy())
