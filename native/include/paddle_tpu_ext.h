@@ -1,0 +1,108 @@
+// Custom-op extension header (the reference's paddle/extension.h
+// counterpart, reduced to a C ABI so ctypes can load user libraries
+// without pybind11).
+//
+// A user op is a C function over PTE_Tensor views:
+//
+//   #include "paddle_tpu_ext.h"
+//   static void relu_fwd(const PTE_Tensor* in, int n_in,
+//                        PTE_Tensor* out, int n_out) {
+//     const float* x = (const float*)in[0].data;
+//     float* y = (float*)out[0].data;
+//     for (int64_t i = 0; i < pte_numel(&in[0]); ++i)
+//       y[i] = x[i] > 0 ? x[i] : 0;
+//   }
+//   PTE_REGISTER_OP(custom_relu, relu_fwd, 1);
+//
+// Outputs are pre-allocated by the framework from the op's Python-side
+// shape inference (default: same shape/dtype as input 0).
+
+#ifndef PADDLE_TPU_EXT_H_
+#define PADDLE_TPU_EXT_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// dtype codes match numpy kind ordering used by the Python bridge
+enum PTE_DType {
+  PTE_FLOAT32 = 0,
+  PTE_FLOAT64 = 1,
+  PTE_INT32 = 2,
+  PTE_INT64 = 3,
+  PTE_BOOL = 4,
+  PTE_UINT8 = 5,
+  PTE_INT8 = 6,
+  PTE_FLOAT16 = 7,
+  PTE_BFLOAT16 = 8,
+};
+
+typedef struct {
+  void* data;
+  const int64_t* shape;
+  int32_t ndim;
+  int32_t dtype;  // PTE_DType
+} PTE_Tensor;
+
+static inline int64_t pte_numel(const PTE_Tensor* t) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < t->ndim; ++i) n *= t->shape[i];
+  return n;
+}
+
+typedef void (*pte_kernel_fn)(const PTE_Tensor* inputs, int n_inputs,
+                              PTE_Tensor* outputs, int n_outputs);
+
+// --- registry (one per user library) ------------------------------------
+#define PTE_MAX_OPS 256
+
+typedef struct {
+  const char* name;
+  pte_kernel_fn fn;
+  int n_outputs;
+} PTE_OpEntry;
+
+// defined once per shared library by PTE_DEFINE_REGISTRY (emitted
+// automatically below)
+extern PTE_OpEntry pte_registry[PTE_MAX_OPS];
+extern int pte_registry_size;
+
+#ifdef __cplusplus
+}
+#endif
+
+// Registry storage + accessors, emitted exactly once per user library.
+#ifndef PTE_NO_DEFINE_REGISTRY
+#ifdef __cplusplus
+extern "C" {
+#endif
+PTE_OpEntry pte_registry[PTE_MAX_OPS];
+int pte_registry_size = 0;
+
+int pte_num_ops(void) { return pte_registry_size; }
+const char* pte_op_name(int i) { return pte_registry[i].name; }
+int pte_op_n_outputs(int i) { return pte_registry[i].n_outputs; }
+void pte_op_call(int i, const PTE_Tensor* inputs, int n_inputs,
+                 PTE_Tensor* outputs, int n_outputs) {
+  pte_registry[i].fn(inputs, n_inputs, outputs, n_outputs);
+}
+#ifdef __cplusplus
+}
+#endif
+#endif  // PTE_NO_DEFINE_REGISTRY
+
+// Registration: a constructor-attributed function appends to the
+// registry before main/dlopen returns.
+#define PTE_REGISTER_OP(op_name, kernel, n_out)                        \
+  __attribute__((constructor)) static void pte_reg_##op_name(void) {   \
+    if (pte_registry_size < PTE_MAX_OPS) {                             \
+      pte_registry[pte_registry_size].name = #op_name;                 \
+      pte_registry[pte_registry_size].fn = (kernel);                   \
+      pte_registry[pte_registry_size].n_outputs = (n_out);             \
+      pte_registry_size++;                                             \
+    }                                                                  \
+  }
+
+#endif  // PADDLE_TPU_EXT_H_

@@ -45,6 +45,8 @@ from . import utils
 from . import profiler
 from . import sparse
 from . import fft
+from . import inference
+from . import distribution
 from .hapi import Model, summary
 from .framework.io import save, load
 from .nn.layer.layers import Layer

@@ -1,0 +1,870 @@
+"""``paddle.distribution`` — probability distributions
+(``python/paddle/distribution/`` parity).
+
+Pure-functional TPU design: every density/statistic is a jax expression
+over the distribution's parameter arrays (differentiable through
+``apply_jax``'s vjp recording, so ``log_prob(value).backward()`` trains
+distribution parameters); sampling draws keys from the framework RNG
+(``framework/random.py``) and uses jax.random — reparameterized
+(``rsample``) where the reference supports it.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..framework.random import next_key
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "Poisson", "StudentT",
+    "kl_divergence", "register_kl",
+]
+
+
+def _param(x):
+    """Distribution parameter → Tensor (keeps autograd linkage)."""
+    if isinstance(x, Tensor):
+        return x
+    return _wrap_out(jnp.asarray(
+        np.asarray(x, np.float32) if not isinstance(x, (int, float))
+        else np.float32(x)))
+
+
+def _shape(sample_shape, batch_shape):
+    return tuple(sample_shape) + tuple(batch_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        from ..framework.core import no_grad
+        with no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return apply_jax("dist_prob", jnp.exp, lp)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = jnp.broadcast_shapes(as_jax(self.loc).shape,
+                                     as_jax(self.scale).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_jax("normal_var", jnp.square, self.scale)
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(loc, scale):
+            eps = jax.random.normal(key, out_shape, jnp.float32)
+            return loc + scale * eps
+        return apply_jax("normal_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            var = scale ** 2
+            return (-((v - loc) ** 2) / (2 * var) - jnp.log(scale)
+                    - 0.5 * math.log(2 * math.pi))
+        return apply_jax("normal_logprob", f, _param(value), self.loc,
+                         self.scale)
+
+    def entropy(self):
+        def f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return apply_jax("normal_entropy", f, self.scale)
+
+    def cdf(self, value):
+        def f(v, loc, scale):
+            return 0.5 * (1 + jax.scipy.special.erf(
+                (v - loc) / (scale * math.sqrt(2.0))))
+        return apply_jax("normal_cdf", f, _param(value), self.loc,
+                         self.scale)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        def f(loc, scale):
+            return jnp.exp(loc + scale ** 2 / 2)
+        return apply_jax("lognormal_mean", f, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def f(loc, scale):
+            s2 = scale ** 2
+            return (jnp.exp(s2) - 1) * jnp.exp(2 * loc + s2)
+        return apply_jax("lognormal_var", f, self.loc, self.scale)
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return apply_jax("lognormal_exp", jnp.exp, base)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            logv = jnp.log(v)
+            var = scale ** 2
+            return (-((logv - loc) ** 2) / (2 * var) - jnp.log(scale)
+                    - logv - 0.5 * math.log(2 * math.pi))
+        return apply_jax("lognormal_logprob", f, _param(value), self.loc,
+                         self.scale)
+
+    def entropy(self):
+        def f(loc, scale):
+            return loc + 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return apply_jax("lognormal_entropy", f, self.loc, self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        shape = jnp.broadcast_shapes(as_jax(self.low).shape,
+                                     as_jax(self.high).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        def f(lo, hi):
+            return (lo + hi) / 2
+        return apply_jax("uniform_mean", f, self.low, self.high)
+
+    @property
+    def variance(self):
+        def f(lo, hi):
+            return (hi - lo) ** 2 / 12
+        return apply_jax("uniform_var", f, self.low, self.high)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(lo, hi):
+            u = jax.random.uniform(key, out_shape, jnp.float32)
+            return lo + (hi - lo) * u
+        return apply_jax("uniform_rsample", f, self.low, self.high)
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = jnp.logical_and(v >= lo, v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+        return apply_jax("uniform_logprob", f, _param(value), self.low,
+                         self.high)
+
+    def entropy(self):
+        def f(lo, hi):
+            return jnp.log(hi - lo)
+        return apply_jax("uniform_entropy", f, self.low, self.high)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _param(probs)
+            self.logits = apply_jax(
+                "bern_logits", lambda p: jnp.log(p) - jnp.log1p(-p),
+                self.probs)
+        else:
+            self.logits = _param(logits)
+            self.probs = apply_jax("bern_probs", jax.nn.sigmoid,
+                                   self.logits)
+        super().__init__(as_jax(self.probs).shape)
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        def f(p):
+            return p * (1 - p)
+        return apply_jax("bern_var", f, self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+        p = as_jax(self.probs)
+        return _wrap_out(jax.random.bernoulli(
+            key, p, out_shape).astype(jnp.float32))
+
+    rsample = sample  # discrete: no reparameterization (reference parity)
+
+    def log_prob(self, value):
+        def f(v, logits):
+            return -jnp.logaddexp(0.0, jnp.where(v > 0.5, -logits,
+                                                 logits))
+        return apply_jax("bern_logprob", f, _param(value), self.logits)
+
+    def entropy(self):
+        def f(p):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return apply_jax("bern_entropy", f, self.probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if logits is not None:
+            self.logits = _param(logits)
+            self.probs = apply_jax(
+                "cat_probs", lambda l: jax.nn.softmax(l, axis=-1),
+                self.logits)
+        else:
+            self.probs = _param(probs)
+            self.logits = apply_jax(
+                "cat_logits",
+                lambda p: jnp.log(p / jnp.sum(p, -1, keepdims=True)),
+                self.probs)
+        super().__init__(as_jax(self.probs).shape[:-1])
+        self.num_categories = as_jax(self.probs).shape[-1]
+
+    @property
+    def mean(self):  # reference: undefined for categorical; use E[idx]
+        def f(p):
+            idx = jnp.arange(p.shape[-1], dtype=jnp.float32)
+            return jnp.sum(p * idx, axis=-1)
+        return apply_jax("cat_mean", f, self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        logits = as_jax(self.logits)
+        out_shape = _shape(shape, self.batch_shape)
+        return _wrap_out(jax.random.categorical(
+            key, logits, shape=out_shape).astype(jnp.int64))
+
+    def log_prob(self, value):
+        def f(v, logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return apply_jax("cat_logprob", f, _param(value), self.logits)
+
+    def probabilities(self):
+        return self.probs
+
+    def entropy(self):
+        def f(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return apply_jax("cat_entropy", f, self.logits)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _param(probs)
+        super().__init__(as_jax(self.probs).shape[:-1],
+                         as_jax(self.probs).shape[-1:])
+
+    @property
+    def mean(self):
+        def f(p):
+            return self.total_count * p
+        return apply_jax("multinom_mean", f, self.probs)
+
+    @property
+    def variance(self):
+        def f(p):
+            return self.total_count * p * (1 - p)
+        return apply_jax("multinom_var", f, self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        p = as_jax(self.probs)
+        out_shape = _shape(shape, self.batch_shape)
+        n_cat = p.shape[-1]
+        logits = jnp.log(p)
+        draws = jax.random.categorical(
+            key, logits, shape=out_shape + (self.total_count,))
+        counts = jax.nn.one_hot(draws, n_cat, dtype=jnp.float32).sum(-2)
+        return _wrap_out(counts)
+
+    def log_prob(self, value):
+        def f(v, p):
+            logp = jnp.log(p)
+            coeff = (jax.scipy.special.gammaln(self.total_count + 1.0)
+                     - jnp.sum(jax.scipy.special.gammaln(v + 1.0), -1))
+            return coeff + jnp.sum(v * logp, -1)
+        return apply_jax("multinom_logprob", f, _param(value), self.probs)
+
+    def entropy(self):
+        # no closed form; reference uses the sum-bound approximation
+        def f(p):
+            n = self.total_count
+            p = jnp.clip(p, 1e-7, 1.0)
+            return (-jnp.sum(n * p * jnp.log(p), axis=-1))
+        return apply_jax("multinom_entropy", f, self.probs)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(as_jax(self.rate).shape)
+
+    @property
+    def mean(self):
+        return apply_jax("exp_mean", lambda r: 1.0 / r, self.rate)
+
+    @property
+    def variance(self):
+        return apply_jax("exp_var", lambda r: 1.0 / r ** 2, self.rate)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(rate):
+            u = jax.random.uniform(key, out_shape, jnp.float32,
+                                   minval=1e-7, maxval=1.0)
+            return -jnp.log(u) / rate
+        return apply_jax("exp_rsample", f, self.rate)
+
+    def log_prob(self, value):
+        def f(v, rate):
+            return jnp.where(v >= 0, jnp.log(rate) - rate * v, -jnp.inf)
+        return apply_jax("exp_logprob", f, _param(value), self.rate)
+
+    def entropy(self):
+        return apply_jax("exp_entropy", lambda r: 1.0 - jnp.log(r),
+                         self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        shape = jnp.broadcast_shapes(as_jax(self.concentration).shape,
+                                     as_jax(self.rate).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        def f(a, r):
+            return a / r
+        return apply_jax("gamma_mean", f, self.concentration, self.rate)
+
+    @property
+    def variance(self):
+        def f(a, r):
+            return a / r ** 2
+        return apply_jax("gamma_var", f, self.concentration, self.rate)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(a, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape))
+            return g / r
+        return apply_jax("gamma_rsample", f, self.concentration,
+                         self.rate)
+
+    def log_prob(self, value):
+        def f(v, a, r):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+        return apply_jax("gamma_logprob", f, _param(value),
+                         self.concentration, self.rate)
+
+    def entropy(self):
+        def f(a, r):
+            return (a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * jax.scipy.special.digamma(a))
+        return apply_jax("gamma_entropy", f, self.concentration,
+                         self.rate)
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        shape = jnp.broadcast_shapes(as_jax(self.alpha).shape,
+                                     as_jax(self.beta).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        def f(a, b):
+            return a / (a + b)
+        return apply_jax("beta_mean", f, self.alpha, self.beta)
+
+    @property
+    def variance(self):
+        def f(a, b):
+            s = a + b
+            return a * b / (s ** 2 * (s + 1))
+        return apply_jax("beta_var", f, self.alpha, self.beta)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        k1, k2 = jax.random.split(key)
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+        return apply_jax("beta_rsample", f, self.alpha, self.beta)
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b)))
+        return apply_jax("beta_logprob", f, _param(value), self.alpha,
+                         self.beta)
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply_jax("beta_entropy", f, self.alpha, self.beta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _param(concentration)
+        c = as_jax(self.concentration)
+        super().__init__(c.shape[:-1], c.shape[-1:])
+
+    @property
+    def mean(self):
+        def f(c):
+            return c / jnp.sum(c, -1, keepdims=True)
+        return apply_jax("dir_mean", f, self.concentration)
+
+    @property
+    def variance(self):
+        def f(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+        return apply_jax("dir_var", f, self.concentration)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        c = as_jax(self.concentration)
+        out_shape = _shape(shape, c.shape)
+
+        def f(conc):
+            g = jax.random.gamma(key, jnp.broadcast_to(conc, out_shape))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return apply_jax("dir_rsample", f, self.concentration)
+
+    def log_prob(self, value):
+        def f(v, c):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), -1))
+        return apply_jax("dir_logprob", f, _param(value),
+                         self.concentration)
+
+    def entropy(self):
+        def f(c):
+            dg = jax.scipy.special.digamma
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lnB = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                   - jax.scipy.special.gammaln(c0))
+            return (lnB + (c0 - k) * dg(c0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+        return apply_jax("dir_entropy", f, self.concentration)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = jnp.broadcast_shapes(as_jax(self.loc).shape,
+                                     as_jax(self.scale).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return apply_jax("laplace_var", lambda s: 2 * s ** 2, self.scale)
+
+    @property
+    def stddev(self):
+        return apply_jax("laplace_std",
+                         lambda s: math.sqrt(2.0) * s, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(loc, scale):
+            u = jax.random.uniform(key, out_shape, jnp.float32,
+                                   minval=-0.5 + 1e-7, maxval=0.5)
+            return loc - scale * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u))
+        return apply_jax("laplace_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            return -jnp.abs(v - loc) / scale - jnp.log(2 * scale)
+        return apply_jax("laplace_logprob", f, _param(value), self.loc,
+                         self.scale)
+
+    def entropy(self):
+        return apply_jax("laplace_entropy",
+                         lambda s: 1 + jnp.log(2 * s), self.scale)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = jnp.broadcast_shapes(as_jax(self.loc).shape,
+                                     as_jax(self.scale).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        def f(loc, scale):
+            return loc + scale * np.euler_gamma
+        return apply_jax("gumbel_mean", f, self.loc, self.scale)
+
+    @property
+    def variance(self):
+        def f(scale):
+            return (math.pi ** 2 / 6) * scale ** 2
+        return apply_jax("gumbel_var", f, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(loc, scale):
+            g = jax.random.gumbel(key, out_shape, jnp.float32)
+            return loc + scale * g
+        return apply_jax("gumbel_rsample", f, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, loc, scale):
+            z = (v - loc) / scale
+            return -(z + jnp.exp(-z)) - jnp.log(scale)
+        return apply_jax("gumbel_logprob", f, _param(value), self.loc,
+                         self.scale)
+
+    def entropy(self):
+        def f(scale):
+            return jnp.log(scale) + 1 + np.euler_gamma
+        return apply_jax("gumbel_entropy", f, self.scale)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, … (failures before first success)."""
+
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _param(probs)
+        else:
+            self.probs = apply_jax("geom_probs", jax.nn.sigmoid,
+                                   _param(logits))
+        super().__init__(as_jax(self.probs).shape)
+
+    @property
+    def mean(self):
+        return apply_jax("geom_mean", lambda p: (1 - p) / p, self.probs)
+
+    @property
+    def variance(self):
+        return apply_jax("geom_var", lambda p: (1 - p) / p ** 2,
+                         self.probs)
+
+    def sample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+        p = as_jax(self.probs)
+        u = jax.random.uniform(key, out_shape, jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        return _wrap_out(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, p):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return apply_jax("geom_logprob", f, _param(value), self.probs)
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return apply_jax("geom_entropy", f, self.probs)
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(as_jax(self.rate).shape)
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+        lam = jnp.broadcast_to(as_jax(self.rate), out_shape)
+        return _wrap_out(jax.random.poisson(key, lam).astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def f(v, rate):
+            return (v * jnp.log(rate) - rate
+                    - jax.scipy.special.gammaln(v + 1.0))
+        return apply_jax("poisson_logprob", f, _param(value), self.rate)
+
+    def entropy(self):
+        # Stirling-order approximation (matches reference behavior of not
+        # having a closed form)
+        def f(rate):
+            return 0.5 * jnp.log(2 * math.pi * math.e * rate)
+        return apply_jax("poisson_entropy", f, self.rate)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        shape = jnp.broadcast_shapes(as_jax(self.df).shape,
+                                     as_jax(self.loc).shape,
+                                     as_jax(self.scale).shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        def f(df, scale):
+            return jnp.where(df > 2, scale ** 2 * df / (df - 2), jnp.inf)
+        return apply_jax("t_var", f, self.df, self.scale)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        out_shape = _shape(shape, self.batch_shape)
+
+        def f(df, loc, scale):
+            t = jax.random.t(key, jnp.broadcast_to(df, out_shape))
+            return loc + scale * t
+        return apply_jax("t_rsample", f, self.df, self.loc, self.scale)
+
+    def log_prob(self, value):
+        def f(v, df, loc, scale):
+            z = (v - loc) / scale
+            gl = jax.scipy.special.gammaln
+            return (gl((df + 1) / 2) - gl(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(scale)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return apply_jax("t_logprob", f, _param(value), self.df,
+                         self.loc, self.scale)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (``python/paddle/distribution/kl.py`` parity)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls: Type, q_cls: Type):
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    best, best_score = None, None
+    p_mro, q_mro = type(p).__mro__, type(q).__mro__
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            # most-derived registered pair wins (subclass overrides)
+            score = p_mro.index(pc) + q_mro.index(qc)
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    if best is not None:
+        return best(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply_jax("kl_normal", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def f(pl, ph, ql, qh):
+        inside = jnp.logical_and(ql <= pl, ph <= qh)
+        return jnp.where(inside,
+                         jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+    return apply_jax("kl_uniform", f, p.low, p.high, q.low, q.high)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def f(pp, qp):
+        pp = jnp.clip(pp, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(qp, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return apply_jax("kl_bern", f, p.probs, q.probs)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def f(pl, ql):
+        plog = jax.nn.log_softmax(pl, -1)
+        qlog = jax.nn.log_softmax(ql, -1)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), -1)
+    return apply_jax("kl_cat", f, p.logits, q.logits)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    def f(pr, qr):
+        ratio = qr / pr
+        return jnp.log(pr) - jnp.log(qr) + ratio - 1
+    return apply_jax("kl_exp", f, p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def f(pa, pr, qa, qr):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        return ((pa - qa) * dg(pa) - gl(pa) + gl(qa)
+                + qa * (jnp.log(pr) - jnp.log(qr))
+                + pa * (qr - pr) / pr)
+    return apply_jax("kl_gamma", f, p.concentration, p.rate,
+                     q.concentration, q.rate)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def f(pa, pb, qa, qb):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        lbeta_p = gl(pa) + gl(pb) - gl(pa + pb)
+        lbeta_q = gl(qa) + gl(qb) - gl(qa + qb)
+        return (lbeta_q - lbeta_p
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return apply_jax("kl_beta", f, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(pc, qc):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        p0 = jnp.sum(pc, -1)
+        q0 = jnp.sum(qc, -1)
+        return (gl(p0) - gl(q0)
+                - jnp.sum(gl(pc) - gl(qc), -1)
+                + jnp.sum((pc - qc) * (dg(pc) - dg(p0)[..., None]), -1))
+    return apply_jax("kl_dirichlet", f, p.concentration, q.concentration)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def f(pl, ps, ql, qs):
+        d = jnp.abs(pl - ql)
+        return (jnp.log(qs) - jnp.log(ps)
+                + (ps * jnp.exp(-d / ps) + d) / qs - 1)
+    return apply_jax("kl_laplace", f, p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def f(pp, qp):
+        return ((1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp))
+                + jnp.log(pp) - jnp.log(qp))
+    return apply_jax("kl_geom", f, p.probs, q.probs)

@@ -1,0 +1,207 @@
+"""``paddle.inference`` — deployment predictor
+(``paddle/fluid/inference/api/analysis_predictor.cc`` +
+``python/paddle/inference/`` parity).
+
+TPU-first: the reference loads a ``*.pdmodel`` program, runs analysis/
+fusion passes, and executes zero-copy through ``AnalysisPredictor``. Here
+the artifact produced by ``paddle.jit.save`` already IS the compiled
+program (a serialized jax.export StableHLO module — XLA did the fusion
+at export time), so ``Predictor`` deserializes it once and ``run()``
+executes the AOT module on device. The named-handle API
+(``get_input_handle``/``copy_from_cpu``/``copy_to_cpu``) is preserved so
+reference deployment scripts port unchanged.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3  # TPU rides the custom-device slot in the reference
+
+
+class Config:
+    """``paddle.inference.Config`` parity. GPU/TRT/MKLDNN toggles are
+    accepted for script compatibility; on TPU the program is already an
+    XLA-compiled module, so they record intent only."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle convention: Config("path/model") with implicit suffixes
+        if prog_file and not prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file
+        elif prog_file:
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = None
+        self._params_file = params_file
+        self._precision = PrecisionType.Float32
+        self._memory_pool_mb = 0
+        self._enable_profile = False
+        self._glog_info = False
+        self._optim = True
+
+    def set_model(self, prog_file: str, params_file: str = None):
+        self.__init__(prog_file, params_file)
+
+    def model_dir(self) -> str:
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self) -> str:
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self) -> str:
+        return self._params_file or (self._prefix or "") + ".pdparams"
+
+    # accelerator knobs (recorded; XLA owns placement/fusion on TPU)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._precision = precision
+
+    def disable_gpu(self):
+        pass
+
+    def enable_xpu(self, *a, **k):
+        pass
+
+    def enable_custom_device(self, device_type="tpu", device_id=0):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        self._optim = flag
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Named I/O handle (``paddle_infer::Tensor`` parity)."""
+
+    def __init__(self, name: str, predictor: "Predictor", is_input: bool):
+        self.name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def copy_from_cpu(self, data: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        self._pred._inputs[self.name] = np.ascontiguousarray(data)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            return np.asarray(self._pred._inputs.get(self.name))
+        if self._pred._outputs is None:
+            raise RuntimeError("run() has not been called")
+        return np.asarray(self._pred._outputs[self.name])
+
+    def shape(self):
+        if self._is_input:
+            arr = self._pred._inputs.get(self.name)
+            if arr is not None:
+                return list(arr.shape)
+            return self._pred._input_meta[self.name]["shape"]
+        if self._pred._outputs is not None:
+            return list(np.asarray(
+                self._pred._outputs[self.name]).shape)
+        return None
+
+    def reshape(self, shape):
+        pass  # shapes are fixed at export on TPU
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        self.config = config
+        self._translated = jit_load(config._prefix)
+        if self._translated._exported is None:
+            raise ValueError(
+                f"{config.prog_file()} has no exported program — save "
+                f"the model with paddle.jit.save(layer, path, "
+                f"input_spec=[...])")
+        spec = self._translated.input_spec
+        self._input_names = [
+            s.get("name") or f"x{i}" for i, s in enumerate(spec)]
+        self._input_meta = {
+            n: s for n, s in zip(self._input_names, spec)}
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs = None
+        self._output_names: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        if name not in self._input_names:
+            raise KeyError(name)
+        return Tensor(name, self, is_input=True)
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n] = np.ascontiguousarray(a)
+        missing = [n for n in self._input_names if n not in self._inputs]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [self._inputs[n] for n in self._input_names]
+        out = self._translated(*args)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        from ..framework.core import Tensor as _T
+        arrays = [np.asarray(o.numpy() if isinstance(o, _T) else o)
+                  for o in out]
+        self._output_names = [f"out{i}" for i in range(len(arrays))]
+        self._outputs = dict(zip(self._output_names, arrays))
+        if inputs is not None:
+            return arrays
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names) or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self, is_input=False)
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -293,35 +293,90 @@ class TrainStep:
 # jit.save / jit.load
 # ---------------------------------------------------------------------------
 
+def _spec_to_sds(spec):
+    import numpy as _np
+    shape = [1 if d is None or (isinstance(d, int) and d < 0) else d
+             for d in spec.shape]
+    return jax.ShapeDtypeStruct(tuple(shape), _np.dtype(spec.dtype))
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Export: params via paddle.save + a jax AOT-exported module when
-    possible (``*.pdmodel`` structural stand-in)."""
+    """``paddle.jit.save`` parity (``python/paddle/jit/api.py``): the
+    ``*.pdmodel`` graph artifact becomes a serialized jax.export
+    StableHLO module — the TPU-native deployable program — alongside the
+    ``*.pdparams`` state dict. The exported callable has signature
+    ``(flat_params, *inputs)``."""
     from ..framework.io import save as fsave
     state = layer.state_dict() if hasattr(layer, "state_dict") else {}
     fsave(state, path + ".pdparams")
+    specs = [s for s in (input_spec or []) if isinstance(s, InputSpec)]
     meta = {
         "class": type(layer).__name__,
         "input_spec": [
-            {"shape": s.shape, "dtype": str(s.dtype), "name": s.name}
-            for s in (input_spec or [])
-            if isinstance(s, InputSpec)
+            {"shape": list(s.shape), "dtype": str(np.dtype(s.dtype)),
+             "name": s.name}
+            for s in specs
         ],
     }
     import json
+    if specs and hasattr(layer, "parameters"):
+        was_training = getattr(layer, "training", False)
+        if hasattr(layer, "eval"):
+            layer.eval()
+        binder = _LayerBinder(layer)
+        params = binder.param_arrays()
+        buffers = binder.buffer_arrays()
+
+        def fwd(param_arrays, *inputs):
+            args = tuple(_wrap_out(x) for x in inputs)
+            out, _ = binder.call(param_arrays, buffers, args, {})
+            return _tree_to_arrays(out)
+
+        from jax import export as jexport
+        exported = jexport.export(jax.jit(fwd))(
+            [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params],
+            *[_spec_to_sds(s) for s in specs])
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        meta["param_names"] = [n for n, _ in binder.param_items]
+        meta["exported"] = True
+        if was_training and hasattr(layer, "train"):
+            layer.train()
     with open(path + ".pdmodel.json", "w") as f:
         json.dump(meta, f)
 
 
 class TranslatedLayer:
-    """Loaded inference artifact (state dict + forward via the live class
-    is not recoverable from serialized form; this carries params only)."""
+    """Loaded inference artifact (``TranslatedLayer`` parity): params +
+    the deserialized AOT module; callable when the artifact was exported
+    with an input_spec."""
 
-    def __init__(self, state_dict, meta):
+    def __init__(self, state_dict, meta, exported=None):
         self._state_dict = state_dict
         self._meta = meta
+        self._exported = exported
+        names = meta.get("param_names")
+        if names:
+            self._flat_params = [as_jax(state_dict[n]) for n in names]
+        else:
+            self._flat_params = [as_jax(v) for v in state_dict.values()]
 
     def state_dict(self):
         return self._state_dict
+
+    @property
+    def input_spec(self):
+        return self._meta.get("input_spec", [])
+
+    def __call__(self, *args):
+        if self._exported is None:
+            raise RuntimeError(
+                "artifact was saved without input_spec; only state_dict "
+                "is available")
+        arrays = [as_jax(a) if isinstance(a, Tensor)
+                  else jnp.asarray(np.asarray(a)) for a in args]
+        out = self._exported.call(self._flat_params, *arrays)
+        return _tree_to_tensors(out)
 
 
 def load(path, **configs):
@@ -333,4 +388,10 @@ def load(path, **configs):
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
-    return TranslatedLayer(state, meta)
+    exported = None
+    model_path = path + ".pdmodel"
+    if meta.get("exported") and os.path.exists(model_path):
+        from jax import export as jexport
+        with open(model_path, "rb") as f:
+            exported = jexport.deserialize(f.read())
+    return TranslatedLayer(state, meta, exported)

@@ -1,6 +1,8 @@
 """``paddle.utils`` — misc helpers + custom-op extension shim."""
 from __future__ import annotations
 
+from . import cpp_extension
+
 __all__ = ["try_import", "unique_name", "deprecated", "run_check"]
 
 _name_counters = {}

@@ -1,0 +1,126 @@
+"""Custom C++ op extension (paddle.utils.cpp_extension parity):
+compile → register → call eagerly and under jax.jit (pure_callback)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import cpp_extension
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    src_dir = tmp_path_factory.mktemp("ext_src")
+    src = src_dir / "my_ops.cc"
+    src.write_text(textwrap.dedent(r'''
+        #include "paddle_tpu_ext.h"
+        static void relu_fwd(const PTE_Tensor* in, int n_in,
+                             PTE_Tensor* out, int n_out) {
+          const float* x = (const float*)in[0].data;
+          float* y = (float*)out[0].data;
+          for (int64_t i = 0; i < pte_numel(&in[0]); ++i)
+            y[i] = x[i] > 0 ? x[i] : 0;
+        }
+        PTE_REGISTER_OP(custom_relu, relu_fwd, 1);
+
+        static void addmul(const PTE_Tensor* in, int n_in,
+                           PTE_Tensor* out, int n_out) {
+          const float* a = (const float*)in[0].data;
+          const float* b = (const float*)in[1].data;
+          float* s = (float*)out[0].data;
+          float* m = (float*)out[1].data;
+          for (int64_t i = 0; i < pte_numel(&in[0]); ++i) {
+            s[i] = a[i] + b[i];
+            m[i] = a[i] * b[i];
+          }
+        }
+        PTE_REGISTER_OP(custom_addmul, addmul, 2);
+
+        static void rowsum(const PTE_Tensor* in, int n_in,
+                           PTE_Tensor* out, int n_out) {
+          const float* x = (const float*)in[0].data;
+          float* y = (float*)out[0].data;
+          int64_t rows = in[0].shape[0], cols = in[0].shape[1];
+          for (int64_t r = 0; r < rows; ++r) {
+            y[r] = 0;
+            for (int64_t c = 0; c < cols; ++c) y[r] += x[r*cols + c];
+          }
+        }
+        PTE_REGISTER_OP(custom_rowsum, rowsum, 1);
+    '''))
+    return cpp_extension.load("my_test_ops", [str(src)],
+                              build_directory=str(src_dir))
+
+
+def test_registry_enumeration(ext):
+    assert set(ext.op_names()) == {"custom_relu", "custom_addmul",
+                                   "custom_rowsum"}
+
+
+def test_eager_unary(ext):
+    x = paddle.to_tensor(np.asarray([-1., 2., -3., 4.], np.float32))
+    y = ext.custom_relu(x)
+    np.testing.assert_array_equal(y.numpy(), [0., 2., 0., 4.])
+
+
+def test_eager_multi_output(ext):
+    a = paddle.to_tensor(np.asarray([1., 2.], np.float32))
+    b = paddle.to_tensor(np.asarray([3., 4.], np.float32))
+    s, m = ext.custom_addmul(a, b)
+    np.testing.assert_array_equal(s.numpy(), [4., 6.])
+    np.testing.assert_array_equal(m.numpy(), [3., 8.])
+
+
+def test_custom_shape_fn(ext):
+    ext.custom_rowsum.set_shape_fn(
+        lambda spec0: [((spec0[0][0],), spec0[1])])
+    x = paddle.to_tensor(
+        np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = ext.custom_rowsum(x)
+    np.testing.assert_array_equal(y.numpy(), [3., 12.])
+
+
+def test_under_jit_pure_callback(ext):
+    import jax
+    from paddle_tpu.framework.core import as_jax
+
+    @jax.jit
+    def f(a):
+        t = paddle.to_tensor(a)
+        return as_jax(ext.custom_relu(t))
+
+    out = f(np.asarray([-5., 5., -1.], np.float32))
+    np.testing.assert_array_equal(np.asarray(out), [0., 5., 0.])
+
+
+def test_rebuild_cache(ext):
+    """Same sources → cached .so (no recompilation)."""
+    lib = ext._lib_path
+    mtime = os.path.getmtime(lib)
+    src = os.path.join(os.path.dirname(lib), "my_ops.cc")
+    mod2 = cpp_extension.load("my_test_ops", [src],
+                              build_directory=os.path.dirname(lib))
+    assert mod2._lib_path == lib
+    assert os.path.getmtime(mod2._lib_path) == mtime
+
+
+def test_setup_api(tmp_path):
+    src = tmp_path / "neg.cc"
+    src.write_text(textwrap.dedent(r'''
+        #include "paddle_tpu_ext.h"
+        static void neg(const PTE_Tensor* in, int n_in,
+                        PTE_Tensor* out, int n_out) {
+          const float* x = (const float*)in[0].data;
+          float* y = (float*)out[0].data;
+          for (int64_t i = 0; i < pte_numel(&in[0]); ++i) y[i] = -x[i];
+        }
+        PTE_REGISTER_OP(custom_neg, neg, 1);
+    '''))
+    mod = cpp_extension.setup(
+        name="neg_ext",
+        ext_modules=cpp_extension.CppExtension(
+            sources=[str(src)], build_directory=str(tmp_path)))
+    x = paddle.to_tensor(np.asarray([1., -2.], np.float32))
+    np.testing.assert_array_equal(mod.custom_neg(x).numpy(), [-1., 2.])

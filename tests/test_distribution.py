@@ -1,0 +1,193 @@
+"""paddle.distribution: moments/log_prob vs scipy-free numpy oracles,
+sampling statistics, KL registry, gradient flow through log_prob."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Beta, Categorical,
+                                     Dirichlet, Exponential, Gamma,
+                                     Geometric, Gumbel, Laplace,
+                                     LogNormal, Multinomial, Normal,
+                                     Poisson, StudentT, Uniform,
+                                     kl_divergence, register_kl)
+
+
+def test_normal_moments_logprob():
+    d = Normal(loc=2.0, scale=3.0)
+    assert np.isclose(float(d.mean), 2.0)
+    assert np.isclose(float(d.variance), 9.0)
+    v = 2.5
+    expect = (-((v - 2.0) ** 2) / 18.0 - math.log(3.0)
+              - 0.5 * math.log(2 * math.pi))
+    assert np.isclose(float(d.log_prob(paddle.to_tensor(v))), expect,
+                      atol=1e-6)
+    assert np.isclose(float(d.entropy()),
+                      0.5 + 0.5 * math.log(2 * math.pi) + math.log(3.0))
+    assert np.isclose(float(d.cdf(paddle.to_tensor(2.0))), 0.5, atol=1e-6)
+
+
+def test_normal_sampling_stats():
+    paddle.seed(0)
+    d = Normal(loc=1.0, scale=2.0)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 1.0) < 0.06
+    assert abs(s.std() - 2.0) < 0.06
+
+
+def test_normal_rsample_grad():
+    loc = paddle.to_tensor(0.5)
+    loc.stop_gradient = False
+    d = Normal(loc=loc, scale=1.0)
+    paddle.seed(1)
+    s = d.rsample([64])
+    s.sum().backward()
+    assert np.isclose(float(loc.grad), 64.0)  # d/dloc sum(loc + eps)
+
+
+def test_logprob_grad_trains_params():
+    """MLE via log_prob.backward(): loc moves toward the data mean."""
+    loc = paddle.to_tensor(0.0)
+    loc.stop_gradient = False
+    data = paddle.to_tensor(np.full((32,), 3.0, np.float32))
+    for _ in range(50):
+        d = Normal(loc=loc, scale=1.0)
+        nll = -d.log_prob(data).sum()
+        nll.backward()
+        with paddle.no_grad():
+            loc.set_value(loc.numpy() - 0.01 * loc.grad.numpy())
+        loc.clear_grad()
+        loc.stop_gradient = False
+    assert abs(float(loc) - 3.0) < 0.2
+
+
+def test_uniform():
+    d = Uniform(low=1.0, high=3.0)
+    assert np.isclose(float(d.mean), 2.0)
+    assert np.isclose(float(d.entropy()), math.log(2.0))
+    assert np.isclose(float(d.log_prob(paddle.to_tensor(1.5))),
+                      -math.log(2.0))
+    assert float(d.log_prob(paddle.to_tensor(5.0))) == -np.inf
+    paddle.seed(0)
+    s = d.sample([5000]).numpy()
+    assert s.min() >= 1.0 and s.max() < 3.0
+
+
+def test_bernoulli_categorical():
+    b = Bernoulli(probs=0.3)
+    assert np.isclose(float(b.mean), 0.3)
+    assert np.isclose(float(b.variance), 0.21)
+    assert np.isclose(float(b.log_prob(paddle.to_tensor(1.0))),
+                      math.log(0.3), atol=1e-5)
+    c = Categorical(probs=np.asarray([0.2, 0.3, 0.5], np.float32))
+    assert np.isclose(float(c.log_prob(paddle.to_tensor(2))),
+                      math.log(0.5), atol=1e-5)
+    ent = -sum(p * math.log(p) for p in (0.2, 0.3, 0.5))
+    assert np.isclose(float(c.entropy()), ent, atol=1e-5)
+    paddle.seed(0)
+    s = c.sample([8000]).numpy()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+
+def test_exponential_gamma_beta():
+    e = Exponential(rate=2.0)
+    assert np.isclose(float(e.mean), 0.5)
+    assert np.isclose(float(e.log_prob(paddle.to_tensor(1.0))),
+                      math.log(2.0) - 2.0, atol=1e-6)
+    g = Gamma(concentration=3.0, rate=2.0)
+    assert np.isclose(float(g.mean), 1.5)
+    assert np.isclose(float(g.variance), 0.75)
+    bt = Beta(alpha=2.0, beta=3.0)
+    assert np.isclose(float(bt.mean), 0.4)
+    paddle.seed(0)
+    s = bt.sample([8000]).numpy()
+    assert abs(s.mean() - 0.4) < 0.02
+
+
+def test_dirichlet_multinomial():
+    d = Dirichlet(np.asarray([1.0, 2.0, 3.0], np.float32))
+    np.testing.assert_allclose(d.mean.numpy(), [1/6, 2/6, 3/6],
+                               rtol=1e-5)
+    paddle.seed(0)
+    s = d.sample([2000]).numpy()
+    np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+    np.testing.assert_allclose(s.mean(0), [1/6, 2/6, 3/6], atol=0.03)
+
+    m = Multinomial(10, np.asarray([0.5, 0.5], np.float32))
+    np.testing.assert_allclose(m.mean.numpy(), [5.0, 5.0])
+    paddle.seed(0)
+    counts = m.sample([500]).numpy()
+    np.testing.assert_allclose(counts.sum(-1), 10.0)
+    # log P(X=[5,5]) = C(10,5) 0.5^10
+    expect = math.log(math.comb(10, 5) * 0.5 ** 10)
+    got = float(m.log_prob(paddle.to_tensor(
+        np.asarray([5.0, 5.0], np.float32))))
+    assert np.isclose(got, expect, atol=1e-5)
+
+
+def test_laplace_gumbel_geometric_poisson_studentt_lognormal():
+    l = Laplace(loc=0.0, scale=1.0)
+    assert np.isclose(float(l.log_prob(paddle.to_tensor(0.0))),
+                      -math.log(2.0))
+    g = Gumbel(loc=0.0, scale=1.0)
+    assert np.isclose(float(g.mean), np.euler_gamma, atol=1e-6)
+    geo = Geometric(probs=0.25)
+    assert np.isclose(float(geo.mean), 3.0)
+    assert np.isclose(float(geo.log_prob(paddle.to_tensor(2.0))),
+                      math.log(0.75 ** 2 * 0.25), atol=1e-6)
+    p = Poisson(rate=4.0)
+    assert np.isclose(float(p.log_prob(paddle.to_tensor(3.0))),
+                      math.log(4.0 ** 3 * math.exp(-4.0) / 6), atol=1e-5)
+    t = StudentT(df=5.0, loc=0.0, scale=1.0)
+    assert np.isclose(float(t.variance), 5.0 / 3.0, atol=1e-5)
+    ln = LogNormal(loc=0.0, scale=0.5)
+    assert np.isclose(float(ln.mean), math.exp(0.125), atol=1e-5)
+
+
+def test_kl_registry():
+    p = Normal(0.0, 1.0)
+    q = Normal(1.0, 2.0)
+    expect = (math.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5)
+    assert np.isclose(float(kl_divergence(p, q)), expect, atol=1e-6)
+    # identical distributions -> 0
+    for pair in [
+        (Uniform(0.0, 1.0), Uniform(0.0, 1.0)),
+        (Bernoulli(probs=0.4), Bernoulli(probs=0.4)),
+        (Exponential(2.0), Exponential(2.0)),
+        (Gamma(2.0, 3.0), Gamma(2.0, 3.0)),
+        (Beta(2.0, 3.0), Beta(2.0, 3.0)),
+        (Laplace(0.0, 1.0), Laplace(0.0, 1.0)),
+        (Geometric(probs=0.3), Geometric(probs=0.3)),
+    ]:
+        assert abs(float(kl_divergence(*pair))) < 1e-5, type(pair[0])
+    c1 = Categorical(probs=np.asarray([0.2, 0.8], np.float32))
+    c2 = Categorical(probs=np.asarray([0.5, 0.5], np.float32))
+    expect = 0.2 * math.log(0.4) + 0.8 * math.log(1.6)
+    assert np.isclose(float(kl_divergence(c1, c2)), expect, atol=1e-5)
+
+
+def test_register_kl_custom():
+    class MyDist(Normal):
+        pass
+
+    @register_kl(MyDist, MyDist)
+    def _kl_my(p, q):
+        return paddle.to_tensor(42.0)
+
+    assert float(kl_divergence(MyDist(0., 1.), MyDist(0., 1.))) == 42.0
+    with pytest.raises(NotImplementedError):
+        kl_divergence(Normal(0., 1.), Uniform(0., 1.))
+
+
+def test_montecarlo_kl_matches_analytic():
+    """Sampled KL estimate agrees with the closed form (cross-checks
+    both log_prob and sampling)."""
+    paddle.seed(3)
+    p = Gamma(concentration=2.0, rate=1.0)
+    q = Gamma(concentration=3.0, rate=2.0)
+    s = p.sample([20000])
+    mc = float((p.log_prob(s) - q.log_prob(s)).mean())
+    analytic = float(kl_divergence(p, q))
+    assert abs(mc - analytic) < 0.05, (mc, analytic)

@@ -1,0 +1,120 @@
+"""Inference stack: jit.save exports an AOT StableHLO module; the
+Config/Predictor API (AnalysisPredictor parity) runs it and matches
+eager outputs."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import InputSpec
+
+
+def _make_mlp():
+    paddle.seed(11)
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(16, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, 4))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    model = _make_mlp()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(3, 16).astype(np.float32))
+    model.eval()
+    expected = model(x).numpy()
+
+    path = str(tmp_path / "mlp")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([3, 16], "float32", "x")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdparams")
+
+    loaded = paddle.jit.load(path)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_load_state_dict_only(tmp_path):
+    model = _make_mlp()
+    path = str(tmp_path / "params_only")
+    paddle.jit.save(model, path)  # no input_spec -> params only
+    loaded = paddle.jit.load(path)
+    assert set(loaded.state_dict().keys()) == set(
+        model.state_dict().keys())
+    with pytest.raises(RuntimeError):
+        loaded(paddle.to_tensor(np.zeros((3, 16), np.float32)))
+
+
+def test_predictor_named_handles(tmp_path):
+    model = _make_mlp()
+    x = np.random.RandomState(1).randn(3, 16).astype(np.float32)
+    model.eval()
+    expected = model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "deploy")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([3, 16], "float32", "x")])
+
+    from paddle_tpu import inference
+    config = inference.Config(path)
+    predictor = inference.create_predictor(config)
+
+    assert predictor.get_input_names() == ["x"]
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(x)
+    assert predictor.run()
+    out_name = predictor.get_output_names()[0]
+    out = predictor.get_output_handle(out_name).copy_to_cpu()
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_run_list_api(tmp_path):
+    model = _make_mlp()
+    x = np.random.RandomState(2).randn(3, 16).astype(np.float32)
+    model.eval()
+    expected = model(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "deploy2")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([3, 16], "float32", "x")])
+    from paddle_tpu import inference
+    predictor = inference.create_predictor(inference.Config(path))
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], expected, rtol=1e-5, atol=1e-6)
+
+
+def test_exported_artifact_survives_fresh_weights(tmp_path):
+    """The .pdmodel captures the program; .pdparams carries weights —
+    the predictor must compute with SAVED weights, not live ones."""
+    model = _make_mlp()
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 16).astype(np.float32))
+    model.eval()
+    expected = model(x).numpy()
+    path = str(tmp_path / "frozen")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([2, 16], "float32", "x")])
+    # mutate live weights after save
+    for p in model.parameters():
+        p.set_value(np.zeros(p.shape, np.float32))
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(loaded(x).numpy(), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lenet_export(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    model = LeNet()
+    model.eval()
+    x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+    expected = model(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "lenet")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([2, 1, 28, 28], "float32",
+                                          "image")])
+    from paddle_tpu import inference
+    predictor = inference.create_predictor(inference.Config(path))
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], expected, rtol=1e-4, atol=1e-5)
