@@ -111,8 +111,57 @@ class PipelineLayer(Layer):
                 return stage
         return self._num_stages - 1
 
-    def forward(self, x):
-        for kind, obj, ffn in self._items:
+    def _engine_route(self):
+        """(pre, body, post) when a homogeneous run of layers can ride the
+        shard_map pipeline engine; None → sequential fallback. The
+        heterogeneous first/last-stage work (embedding, head, loss prep)
+        stays outside the ring — the scan-pipeline equivalent of the
+        reference's first/last-stage special-casing."""
+        if getattr(self, "_route_cache", "unset") != "unset":
+            return self._route_cache
+        self._route_cache = None
+        k = self._num_stages
+        if k <= 1 or mesh_axis_size("pp") < k:
+            return None
+        from ...jit import _LayerBinder
+
+        def sig(item):
+            kind, obj, _ = item
+            if kind != "layer":
+                return None
+            shapes = tuple((n, tuple(p.shape), str(p.dtype))
+                           for n, p in _LayerBinder(obj).param_items)
+            return (type(obj).__name__, shapes)
+
+        sigs = [sig(it) for it in self._items]
+        best = (0, 0)  # (length, start)
+        i = 0
+        n = len(sigs)
+        while i < n:
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < n and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        length, start = best
+        usable = (length // k) * k
+        if usable < k or usable < 2:
+            return None
+        # align the run's tail with the segment boundary: keep the last
+        # `usable` homogeneous layers in the body
+        start = start + (length - usable)
+        self._route_cache = (self._items[:start],
+                             [obj for _, obj, _ in
+                              self._items[start:start + usable]],
+                             self._items[start + usable:])
+        return self._route_cache
+
+    def _run_items(self, items, x):
+        for kind, obj, ffn in items:
             if kind == "layer":
                 x = obj(x)
             elif kind == "shared":
@@ -121,6 +170,61 @@ class PipelineLayer(Layer):
             else:
                 x = obj(x)
         return x
+
+    def _pipe_body(self, body, x):
+        from ...jit import _LayerBinder
+        from ..pipeline import pipeline_apply
+        from ..shard_utils import current_mesh
+        mesh = current_mesh()
+        pp = self._num_stages
+        lps = len(body) // pp
+        binder = _LayerBinder(body[0])
+        n_p = len(binder.param_items)
+        param_tensors = [p for lay in body
+                         for _, p in _LayerBinder(lay).param_items]
+        n_micro = getattr(self, "_num_micro", None) or pp
+        recompute = self._recompute_interval and self.training
+
+        def one_layer(params_local, h, i):
+            arrs = [p[i] for p in params_local]
+            out, _ = binder.call(arrs, [], (_wrap_out(h),), {})
+            return as_jax(out)
+
+        def stage_fn(params_local, h):
+            f = one_layer
+            if recompute:
+                f = jax.checkpoint(one_layer, static_argnums=(2,))
+            for i in range(lps):
+                h = f(params_local, h, i)
+            return h
+
+        def run_pipe(h_a, *flat):
+            per = [flat[kk * n_p:(kk + 1) * n_p]
+                   for kk in range(len(body))]
+            stacked = [
+                jnp.stack([jnp.stack([per[s * lps + i][j]
+                                      for i in range(lps)])
+                           for s in range(pp)])
+                for j in range(n_p)
+            ]
+            b = h_a.shape[0]
+            nm = n_micro
+            while b % nm != 0:
+                nm -= 1
+            mbs = h_a.reshape((nm, b // nm) + h_a.shape[1:])
+            out = pipeline_apply(stage_fn, stacked, mbs, mesh=mesh)
+            return out.reshape(h_a.shape)
+
+        return apply_jax("pipeline_body", run_pipe, x, *param_tensors)
+
+    def forward(self, x):
+        route = self._engine_route()
+        if route is None:
+            return self._run_items(self._items, x)
+        pre, body, post = route
+        x = self._run_items(pre, x)
+        x = self._pipe_body(body, x)
+        return self._run_items(post, x)
 
 
 class PipelineParallel(Layer):
@@ -151,10 +255,28 @@ class PipelineParallel(Layer):
         if not isinstance(labels, Tensor):
             labels = Tensor(labels)
         n_micro = self.accumulate_steps
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if isinstance(self._layers, PipelineLayer) and \
+                self._layers._engine_route() is not None:
+            # engine path: all microbatches ride the scan pipeline in ONE
+            # call — grad accumulation is the sum inside the scan, so the
+            # python-loop schedule below would only add bubbles.
+            self._layers._num_micro = n_micro
+            out = self._layers(inputs)
+            loss = loss_fn(out, labels) if loss_fn is not None else out
+            if scaler is not None:
+                scaler.scale(loss).backward()
+                scaler.step(optimizer)
+            else:
+                loss.backward()
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return _wrap_out(as_jax(loss))
         bsz = inputs.shape[0]
         mb = max(bsz // n_micro, 1)
         total = 0.0
-        loss_fn = getattr(self._layers, "_loss_fn", None)
         for i in range(0, bsz, mb):
             x = inputs[i:i + mb]
             y = labels[i:i + mb]
@@ -215,21 +337,49 @@ class ShardingParallel(TensorParallel):
 
 
 class _RNGStateTracker:
-    """model-parallel RNG tracker (``get_rng_state_tracker`` parity) —
-    dropout seeds differ across mp ranks via fold_in."""
+    """Model-parallel RNG tracker (``get_rng_state_tracker`` parity,
+    reference ``fleet/meta_parallel/parallel_layers/random.py``).
+
+    Two named streams matter: ``global_seed`` (identical on every mp
+    rank — e.g. attention dropout over replicated activations) and
+    ``local_seed`` (distinct per mp rank — dropout over TP-sharded
+    activations must decorrelate). Entering ``rng_state(name)`` swaps the
+    framework's functional PRNG key for one derived as
+    ``fold_in(base_seed, stream)`` and, for local streams, additionally
+    ``fold_in(mp_rank)`` — so TP dropout is decorrelated where it must be
+    and reproducible everywhere."""
+
+    LOCAL_STREAMS = ("local_seed", "model_parallel_rng")
 
     def __init__(self):
-        self._states = {}
+        self._seeds = {}
 
     def add(self, name, seed):
-        self._states[name] = seed
+        if name in self._seeds and self._seeds[name] != seed:
+            raise ValueError(f"seed for state {name!r} already set")
+        self._seeds[name] = int(seed)
+
+    def get_states_tracker(self):
+        return dict(self._seeds)
 
     def rng_state(self, name="global_seed"):
         import contextlib
 
         @contextlib.contextmanager
         def ctx():
-            yield
+            from ...framework import random as frandom
+            hcg = get_hcg()
+            seed = self._seeds.get(name, 0)
+            key = jax.random.PRNGKey(seed) if seed else frandom.get_key()
+            key = jax.random.fold_in(key, abs(hash(name)) % (2 ** 31))
+            if name in self.LOCAL_STREAMS and hcg is not None:
+                key = jax.random.fold_in(
+                    key, hcg.get_model_parallel_rank())
+            prev = frandom.swap_key(key)
+            try:
+                yield
+            finally:
+                frandom.swap_key(prev)
         return ctx()
 
 

@@ -39,13 +39,16 @@ def stack_stage_params(per_stage_params: List[Any]):
 
 def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
                    microbatches, mesh: Mesh = None, axis: str = "pp",
-                   extra_inputs=None):
+                   extra_inputs=None, batch_axes=("dp", "sharding")):
     """Run the pipelined forward.
 
     stage_fn(params_local, x, *extra) -> y  — one stage's compute; must
         be shape-preserving on x (homogeneous stages).
     stacked_params: pytree, leaves [pp, ...] (will be sharded over axis).
     microbatches: [n_micro, mb, ...] array; fed to stage 0 in order.
+    batch_axes: mesh axes (those present with size>1) that shard the
+        per-microbatch batch dim (dim 1) inside the pipe — data parallel
+        composes with pp without leaving the shard_map.
     Returns [n_micro, mb, ...] outputs (valid on every device — the last
     stage's results are broadcast over the pp axis).
     """
@@ -57,7 +60,13 @@ def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
 
     in_spec_params = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
-    other_axes = tuple(a for a in mesh.axis_names if a != axis)
+    live_batch = tuple(a for a in (batch_axes or ())
+                       if a != axis and mesh.shape.get(a, 1) > 1
+                       and microbatches.shape[1]
+                       % mesh.shape.get(a, 1) == 0)
+    mb_spec = P(None, live_batch if len(live_batch) > 1
+                else (live_batch[0] if live_batch else None),
+                *([None] * (microbatches.ndim - 2)))
 
     def per_device(params_block, mbs, *extra_args):
         # params_block leaves: [1, ...] (this stage's slice)
@@ -87,10 +96,11 @@ def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
         valid = jax.lax.dynamic_slice_in_dim(outs, pp - 1, n_micro, axis=0)
         return jax.lax.psum(valid, axis)
 
-    from .shard_utils import shard_map_compat
+    from .shard_utils import manual_region, shard_map_compat
     mapped = shard_map_compat(
         per_device, mesh,
-        (in_spec_params, P(*([None] * microbatches.ndim)),
+        (in_spec_params, mb_spec,
          *[P(*([None] * jnp.ndim(e))) for e in extra]),
-        P(*([None] * microbatches.ndim)))
-    return mapped(stacked_params, microbatches, *extra)
+        mb_spec)
+    with manual_region():
+        return mapped(stacked_params, microbatches, *extra)

@@ -17,11 +17,37 @@ from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
 from . import env as _env
 
 __all__ = ["P", "mesh_axis_size", "annotate_param", "constraint",
-           "place_param", "batch_shard", "current_mesh"]
+           "place_param", "batch_shard", "current_mesh", "manual_region",
+           "in_manual_region"]
 
 
 def current_mesh() -> Optional[Mesh]:
     return _env.get_mesh()
+
+
+# Inside a shard_map body the mesh axes are Manual — GSPMD constraint /
+# reshard ops emitted there (by TP layers etc.) are invalid. The pipeline
+# engine traces its stage functions under this flag so the sharding
+# facades become identities; the shard_map in/out specs already define
+# the data placement.
+import contextlib as _contextlib
+import threading as _threading
+
+_manual_tls = _threading.local()
+
+
+@_contextlib.contextmanager
+def manual_region():
+    prev = getattr(_manual_tls, "on", False)
+    _manual_tls.on = True
+    try:
+        yield
+    finally:
+        _manual_tls.on = prev
+
+
+def in_manual_region() -> bool:
+    return getattr(_manual_tls, "on", False)
 
 
 def shard_map_compat(f, mesh, in_specs, out_specs):
@@ -80,7 +106,7 @@ def _is_tracer(x):
 def constraint(x, *spec):
     """with_sharding_constraint as a differentiable identity op."""
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or in_manual_region():
         return x if isinstance(x, Tensor) else _wrap_out(as_jax(x))
     sharding = NamedSharding(mesh, P(*spec))
 
@@ -95,7 +121,7 @@ def constraint(x, *spec):
 def batch_shard(x, axes=("dp", "sharding")):
     """Shard the leading (batch) dim over the data-parallel axes."""
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or in_manual_region():
         return x
     live = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
     if not live:

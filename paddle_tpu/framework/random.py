@@ -56,6 +56,26 @@ def set_functional_key(key):
     _grad_state.functional_key = key
 
 
+def get_key():
+    """The active PRNG key (the per-step functional key when tracing)."""
+    from .core import _grad_state
+    fk = getattr(_grad_state, "functional_key", None)
+    return fk if fk is not None else _state.key
+
+
+def swap_key(key):
+    """Install ``key`` as the active PRNG key; returns the previous one.
+    Used by the mp RNG tracker to scope named dropout streams."""
+    from .core import _grad_state
+    fk = getattr(_grad_state, "functional_key", None)
+    if fk is not None:
+        _grad_state.functional_key = key
+        return fk
+    prev = _state.key
+    _state.key = key
+    return prev
+
+
 def get_cuda_rng_state():
     return get_rng_state()
 

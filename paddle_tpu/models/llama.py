@@ -38,7 +38,7 @@ from ..incubate.nn.functional import (fused_rotary_position_embedding,
                                       swiglu)
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "LlamaPretrainingCriterion"]
+           "LlamaForCausalLMPipe", "LlamaPretrainingCriterion"]
 
 
 @dataclass
@@ -268,6 +268,9 @@ class LlamaForCausalLM(Layer):
     def forward(self, input_ids, labels=None, attention_mask=None,
                 position_ids=None):
         h = self.llama(input_ids, attention_mask, position_ids)
+        return self._head_and_loss(h, labels)
+
+    def _head_and_loss(self, h, labels):
         if self.config.tie_word_embeddings:
             from ..ops.linalg import matmul
             logits = matmul(h, self.llama.embed_tokens.weight,
@@ -277,3 +280,95 @@ class LlamaForCausalLM(Layer):
         if labels is not None:
             return self.criterion(logits, labels)
         return logits
+
+
+class LlamaForCausalLMPipe(LlamaForCausalLM):
+    """Pipeline-parallel Llama (``LlamaForCausalLMPipe`` parity —
+    PaddleNLP ``llama/modeling_pp.py`` over fleet
+    ``meta_parallel/pipeline_parallel.py``'s 1F1B schedule).
+
+    TPU-first schedule: the homogeneous decoder stack runs through the
+    shard_map + ppermute scan pipeline (``distributed/pipeline.py``);
+    the heterogeneous first/last-stage work (embedding, final norm, head,
+    loss) executes outside the ring in GSPMD land. Per-microbatch grad
+    accumulation and the 1F1B/FThenB bookkeeping of the reference are
+    subsumed by differentiating through the scan — the backward ring is
+    the transposed ppermute, and XLA overlaps stage compute with the
+    permutes. Parameter layout and state_dict are identical to
+    ``LlamaForCausalLM`` (same sublayers), so pp=1 checkpoints load
+    unchanged and numeric parity is testable layer-for-layer."""
+
+    def __init__(self, config: LlamaConfig, num_micro_batches=None,
+                 num_stages=None):
+        super().__init__(config)
+        self.num_micro_batches = num_micro_batches
+        self._num_stages = num_stages
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                position_ids=None):
+        from ..distributed.shard_utils import current_mesh
+        mesh = current_mesh()
+        pp = self._num_stages or (
+            mesh.shape.get("pp", 1) if mesh is not None else 1)
+        n_layers = self.config.num_hidden_layers
+        if pp <= 1 or mesh is None or mesh.shape.get("pp", 1) <= 1 \
+                or n_layers % pp != 0:
+            return super().forward(input_ids, labels, attention_mask,
+                                   position_ids)
+        lps = n_layers // pp
+
+        core = self.llama
+        input_ids = batch_shard(input_ids)
+        h = core.embed_tokens(input_ids)
+        b, l = h.shape[0], h.shape[1]
+        cos = as_jax(core._rope_cos)[:l]
+        sin = as_jax(core._rope_sin)[:l]
+
+        n_micro = self.num_micro_batches or pp
+        n_micro = min(n_micro, b)
+        while b % n_micro != 0:  # static python loop at trace time
+            n_micro -= 1
+
+        from ..jit import _LayerBinder
+        binder = _LayerBinder(core.layers[0])
+        param_tensors = [p for lay in core.layers
+                         for _, p in _LayerBinder(lay).param_items]
+        n_p = len(binder.param_items)
+        recompute = self.config.recompute and self.training
+
+        def one_layer(params_local, x, cos_a, sin_a, i):
+            arrs = [p[i] for p in params_local]
+            out, _ = binder.call(
+                arrs, [], (_wrap_out(x), _wrap_out(cos_a),
+                           _wrap_out(sin_a)), {})
+            return as_jax(out)
+
+        def stage_fn(params_local, x, cos_a, sin_a):
+            f = one_layer
+            if recompute:
+                f = jax.checkpoint(one_layer, static_argnums=(4,))
+            for i in range(lps):
+                x = f(params_local, x, cos_a, sin_a, i)
+            return x
+
+        from ..distributed.pipeline import pipeline_apply
+
+        def run_pipe(h_a, cos_a, sin_a, *flat):
+            per = [flat[k * n_p:(k + 1) * n_p] for k in range(n_layers)]
+            # leaves [pp, lps, ...] — stage-major stacking
+            stacked = [
+                jnp.stack([jnp.stack([per[s * lps + i][j]
+                                      for i in range(lps)])
+                           for s in range(pp)])
+                for j in range(n_p)
+            ]
+            mbs = h_a.reshape((n_micro, h_a.shape[0] // n_micro)
+                              + h_a.shape[1:])
+            out = pipeline_apply(stage_fn, stacked, mbs, mesh=mesh,
+                                 extra_inputs=(cos_a, sin_a))
+            return out.reshape(h_a.shape)
+
+        h = apply_jax("llama_pipeline", run_pipe, h,
+                      _wrap_out(cos), _wrap_out(sin), *param_tensors)
+        h = core.norm(h)
+        return self._head_and_loss(h, labels)

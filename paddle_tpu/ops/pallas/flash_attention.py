@@ -17,9 +17,12 @@ import numpy as np
 from ...framework.core import Tensor, apply_jax, as_jax
 
 
+from .flash_attention_kernel import pallas_flash_attention
+
+
 def _xla_attention(q, k, v, bias, is_causal, scale):
-    """Reference path: jax.nn.dot_product_attention (XLA fuses softmax chain;
-    on TPU the compiler emits a flash-style fused loop)."""
+    """Fallback path: jax.nn.dot_product_attention (XLA fuses the softmax
+    chain; fine for short sequences / biased attention)."""
     return jax.nn.dot_product_attention(
         q, k, v, bias=bias, is_causal=is_causal, scale=scale)
 
@@ -31,19 +34,33 @@ def _pallas_available():
         return False
 
 
+def _kernel_eligible(q, bias):
+    # seq divisible into >=128 lanes, head_dim tile-friendly, no dense bias
+    # (FlashMask lowers its compact form separately)
+    return (bias is None and q.shape[1] % 128 == 0 and q.shape[1] >= 256
+            and q.shape[-1] in (64, 128, 256))
+
+
+_fallback_logged = False
+
+
 def flash_attention_core(q, k, v, bias=None, is_causal=False, scale=None):
-    """Pure-array flash attention; q/k/v: [B, L, H, D]."""
+    """Pure-array flash attention; q/k/v: [B, L, H, D]. K/V already
+    repeated to the query head count (GQA expansion at call site)."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if _pallas_available():
-        try:
-            from .flash_attention_kernel import pallas_flash_attention
-            if bias is None and q.shape[1] >= 256 \
-                    and q.shape[-1] in (64, 128, 256):
-                return pallas_flash_attention(q, k, v, causal=is_causal,
-                                              sm_scale=scale)
-        except Exception:
-            pass
+        if _kernel_eligible(q, bias):
+            return pallas_flash_attention(q, k, v, causal=is_causal,
+                                          sm_scale=scale)
+        global _fallback_logged
+        if not _fallback_logged:
+            _fallback_logged = True
+            import warnings
+            warnings.warn(
+                "flash_attention: shape %s / bias=%s not eligible for the "
+                "Pallas kernel; using the XLA fallback (logged once)"
+                % (tuple(q.shape), bias is not None))
     return _xla_attention(q, k, v, bias, is_causal, scale)
 
 

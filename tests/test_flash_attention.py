@@ -1,0 +1,100 @@
+"""Pallas flash-attention kernel parity vs jax.nn.dot_product_attention
+(the numpy-oracle OpTest pattern, SURVEY.md §4). Runs the real kernel in
+pallas interpret mode on CPU; the same code path compiles on TPU."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.ops.pallas.flash_attention_kernel as fak
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    prev = fak._FORCE_INTERPRET
+    fak._FORCE_INTERPRET = True
+    yield
+    fak._FORCE_INTERPRET = prev
+
+
+def _qkv(b=2, l=256, h=4, d=64, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, l, h, d).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_xla(causal):
+    q, k, v = _qkv()
+    out = fak.pallas_flash_attention(q, k, v, causal=causal,
+                                     block_q=128, block_k=128)
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=causal)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_xla(causal):
+    q, k, v = _qkv()
+
+    def loss_pallas(q, k, v):
+        o = fak.pallas_flash_attention(q, k, v, causal=causal,
+                                       block_q=128, block_k=128)
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jax.nn.dot_product_attention(
+            q, k, v, is_causal=causal) ** 2)
+
+    gp = jax.grad(loss_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        rel = float(jnp.abs(a - b).max()) / max(1e-6,
+                                                float(jnp.abs(b).max()))
+        assert rel < 1e-4
+
+
+def test_bf16_tolerance():
+    q, k, v = _qkv(dtype=np.float32)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = fak.pallas_flash_attention(qb, kb, vb, causal=True,
+                                     block_q=128, block_k=128)
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    xla_bf16 = jax.nn.dot_product_attention(qb, kb, vb, is_causal=True)
+    kern_err = float(jnp.abs(out.astype(jnp.float32) - ref).max())
+    xla_err = float(jnp.abs(xla_bf16.astype(jnp.float32) - ref).max())
+    # fp32 accumulators: the kernel must be at least as accurate as the
+    # XLA bf16 path, and within bf16 resolution of the fp32 oracle
+    assert kern_err <= xla_err + 1e-3
+    assert kern_err < 2e-2
+
+
+def test_uneven_seq_blocks():
+    # L=384 -> block sizes must adapt (384 % 256 != 0)
+    q, k, v = _qkv(l=384)
+    out = fak.pallas_flash_attention(q, k, v, causal=True)
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_gqa_via_repeat_matches():
+    # GQA: caller repeats K/V heads (llama.py:150 pattern)
+    q, _, _ = _qkv(h=8)
+    _, k, v = _qkv(h=2, seed=1)
+    k = jnp.repeat(k, 4, axis=2)
+    v = jnp.repeat(v, 4, axis=2)
+    out = fak.pallas_flash_attention(q, k, v, causal=True,
+                                     block_q=128, block_k=128)
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    assert float(jnp.abs(out - ref).max()) < 2e-5
+
+
+def test_core_dispatch_fallback_logs_once(recwarn):
+    # bias path must take the XLA fallback (kernel ineligible), silently
+    # on CPU (no TPU), and produce correct results
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_core
+    q, k, v = _qkv(l=64)
+    bias = jnp.zeros((1, 1, 64, 64), jnp.float32)
+    out = flash_attention_core(q, k, v, bias=bias)
+    ref = jax.nn.dot_product_attention(q, k, v, bias=bias)
+    assert float(jnp.abs(out - ref).max()) < 1e-6
