@@ -33,6 +33,8 @@ def __getattr__(name):
 from .pipeline import pipeline_apply, stack_stage_params
 from .recompute import recompute, recompute_sequential
 from .ring_attention import RingFlashAttention, ring_flash_attention
+from .sep_parallel import (ReshardLayer, sep_attention,
+                           ulysses_attention)
 from .shard_utils import constraint as shard_op_constraint
 from .sharding import group_sharded_parallel, save_group_sharded_model
 

@@ -30,6 +30,10 @@ class DistributedStrategy:
         self.hybrid_configs: Dict[str, Any] = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sep_degree": 1,
+            # mechanism consuming the sep axis: "ulysses" (all-to-all
+            # head<->seq, the reference's sep semantics) or "ring"
+            # (ppermute KV ring / context parallel)
+            "sep_mechanism": "ulysses",
         }
         self.tensor_parallel = False
         self.tensor_parallel_configs: Dict[str, Any] = {

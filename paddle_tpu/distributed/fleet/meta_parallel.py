@@ -371,7 +371,10 @@ class _RNGStateTracker:
             hcg = get_hcg()
             seed = self._seeds.get(name, 0)
             key = jax.random.PRNGKey(seed) if seed else frandom.get_key()
-            key = jax.random.fold_in(key, abs(hash(name)) % (2 ** 31))
+            # crc32, not hash(): str hashes are salted per process, which
+            # would desynchronize "identical on every rank" streams
+            import zlib
+            key = jax.random.fold_in(key, zlib.crc32(name.encode()))
             if name in self.LOCAL_STREAMS and hcg is not None:
                 key = jax.random.fold_in(
                     key, hcg.get_model_parallel_rank())

@@ -60,10 +60,18 @@ def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
 
     in_spec_params = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
-    live_batch = tuple(a for a in (batch_axes or ())
-                       if a != axis and mesh.shape.get(a, 1) > 1
-                       and microbatches.shape[1]
-                       % mesh.shape.get(a, 1) == 0)
+    # keep a batch axis only while the *product* of kept axes still
+    # divides the per-microbatch batch dim (per-axis checks would admit
+    # e.g. 2x2 devices for a batch of 2)
+    live_batch = []
+    _prod = 1
+    for a in (batch_axes or ()):
+        sz = mesh.shape.get(a, 1)
+        if a != axis and sz > 1 and \
+                microbatches.shape[1] % (_prod * sz) == 0:
+            live_batch.append(a)
+            _prod *= sz
+    live_batch = tuple(live_batch)
     mb_spec = P(None, live_batch if len(live_batch) > 1
                 else (live_batch[0] if live_batch else None),
                 *([None] * (microbatches.ndim - 2)))

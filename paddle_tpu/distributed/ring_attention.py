@@ -46,8 +46,9 @@ def ring_flash_attention(q, k, v, mesh: Mesh = None, axis: str = "sep",
     if scale is None:
         scale = 1.0 / np.sqrt(q_arr.shape[-1])
     scale = float(scale)  # keep weak-typed under x64
+    from .shard_utils import in_manual_region
     sp = mesh.shape[axis] if mesh is not None else 1
-    if mesh is None or sp <= 1:
+    if mesh is None or sp <= 1 or in_manual_region():
         out = jax.nn.dot_product_attention(q_arr, k_arr, v_arr,
                                            is_causal=causal, scale=scale)
         return Tensor(out) if isinstance(q, Tensor) else out

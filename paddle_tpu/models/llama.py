@@ -7,8 +7,10 @@ TPU-first 4D parallel layout:
     annotations on the ``mp`` mesh axis (GSPMD inserts the collectives).
   - SP (Megatron): activation constraints on the seq dim when
     ``sequence_parallel=True``.
-  - CP (ring attention): when the ``sep`` axis is >1, attention runs the
-    ppermute ring (``distributed/ring_attention.py``).
+  - SEP: when the ``sep`` axis is >1, attention runs Ulysses all-to-all
+    head<->seq reshuffles (``distributed/sep_parallel.py``, the default)
+    or the ppermute ring (``distributed/ring_attention.py``), selected
+    by ``hybrid_configs["sep_mechanism"]``.
   - DP/sharding: batch dim constraint + fsdp param specs (stage 3).
   - PP: homogeneous decoder layers — pipelined via
     ``distributed/pipeline.py`` through ``LlamaForCausalLMPipe``.
@@ -137,13 +139,13 @@ class LlamaAttention(Layer):
             vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
             qh = _apply_rope(qh, cos, sin)
             kh = _apply_rope(kh, cos, sin)
-            if mesh_axis_size("sep") > 1:
-                from ..distributed.ring_attention import \
-                    ring_flash_attention
+            from ..distributed.shard_utils import in_manual_region
+            if mesh_axis_size("sep") > 1 and not in_manual_region():
+                from ..distributed.sep_parallel import sep_attention
                 rep = self.num_heads // self.num_kv_heads
                 kh = jnp.repeat(kh, rep, axis=2)
                 vh = jnp.repeat(vh, rep, axis=2)
-                out = ring_flash_attention(qh, kh, vh, causal=True)
+                out = sep_attention(qh, kh, vh, causal=True)
             else:
                 from ..ops.pallas.flash_attention import \
                     flash_attention_core
@@ -312,7 +314,14 @@ class LlamaForCausalLMPipe(LlamaForCausalLM):
             mesh.shape.get("pp", 1) if mesh is not None else 1)
         n_layers = self.config.num_hidden_layers
         if pp <= 1 or mesh is None or mesh.shape.get("pp", 1) <= 1 \
-                or n_layers % pp != 0:
+                or n_layers % pp != 0 or attention_mask is not None:
+            # attention_mask is not threaded through the pipeline stage
+            # function — run the (numerically identical) sequential path
+            if attention_mask is not None and pp > 1:
+                import warnings
+                warnings.warn(
+                    "LlamaForCausalLMPipe: attention_mask given; running "
+                    "the sequential (non-pipelined) path")
             return super().forward(input_ids, labels, attention_mask,
                                    position_ids)
         lps = n_layers // pp

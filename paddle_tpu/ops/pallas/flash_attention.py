@@ -17,7 +17,12 @@ import numpy as np
 from ...framework.core import Tensor, apply_jax, as_jax
 
 
-from .flash_attention_kernel import pallas_flash_attention
+try:
+    from .flash_attention_kernel import pallas_flash_attention
+    _kernel_import_error = None
+except Exception as _e:  # pallas/tpu lowering unavailable on this build
+    pallas_flash_attention = None
+    _kernel_import_error = _e
 
 
 def _xla_attention(q, k, v, bias, is_causal, scale):
@@ -29,15 +34,26 @@ def _xla_attention(q, k, v, bias, is_causal, scale):
 
 def _pallas_available():
     try:
-        return jax.default_backend() == "tpu"
+        on_tpu = jax.default_backend() == "tpu"
     except Exception:
         return False
+    if on_tpu and pallas_flash_attention is None:
+        global _fallback_logged
+        if not _fallback_logged:
+            _fallback_logged = True
+            import warnings
+            warnings.warn(
+                "flash_attention: Pallas kernel unavailable on this jax "
+                "build (%r); using the XLA fallback" % _kernel_import_error)
+        return False
+    return on_tpu
 
 
-def _kernel_eligible(q, bias):
-    # seq divisible into >=128 lanes, head_dim tile-friendly, no dense bias
-    # (FlashMask lowers its compact form separately)
+def _kernel_eligible(q, k, bias):
+    # q and kv seq divisible into >=128 lanes, head_dim tile-friendly,
+    # no dense bias (FlashMask lowers its compact form separately)
     return (bias is None and q.shape[1] % 128 == 0 and q.shape[1] >= 256
+            and k.shape[1] % 128 == 0
             and q.shape[-1] in (64, 128, 256))
 
 
@@ -50,7 +66,7 @@ def flash_attention_core(q, k, v, bias=None, is_causal=False, scale=None):
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if _pallas_available():
-        if _kernel_eligible(q, bias):
+        if _kernel_eligible(q, k, bias):
             return pallas_flash_attention(q, k, v, causal=is_causal,
                                           sm_scale=scale)
         global _fallback_logged
