@@ -1,0 +1,183 @@
+"""LLM generation: KV-cache incremental decode + sampling
+(reference: PaddleNLP ``paddlenlp/generation/utils.py`` GenerationMixin
+— the entry point BASELINE.json's north star serves through).
+
+TPU-first: the whole decode loop is ONE jitted program — prefill writes
+the prompt K/V into static-shape caches, then a ``lax.while_loop``
+feeds one token per step with a traced position offset, so there is a
+single compilation per (batch, prompt-len, max-new) shape and a single
+host sync at the end. Early exit when every sequence hit EOS happens
+inside the while condition, not in Python.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["GenerationConfig", "GenerationMixin"]
+
+
+@dataclass
+class GenerationConfig:
+    max_new_tokens: int = 20
+    decode_strategy: str = "greedy_search"  # or "sampling"
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: Optional[int] = None
+    seed: Optional[int] = None
+
+
+def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
+    """(token, logprob-of-token) for one step. logits: [B, V]."""
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0 and do_sample:
+        logits = logits / max(temperature, 1e-6)
+    if do_sample and top_k:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if do_sample and top_p < 1.0:
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until the cumulative prob of *previous* kept ones
+        # exceeds top_p (always keeps the first)
+        drop = cum - probs > top_p
+        kept = jnp.where(drop, jnp.inf, sorted_logits)
+        thresh = jnp.min(kept, axis=-1, keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if do_sample:
+        tok = jax.random.categorical(key, logits)
+    else:
+        tok = jnp.argmax(logits, axis=-1)
+    tok = tok.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, tok[:, None], axis=-1)[:, 0]
+    return tok, picked
+
+
+class GenerationMixin:
+    """Adds ``generate()`` to a causal-LM Layer that implements the cache
+    protocol: ``init_caches(batch, max_len)`` and
+    ``forward(input_ids, caches=..., offset=...) -> (logits, caches)``."""
+
+    def generate(self, input_ids, generation_config: GenerationConfig = None,
+                 max_new_tokens=None, max_length=None,
+                 decode_strategy=None, temperature=None, top_k=None,
+                 top_p=None, eos_token_id=None, pad_token_id=None,
+                 seed=None, **kwargs):
+        if kwargs:
+            # silently dropping generation options produces output that
+            # looks valid but ignores the request — fail instead
+            raise TypeError(
+                f"generate() got unsupported options {sorted(kwargs)}; "
+                "supported: max_new_tokens/max_length, decode_strategy "
+                "(greedy_search|sampling), temperature, top_k, top_p, "
+                "eos_token_id, pad_token_id, seed")
+        """Returns ``(ids, scores)``: generated token ids
+        [B, max_new_tokens] (pad-filled after EOS) and the summed
+        log-probability of the chosen tokens per sequence."""
+        cfg = generation_config or GenerationConfig()
+        if max_length is not None and max_new_tokens is None:
+            max_new_tokens = max_length  # PaddleNLP: length of generation
+        max_new = int(max_new_tokens or cfg.max_new_tokens)
+        strategy = decode_strategy or cfg.decode_strategy
+        if strategy not in ("greedy_search", "sampling"):
+            raise NotImplementedError(
+                f"decode_strategy {strategy!r} (beam search not "
+                "implemented; use greedy_search or sampling)")
+        do_sample = strategy == "sampling"
+        temperature = cfg.temperature if temperature is None \
+            else float(temperature)
+        top_k = cfg.top_k if top_k is None else int(top_k)
+        top_p = cfg.top_p if top_p is None else float(top_p)
+        eos = eos_token_id if eos_token_id is not None else cfg.eos_token_id
+        pad = pad_token_id if pad_token_id is not None else cfg.pad_token_id
+        eos = -1 if eos is None else int(eos)   # -1 never matches
+        pad = (eos if eos >= 0 else 0) if pad is None else int(pad)
+        seed = cfg.seed if seed is None else seed
+        if seed is None:
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+
+        ids = as_jax(input_ids).astype(jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        max_pos = getattr(getattr(self, "config", None),
+                          "max_position_embeddings", None)
+        if max_pos is not None and prompt_len + max_new > max_pos:
+            # beyond the rope/position tables the dynamic slices clamp
+            # and silently reuse the last position — error instead
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new}) "
+                f"exceeds max_position_embeddings ({max_pos})")
+
+        from ..jit import _LayerBinder
+        binder = _LayerBinder(self)
+        params = binder.param_arrays()
+        buffers = binder.buffer_arrays()
+
+        def model_step(params_a, tok_ids, caches, off):
+            t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
+            out, _ = binder.call(
+                params_a, buffers, (_wrap_out(tok_ids),),
+                {"caches": t_caches, "offset": _wrap_out(off)})
+            logits, new_caches = out
+            return as_jax(logits), [(as_jax(k), as_jax(v))
+                                    for k, v in new_caches]
+
+        select = lambda lg, k: _select_token(
+            lg, k, do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p)
+
+        def run(params_a, ids_a, key):
+            caches = self.init_caches(b, prompt_len + max_new)
+            logits, caches = model_step(params_a, ids_a, caches,
+                                        jnp.zeros((), jnp.int32))
+            key, sub = jax.random.split(key)
+            tok, logp = select(logits[:, -1, :], sub)
+            done = tok == eos
+            out = jnp.full((b, max_new), pad, jnp.int32)
+            out = out.at[:, 0].set(jnp.where(done, eos, tok))
+            score = logp
+
+            def cond(c):
+                i = c[0]
+                return (i < max_new) & jnp.logical_not(jnp.all(c[4]))
+
+            def body(c):
+                i, tok, caches, out, done, score, key = c
+                off = jnp.asarray(prompt_len - 1, jnp.int32) + i
+                logits, caches = model_step(params_a, tok[:, None],
+                                            caches, off)
+                key, sub = jax.random.split(key)
+                ntok, logp = select(logits[:, -1, :], sub)
+                ntok = jnp.where(done, jnp.int32(pad), ntok)
+                score = score + jnp.where(done, 0.0, logp)
+                out = jax.lax.dynamic_update_slice(
+                    out, ntok[:, None], (jnp.int32(0), i))
+                done = done | (ntok == eos)
+                return (i + 1, ntok, caches, out, done, score, key)
+
+            state = (jnp.int32(1), tok, caches, out, done, score, key)
+            state = jax.lax.while_loop(cond, body, state)
+            return state[3], state[5]
+
+        if not hasattr(self, "_generate_jit_cache"):
+            self._generate_jit_cache = {}
+        jit_key = (b, prompt_len, max_new, do_sample, temperature, top_k,
+                   top_p, eos, pad)
+        jitted = self._generate_jit_cache.get(jit_key)
+        if jitted is None:
+            jitted = jax.jit(run)
+            self._generate_jit_cache[jit_key] = jitted
+        out, score = jitted(params, ids, jax.random.PRNGKey(seed))
+        return (_wrap_out(out.astype(jnp.int64)),
+                _wrap_out(score))

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "GenerationPredictor", "create_generation_predictor",
            "PrecisionType", "PlaceType", "get_version"]
 
 
@@ -175,6 +176,11 @@ class Predictor:
 
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is not None:
+            if len(inputs) != len(self._input_names):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs; the exported "
+                    f"program expects {len(self._input_names)} "
+                    f"({self._input_names})")
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n] = np.ascontiguousarray(a)
         missing = [n for n in self._input_names if n not in self._inputs]
@@ -205,3 +211,38 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class GenerationPredictor:
+    """LLM serving predictor (the role PaddleNLP's
+    ``llm/predict/predictor.py`` plays over AnalysisPredictor): wraps a
+    causal-LM Layer's KV-cache ``generate()`` decode loop. The loop is
+    one jitted XLA program per (batch, prompt-len, max-new) shape —
+    compiled on first call, cached after."""
+
+    def __init__(self, model, generation_config=None):
+        from ..generation import GenerationConfig, GenerationMixin
+        if not isinstance(model, GenerationMixin):
+            raise TypeError(
+                f"{type(model).__name__} does not support generation "
+                "(needs the KV-cache protocol: init_caches + caches/"
+                "offset forward kwargs)")
+        self.model = model
+        self.generation_config = generation_config or GenerationConfig()
+        model.eval()
+
+    def generate(self, input_ids, **overrides) -> np.ndarray:
+        """input_ids: [B, L] numpy/array of token ids. Returns the
+        generated ids [B, max_new_tokens] as numpy (pad after EOS)."""
+        from ..framework.core import Tensor as _T
+        ids = np.ascontiguousarray(np.asarray(input_ids))
+        out, _scores = self.model.generate(
+            _T(ids), generation_config=self.generation_config,
+            **overrides)
+        return np.asarray(out.numpy())
+
+
+def create_generation_predictor(model,
+                                generation_config=None
+                                ) -> GenerationPredictor:
+    return GenerationPredictor(model, generation_config)

@@ -21,6 +21,7 @@ from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
 from ..distributed.moe import moe_dispatch_combine
 from ..distributed.shard_utils import batch_shard
+from ..generation import GenerationMixin
 from .llama import (LlamaAttention, LlamaPretrainingCriterion,
                     _rope_tables)
 from .qwen2_moe import StackedExpertsMLP, _DenseMLP
@@ -128,10 +129,16 @@ class DeepseekMoeDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None):
+                attention_mask=None, kv_cache=None, offset=None):
         h = self.input_layernorm(hidden_states)
-        h = hidden_states + self.self_attn(h, rope_cos, rope_sin,
-                                           attention_mask)
+        new_cache = None
+        if kv_cache is not None:
+            a, new_cache = self.self_attn(h, rope_cos, rope_sin,
+                                          attention_mask, kv_cache,
+                                          offset)
+        else:
+            a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
+        h = hidden_states + a
         h2 = self.post_attention_layernorm(h)
         m = self.mlp(h2)
         if isinstance(m, tuple):
@@ -139,6 +146,8 @@ class DeepseekMoeDecoderLayer(Layer):
         else:
             import jax.numpy as jnp
             aux = _wrap_out(jnp.zeros((), jnp.float32))
+        if kv_cache is not None:
+            return h + m, aux, new_cache
         return h + m, aux
 
 
@@ -159,9 +168,18 @@ class DeepseekMoeModel(Layer):
         self._rope_cos = Tensor(cos)
         self._rope_sin = Tensor(sin)
 
-    def forward(self, input_ids, attention_mask=None):
+    def forward(self, input_ids, attention_mask=None, caches=None,
+                offset=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, kv in zip(self.layers, caches):
+                h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
+                                     attention_mask, kv_cache=kv,
+                                     offset=offset)
+                new_caches.append(kv2)
+            return self.norm(h), None, new_caches
         l = h.shape[1]
         cos = _wrap_out(as_jax(self._rope_cos)[:l])
         sin = _wrap_out(as_jax(self._rope_sin)[:l])
@@ -177,7 +195,7 @@ class DeepseekMoeModel(Layer):
         return self.norm(h), aux_total
 
 
-class DeepseekMoeForCausalLM(Layer):
+class DeepseekMoeForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: DeepseekMoeConfig):
         super().__init__()
         self.config = config
@@ -195,7 +213,25 @@ class DeepseekMoeForCausalLM(Layer):
                           transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, labels=None, attention_mask=None):
+    def init_caches(self, batch_size: int, max_length: int):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        import jax.numpy as jnp
+        dtype = jnp.dtype(getattr(cfg, "dtype", "float32"))
+        return [
+            (jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype),
+             jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                caches=None, offset=None):
+        if caches is not None:
+            h, _, new_caches = self.deepseek(input_ids, attention_mask,
+                                             caches=caches, offset=offset)
+            return self._logits(h), new_caches
         h, aux_total = self.deepseek(input_ids, attention_mask)
         logits = self._logits(h)
         if labels is None:

@@ -17,6 +17,7 @@ from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
 from ..distributed.shard_utils import batch_shard, constraint
+from ..generation import GenerationMixin
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
            "GPTPretrainingCriterion"]
@@ -56,9 +57,26 @@ class GPTAttention(Layer):
             input_is_parallel=True)
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, kv_cache=None, offset=None):
         b, l, d = x.shape
         qkv = self.qkv_proj(x)
+
+        if kv_cache is not None:
+            def attn_c(a, kc, vc, off):
+                from .llama import cached_attention
+                q, k, v = jnp.split(a, 3, axis=-1)
+                qh = q.reshape(b, l, self.num_heads, self.head_dim)
+                kh = k.reshape(b, l, self.num_heads, self.head_dim)
+                vh = v.reshape(b, l, self.num_heads, self.head_dim)
+                out, kc2, vc2 = cached_attention(qh, kh, vh, kc, vc,
+                                                 off, self.head_dim)
+                return out.reshape(b, l, d), kc2, vc2
+
+            ctx, kc2, vc2 = apply_jax("gpt_attention_cached", attn_c,
+                                      qkv, kv_cache[0], kv_cache[1],
+                                      offset, n_outputs=3)
+            ctx = constraint(ctx, None, None, "mp")
+            return self.out_proj(ctx), (kc2, vc2)
 
         def attn(a):
             q, k, v = jnp.split(a, 3, axis=-1)
@@ -89,11 +107,19 @@ class GPTDecoderLayer(Layer):
             input_is_parallel=True)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+    def forward(self, x, kv_cache=None, offset=None):
+        new_cache = None
+        if kv_cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), kv_cache, offset)
+        else:
+            a = self.attn(self.ln_1(x))
+        x = x + self.dropout(a)
         h = self.linear2(F.gelu(self.linear1(self.ln_2(x)),
                                 approximate=True))
-        return x + self.dropout(h)
+        out = x + self.dropout(h)
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class GPTModel(Layer):
@@ -110,15 +136,24 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size,
                               config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                offset=None):
         input_ids = batch_shard(input_ids)
         l = input_ids.shape[1]
         if position_ids is None:
             from ..ops.creation import arange
             position_ids = arange(l, dtype="int64")
+            if offset is not None:
+                position_ids = position_ids + offset
         h = self.embeddings(input_ids) + \
             self.position_embeddings(position_ids)
         h = self.dropout(h)
+        if caches is not None:
+            new_caches = []
+            for layer, kv in zip(self.h, caches):
+                h, kv2 = layer(h, kv_cache=kv, offset=offset)
+                new_caches.append(kv2)
+            return self.ln_f(h), new_caches
         for layer in self.h:
             h = layer(h)
         return self.ln_f(h)
@@ -136,15 +171,33 @@ class GPTPretrainingCriterion(Layer):
         return apply_jax("gpt_ce", f, logits, labels)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
+        self.config = config
         self.gpt = GPTModel(config)
         self.criterion = GPTPretrainingCriterion()
 
-    def forward(self, input_ids, labels=None):
-        h = self.gpt(input_ids)
+    def init_caches(self, batch_size: int, max_length: int):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [
+            (jnp.zeros((batch_size, max_length, cfg.num_attention_heads,
+                        head_dim), jnp.float32),
+             jnp.zeros((batch_size, max_length, cfg.num_attention_heads,
+                        head_dim), jnp.float32))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def forward(self, input_ids, labels=None, caches=None, offset=None):
         from ..ops.linalg import matmul
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, caches=caches,
+                                     offset=offset)
+            logits = matmul(h, self.gpt.embeddings.weight,
+                            transpose_y=True)
+            return logits, new_caches
+        h = self.gpt(input_ids)
         logits = matmul(h, self.gpt.embeddings.weight, transpose_y=True)
         if labels is not None:
             return self.criterion(logits, labels)

@@ -36,6 +36,7 @@ from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
 from ..distributed.shard_utils import batch_shard, constraint, \
     mesh_axis_size
+from ..generation import GenerationMixin
 from ..incubate.nn.functional import (fused_rotary_position_embedding,
                                       swiglu)
 
@@ -86,6 +87,32 @@ def _rope_tables(seq_len, head_dim, theta):
     return np.cos(freqs), np.sin(freqs)
 
 
+def cached_attention(qh, kh, vh, kc, vc, off, head_dim):
+    """Shared KV-cache attention step (Llama/GPT families): write this
+    chunk's heads [B, L, H', D] into the static cache at ``off``, attend
+    q against the full cache under a causal-with-offset mask. Returns
+    (out [B, L, H, D], new_k_cache, new_v_cache). GQA: cache holds KV
+    heads; repeat to the query head count here."""
+    b, l = qh.shape[0], qh.shape[1]
+    off = off.astype(jnp.int32) if hasattr(off, "astype") else off
+    zero = jnp.zeros((), jnp.int32)
+    kc2 = jax.lax.dynamic_update_slice(
+        kc, kh.astype(kc.dtype), (zero, off, zero, zero))
+    vc2 = jax.lax.dynamic_update_slice(
+        vc, vh.astype(vc.dtype), (zero, off, zero, zero))
+    rep = qh.shape[2] // kc.shape[2]
+    kf = jnp.repeat(kc2, rep, axis=2) if rep > 1 else kc2
+    vf = jnp.repeat(vc2, rep, axis=2) if rep > 1 else vc2
+    S = kc.shape[1]
+    rows = off + jnp.arange(l)[:, None]
+    cols = jnp.arange(S)[None, :]
+    bias = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
+    out = jax.nn.dot_product_attention(
+        qh, kf.astype(qh.dtype), vf.astype(qh.dtype),
+        bias=bias.astype(qh.dtype), scale=1.0 / math.sqrt(head_dim))
+    return out, kc2, vc2
+
+
 def _apply_rope(x, cos, sin):
     # x: [B, L, H, D]; neox style halves. Tables stay fp32 for precision;
     # output is cast back so bf16 activations remain bf16.
@@ -127,11 +154,19 @@ class LlamaAttention(Layer):
             has_bias=False, input_is_parallel=True)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None):
+                attention_mask=None, kv_cache=None, offset=None):
         b, l, _ = hidden_states.shape
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
+
+        if kv_cache is not None:
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "KV-cache decode does not support attention_mask "
+                    "(padded batches); generate prompts of equal length")
+            return self._forward_cached(q, k, v, rope_cos, rope_sin,
+                                        kv_cache, offset, b, l)
 
         def attn(q_a, k_a, v_a, cos, sin):
             qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
@@ -160,6 +195,36 @@ class LlamaAttention(Layer):
                         rope_sin)
         ctx = constraint(ctx, None, None, "mp")
         return self.o_proj(ctx)
+
+    def _forward_cached(self, q, k, v, rope_cos, rope_sin, kv_cache,
+                        offset, b, l):
+        """Incremental-decode attention: write this chunk's K/V into the
+        static-shape cache at ``offset`` and attend against the full
+        cache under a causal-with-offset mask (KV-cache decode path —
+        reference: PaddleNLP generation with ``cache_kvs``). rope tables
+        arrive un-sliced; ``offset`` is a traced int32 scalar so one
+        compiled program serves every decode step."""
+
+        def attn_c(q_a, k_a, v_a, cos_t, sin_t, kc, vc, off):
+            qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
+            kh = k_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            off32 = off.astype(jnp.int32) if hasattr(off, "astype") \
+                else off
+            cos = jax.lax.dynamic_slice_in_dim(cos_t, off32, l, 0)
+            sin = jax.lax.dynamic_slice_in_dim(sin_t, off32, l, 0)
+            qh = _apply_rope(qh, cos, sin)
+            kh = _apply_rope(kh, cos, sin)
+            out, kc2, vc2 = cached_attention(qh, kh, vh, kc, vc, off32,
+                                             self.head_dim)
+            return (out.reshape(b, l, self.num_heads * self.head_dim),
+                    kc2, vc2)
+
+        ctx, kc2, vc2 = apply_jax(
+            "llama_attention_cached", attn_c, q, k, v, rope_cos, rope_sin,
+            kv_cache[0], kv_cache[1], offset, n_outputs=3)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx), (kc2, vc2)
 
 
 class LlamaMLP(Layer):
@@ -191,15 +256,23 @@ class LlamaDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None):
+                attention_mask=None, kv_cache=None, offset=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
-        h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
+        new_cache = None
+        if kv_cache is not None:
+            h, new_cache = self.self_attn(h, rope_cos, rope_sin,
+                                          attention_mask, kv_cache, offset)
+        else:
+            h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
         h = residual + h
         residual = h
         h2 = self.post_attention_layernorm(h)
         h2 = self.mlp(h2)
-        return residual + h2
+        out = residual + h2
+        if kv_cache is not None:
+            return out, new_cache
+        return out
 
 
 class LlamaModel(Layer):
@@ -219,9 +292,19 @@ class LlamaModel(Layer):
         self._rope_cos = Tensor(cos)
         self._rope_sin = Tensor(sin)
 
-    def forward(self, input_ids, attention_mask=None, position_ids=None):
+    def forward(self, input_ids, attention_mask=None, position_ids=None,
+                caches=None, offset=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
+        if caches is not None:
+            # decode path: full rope tables + per-layer kv caches
+            cos, sin = self._rope_cos, self._rope_sin
+            new_caches = []
+            for layer, kv in zip(self.layers, caches):
+                h, kv2 = layer(h, cos, sin, attention_mask,
+                               kv_cache=kv, offset=offset)
+                new_caches.append(kv2)
+            return self.norm(h), new_caches
         l = h.shape[1]
         cos = _wrap_out(as_jax(self._rope_cos)[:l])
         sin = _wrap_out(as_jax(self._rope_sin)[:l])
@@ -256,7 +339,7 @@ class LlamaPretrainingCriterion(Layer):
         return apply_jax("llama_ce", f, logits, labels)
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -268,9 +351,27 @@ class LlamaForCausalLM(Layer):
         self.criterion = LlamaPretrainingCriterion(config)
 
     def forward(self, input_ids, labels=None, attention_mask=None,
-                position_ids=None):
+                position_ids=None, caches=None, offset=None):
+        if caches is not None:
+            h, new_caches = self.llama(input_ids, attention_mask,
+                                       position_ids, caches=caches,
+                                       offset=offset)
+            return self._head_and_loss(h, None), new_caches
         h = self.llama(input_ids, attention_mask, position_ids)
         return self._head_and_loss(h, labels)
+
+    def init_caches(self, batch_size: int, max_length: int):
+        """Zeroed per-layer (k, v) caches [B, S, H_kv, D] for decode."""
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dtype = jnp.dtype(cfg.dtype)
+        return [
+            (jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype),
+             jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
 
     def _head_and_loss(self, h, labels):
         if self.config.tie_word_embeddings:

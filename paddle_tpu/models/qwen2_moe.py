@@ -25,6 +25,7 @@ from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
 from ..distributed.moe import moe_dispatch_combine
 from ..distributed.shard_utils import batch_shard
+from ..generation import GenerationMixin
 from ..incubate.nn.functional import swiglu
 from .llama import (LlamaAttention, LlamaPretrainingCriterion,
                     _rope_tables)
@@ -192,19 +193,27 @@ class Qwen2MoeDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None):
+                attention_mask=None, kv_cache=None, offset=None):
         """Returns ``(h, aux_loss)`` uniformly (zero aux for dense
         layers) so the remat and non-remat paths carry the router loss
-        identically."""
+        identically; with ``kv_cache``, ``(h, aux_loss, new_cache)``."""
         h = self.input_layernorm(hidden_states)
-        h = hidden_states + self.self_attn(h, rope_cos, rope_sin,
-                                           attention_mask)
+        new_cache = None
+        if kv_cache is not None:
+            a, new_cache = self.self_attn(h, rope_cos, rope_sin,
+                                          attention_mask, kv_cache,
+                                          offset)
+        else:
+            a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
+        h = hidden_states + a
         h2 = self.post_attention_layernorm(h)
         m = self.mlp(h2)
         if isinstance(m, tuple):
             m, aux = m
         else:
             aux = _wrap_out(jnp.zeros((), jnp.float32))
+        if kv_cache is not None:
+            return h + m, aux, new_cache
         return h + m, aux
 
 
@@ -225,10 +234,20 @@ class Qwen2MoeModel(Layer):
         self._rope_cos = Tensor(cos)
         self._rope_sin = Tensor(sin)
 
-    def forward(self, input_ids, attention_mask=None):
-        """Returns ``(h, total_aux_loss)``."""
+    def forward(self, input_ids, attention_mask=None, caches=None,
+                offset=None):
+        """Returns ``(h, total_aux_loss)``; with ``caches``,
+        ``(h, total_aux_loss, new_caches)``."""
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, kv in zip(self.layers, caches):
+                h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
+                                     attention_mask, kv_cache=kv,
+                                     offset=offset)
+                new_caches.append(kv2)
+            return self.norm(h), None, new_caches
         l = h.shape[1]
         cos = _wrap_out(as_jax(self._rope_cos)[:l])
         sin = _wrap_out(as_jax(self._rope_sin)[:l])
@@ -244,7 +263,7 @@ class Qwen2MoeModel(Layer):
         return self.norm(h), aux_total
 
 
-class Qwen2MoeForCausalLM(Layer):
+class Qwen2MoeForCausalLM(Layer, GenerationMixin):
     def __init__(self, config: Qwen2MoeConfig):
         super().__init__()
         self.config = config
@@ -262,7 +281,24 @@ class Qwen2MoeForCausalLM(Layer):
                           transpose_y=True)
         return self.lm_head(h)
 
-    def forward(self, input_ids, labels=None, attention_mask=None):
+    def init_caches(self, batch_size: int, max_length: int):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dtype = jnp.dtype(getattr(cfg, "dtype", "float32"))
+        return [
+            (jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype),
+             jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
+                        head_dim), dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                caches=None, offset=None):
+        if caches is not None:
+            h, _, new_caches = self.qwen2_moe(input_ids, attention_mask,
+                                              caches=caches, offset=offset)
+            return self._logits(h), new_caches
         h, aux_total = self.qwen2_moe(input_ids, attention_mask)
         logits = self._logits(h)
         if labels is None:

@@ -1,0 +1,137 @@
+"""KV-cache decode + generate() (reference: PaddleNLP
+``paddlenlp/generation/utils.py`` GenerationMixin test strategy —
+greedy parity vs full-forward argmax, sampling determinism, EOS stop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _greedy_reference(model, ids, steps):
+    """Decode by re-running the full forward each step (no cache)."""
+    full = ids.copy()
+    for _ in range(steps):
+        logits = model(paddle.to_tensor(full))
+        nxt = np.argmax(np.asarray(logits.numpy())[:, -1, :], -1)
+        full = np.concatenate([full, nxt[:, None].astype(full.dtype)], 1)
+    return full[:, ids.shape[1]:]
+
+
+@pytest.fixture
+def llama_tiny():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, ffn=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_llama_greedy_matches_full_forward(llama_tiny):
+    ids = np.random.RandomState(0).randint(0, 128, (2, 9)).astype(np.int64)
+    out, scores = llama_tiny.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=6)
+    ref = _greedy_reference(llama_tiny, ids, 6)
+    np.testing.assert_array_equal(out.numpy(), ref)
+    assert scores.shape == [2]
+    assert np.all(np.asarray(scores.numpy()) <= 0)  # log-probs
+
+
+def test_gpt_greedy_matches_full_forward():
+    paddle.seed(3)
+    m = GPTForCausalLM(GPTConfig.tiny(vocab=96, hidden=64, layers=2,
+                                      heads=4))
+    m.eval()
+    ids = np.random.RandomState(1).randint(0, 96, (2, 5)).astype(np.int64)
+    out, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=4)
+    ref = _greedy_reference(m, ids, 4)
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_sampling_deterministic_with_seed(llama_tiny):
+    ids = np.random.RandomState(2).randint(0, 128, (1, 6)).astype(np.int64)
+    a, _ = llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                               decode_strategy="sampling", top_k=20,
+                               top_p=0.95, temperature=0.7, seed=11)
+    b, _ = llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                               decode_strategy="sampling", top_k=20,
+                               top_p=0.95, temperature=0.7, seed=11)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    assert np.asarray(a.numpy()).max() < 128
+
+
+def test_eos_stops_and_pads(llama_tiny):
+    ids = np.random.RandomState(4).randint(0, 128, (1, 5)).astype(np.int64)
+    # find the first greedy token, declare it EOS -> everything pads
+    first, _ = llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=1)
+    eos = int(np.asarray(first.numpy())[0, 0])
+    out, _ = llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                                 eos_token_id=eos, pad_token_id=0)
+    arr = np.asarray(out.numpy())[0]
+    assert arr[0] == eos
+    assert np.all(arr[1:] == 0)
+
+
+def test_beam_search_raises(llama_tiny):
+    ids = np.zeros((1, 4), np.int64)
+    with pytest.raises(NotImplementedError):
+        llama_tiny.generate(paddle.to_tensor(ids),
+                            decode_strategy="beam_search")
+
+
+def test_generation_predictor(llama_tiny):
+    from paddle_tpu.inference import create_generation_predictor
+    from paddle_tpu.generation import GenerationConfig
+    pred = create_generation_predictor(
+        llama_tiny, GenerationConfig(max_new_tokens=5))
+    ids = np.random.RandomState(5).randint(0, 128, (2, 7))
+    out = pred.generate(ids)
+    assert out.shape == (2, 5)
+    ref = _greedy_reference(llama_tiny, ids.astype(np.int64), 5)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_generation_predictor_rejects_non_lm():
+    from paddle_tpu.inference import create_generation_predictor
+    import paddle_tpu.nn as nn
+    with pytest.raises(TypeError):
+        create_generation_predictor(nn.Linear(4, 4))
+
+
+def test_moe_generate_smoke():
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+    paddle.seed(9)
+    cfg = Qwen2MoeConfig.tiny()
+    m = Qwen2MoeForCausalLM(cfg)
+    m.eval()
+    ids = np.random.RandomState(6).randint(
+        0, cfg.vocab_size, (1, 6)).astype(np.int64)
+    out, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=3)
+    ref = _greedy_reference(m, ids, 3)
+    np.testing.assert_array_equal(out.numpy(), ref)
+
+
+def test_generate_rejects_unknown_kwargs(llama_tiny):
+    ids = np.zeros((1, 4), np.int64)
+    with pytest.raises(TypeError, match="unsupported options"):
+        llama_tiny.generate(paddle.to_tensor(ids), num_beams=4)
+
+
+def test_generate_rejects_overlong(llama_tiny):
+    max_pos = llama_tiny.config.max_position_embeddings
+    ids = np.zeros((1, max_pos - 2), np.int64)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=8)
+
+
+def test_cached_decode_rejects_attention_mask(llama_tiny):
+    import jax.numpy as jnp
+    caches = llama_tiny.init_caches(1, 16)
+    ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
+    mask = paddle.to_tensor(np.ones((1, 4), np.float32))
+    with pytest.raises(NotImplementedError, match="attention_mask"):
+        llama_tiny(ids, attention_mask=mask, caches=caches,
+                   offset=paddle.to_tensor(np.int32(0)))
