@@ -85,10 +85,14 @@ class HybridParallelOptimizer:
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._inner = optimizer
         self._hcg = hcg
-        if strategy is not None and strategy.sharding_configs.get(
-                "stage", 1) >= 1 and mesh_axis_size("sharding") > 1:
-            from ..sharding import shard_optimizer_states
-            shard_optimizer_states(optimizer)
+        if strategy is not None and mesh_axis_size("sharding") > 1:
+            stage = strategy.sharding_configs.get("stage", 1)
+            if stage >= 1:
+                from ..sharding import shard_optimizer_states
+                shard_optimizer_states(optimizer)
+            if stage >= 2:
+                from ..sharding import shard_gradients
+                shard_gradients(optimizer)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_inner"], name)

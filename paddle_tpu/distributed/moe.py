@@ -24,7 +24,29 @@ from ..nn.layer.layers import Layer
 from .shard_utils import annotate_param, constraint, mesh_axis_size
 
 __all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
-           "moe_dispatch_combine"]
+           "moe_dispatch_combine", "ClipGradForMOEByGlobalNorm"]
+
+
+from ..nn.clip import ClipGradByGlobalNorm as _ClipGradByGlobalNorm
+
+
+class ClipGradForMOEByGlobalNorm(_ClipGradByGlobalNorm):
+    """MoE-aware global-norm clip (reference:
+    ``incubate/distributed/models/moe/grad_clip.py``). The reference
+    splits (param, grad) pairs into expert / non-expert sets and
+    all-reduces the expert-set norm over the moe group, because with EP
+    each rank holds only its local experts; expert_sq + normal_sq is
+    then the true global norm. TPU-first: expert params are stacked
+    GSPMD arrays that are *logically global*, so the plain global norm
+    over all grads is already the same quantity — this subclass exists
+    so reference scripts construct the same class name, and keeps the
+    constructor surface (predicate/group args are metadata here)."""
+
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm, group_name=group_name)
+        self.is_expert_param_func = is_expert_param_func
+        self.moe_group = moe_group
 
 
 class BaseGate(Layer):
@@ -47,28 +69,57 @@ class NaiveGate(BaseGate):
 
 
 class GShardGate(NaiveGate):
+    """GShard top-2 gate (``gate/gshard_gate.py`` parity): the 2nd-choice
+    expert receives the token only with probability ``min(1, 2*g2)``
+    (GShard's random routing), sampled per token during training."""
+
     def __init__(self, d_model, num_expert, world_size=1, topk=2,
                  capacity=(1.2, 2.4), group=None, gate_bias=True):
         super().__init__(d_model, num_expert, world_size, topk)
         self.capacity_factor = capacity[0]
+        self.second_expert_policy = "random"
 
 
 class SwitchGate(NaiveGate):
+    """Switch top-1 gate (``gate/switch_gate.py`` parity): multiplicative
+    jitter noise ``U(1-eps, 1+eps)`` on the router input during
+    training; capacity-drop statistics surface via ``drop_rate``."""
+
     def __init__(self, d_model, num_expert, world_size=1, topk=1,
                  switch_eps=0.1, capacity=(1.2, 2.4), group=None):
         super().__init__(d_model, num_expert, world_size, topk=1)
         self.capacity_factor = capacity[0]
+        self.switch_eps = float(switch_eps)
+
+    def forward(self, x):
+        if self.training and self.switch_eps > 0:
+            from ..framework import random as _random
+            key = _random.next_key()
+            eps = self.switch_eps
+
+            def jitter(a):
+                noise = jax.random.uniform(
+                    key, a.shape, jnp.float32, 1.0 - eps, 1.0 + eps)
+                return a * noise.astype(a.dtype)
+            x = apply_jax("switch_jitter", jitter, x)
+        return self.gate(x)
 
 
 def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
                          capacity_factor=1.25, expert_fn=None,
-                         expert_axis=None, normalize_gates=True):
+                         expert_axis=None, normalize_gates=True,
+                         second_expert_policy="all", rng_key=None,
+                         return_stats=False):
     """Pure-array GShard dispatch → expert_fn → combine.
 
     x: [tokens, d]; gate_logits: [tokens, e]. expert_fn(inputs[e, c, d])
-    -> [e, c, d]. Returns (y [tokens, d], aux_loss scalar).
+    -> [e, c, d]. Returns (y [tokens, d], aux_loss scalar), plus a stats
+    dict (capacity ``drop_rate``) when ``return_stats``.
     ``normalize_gates=False`` combines with the raw softmax probs of the
     selected experts (Qwen2-MoE/DeepSeek ``norm_topk_prob=False``).
+    ``second_expert_policy="random"`` + ``rng_key`` enables GShard's
+    random routing: slot j>=1 dispatches with probability
+    ``min(1, k * g_j)``.
     """
     s, d = x.shape
     e = num_expert
@@ -80,11 +131,20 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
 
     # position of each (token, k) within its expert's queue
     onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [s, k, e]
+
+    if second_expert_policy == "random" and rng_key is not None \
+            and top_k >= 2:
+        u = jax.random.uniform(rng_key, (s, top_k))
+        sel = u < jnp.minimum(top_k * topk_prob, 1.0)
+        sel = sel.at[:, 0].set(True)  # 1st choice always dispatches
+        onehot = onehot * sel[..., None].astype(onehot.dtype)
+
     flat = onehot.reshape(s * top_k, e)
     pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(
         s, top_k, e)  # [s, k, e]
     pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [s, k]
-    keep = pos < c
+    slot_used = jnp.sum(onehot, axis=-1) > 0  # [s, k]
+    keep = (pos < c) & slot_used
 
     # load-balancing aux loss (GShard eq.: e * sum(me * ce))
     me = jnp.mean(probs, axis=0)
@@ -113,6 +173,12 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     if expert_axis is not None:
         expert_out = _ep_constraint(expert_out, expert_axis)
     y = jnp.einsum("sec,ecd->sd", comb, expert_out)
+    if return_stats:
+        # fraction of requested (token, slot) dispatches that were
+        # dropped — capacity overflow plus random-routing skips
+        stats = {"drop_rate": 1.0 - jnp.sum(keep.astype(jnp.float32))
+                 / float(s * top_k)}
+        return y, aux, stats
     return y, aux
 
 
@@ -126,7 +192,11 @@ def _ep_constraint(arr, axis):
     try:
         return jax.lax.with_sharding_constraint(
             arr, NamedSharding(mesh, spec))
-    except Exception:
+    except Exception as exc:
+        import warnings
+        warnings.warn(
+            f"moe: expert-parallel sharding constraint on axis {axis!r} "
+            f"failed ({exc!r}); expert compute stays replicated")
         return arr
 
 
@@ -137,7 +207,7 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, experts: List[Layer] = None, gate=None,
                  moe_group=None, mp_group=None, recompute_interval=0,
-                 top_k=2, capacity_factor=1.25, moe_axis="dp", **kwargs):
+                 top_k=2, capacity_factor=None, moe_axis="dp", **kwargs):
         super().__init__()
         self.d_model = d_model
         from ..nn.layer.container import LayerList
@@ -152,10 +222,20 @@ class MoELayer(Layer):
             gate = cls(d_model, self.num_expert, topk=topk)
         self.gate = gate
         self.top_k = getattr(gate, "top_k", top_k)
-        self.capacity_factor = capacity_factor
+        # explicit layer arg wins; else the gate's capacity; else 1.25
+        if capacity_factor is not None:
+            self.capacity_factor = capacity_factor
+        else:
+            gate_cap = getattr(gate, "capacity_factor", None)
+            self.capacity_factor = 1.25 if gate_cap is None else gate_cap
         self.moe_axis = moe_axis
         # stacked expert params: [e, ...] (template = expert 0)
         self._template = self.experts[0] if self.num_expert else None
+        # mark for MoE-aware grad clip (ClipGradForMOEByGlobalNorm)
+        for exp in self.experts:
+            for p in exp.parameters():
+                p.is_expert_param = True
+        self.drop_rate = None
 
     def _flat_params(self):
         """All expert params expert-major, as the live Tensor objects (so
@@ -175,6 +255,12 @@ class MoELayer(Layer):
         e = self.num_expert
         template = self._template
         param_objs = [p for _, p in template.named_parameters()]
+
+        second_policy = getattr(self.gate, "second_expert_policy", "all")
+        rng_key = None
+        if second_policy == "random" and self.training:
+            from ..framework import random as _random
+            rng_key = _random.next_key()
 
         def f(x_arr, logit_arr, *flat):
             # restack [e, ...] per param position from the flat operands
@@ -197,13 +283,16 @@ class MoELayer(Layer):
                         for p, arr in zip(param_objs, saved):
                             p._data = arr
                 return jax.lax.map(one, (tuple(stk), expert_in))
-            y, aux = moe_dispatch_combine(
+            y, aux, stats = moe_dispatch_combine(
                 x_arr, logit_arr, self.num_expert, self.top_k,
-                self.capacity_factor, efn, self.moe_axis)
-            return y, aux
+                self.capacity_factor, efn, self.moe_axis,
+                second_expert_policy=second_policy, rng_key=rng_key,
+                return_stats=True)
+            return y, aux, stats["drop_rate"]
 
-        y, aux = apply_jax("moe", f, x2, logits, *flat_params,
-                           n_outputs=2)
+        y, aux, drop = apply_jax("moe", f, x2, logits, *flat_params,
+                                 n_outputs=3)
         self.gate.loss = aux
         self._aux_loss = aux
+        self.drop_rate = drop
         return reshape(y, list(orig_shape))

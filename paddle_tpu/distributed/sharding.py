@@ -21,7 +21,9 @@ from . import env as _env
 from .shard_utils import annotate_param, mesh_axis_size
 
 __all__ = ["group_sharded_parallel", "save_group_sharded_model",
-           "ShardingOptimizerStage2", "shard_optimizer_states"]
+           "ShardingOptimizerStage2", "shard_optimizer_states",
+           "shard_gradients", "constrain_grad_shards",
+           "GroupShardedScaler"]
 
 
 def _shardable_dim0(param, degree):
@@ -44,7 +46,11 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
                     p, "dist_spec", None) is None:
                 spec = ["sharding"] + [None] * (len(p.shape) - 1)
                 annotate_param(p, spec)
+    if level in ("os_g", "p_g_os"):
+        shard_gradients(optimizer)
     shard_optimizer_states(optimizer, degree)
+    if scaler is not None:
+        scaler = GroupShardedScaler(scaler)
     return model, optimizer, scaler
 
 
@@ -65,12 +71,75 @@ def shard_optimizer_states(optimizer, degree=None):
             try:
                 acc = jax.device_put(acc, NamedSharding(mesh, spec))
                 optimizer._accumulators[name][id(param)] = acc
-            except Exception:
-                pass
+            except Exception as exc:
+                import warnings
+                warnings.warn(
+                    f"sharding: could not shard optimizer state {name!r} "
+                    f"for param shape {tuple(param.shape)}: {exc!r}; "
+                    "state stays replicated")
         return acc
 
     optimizer._create_accumulator = sharded_create
     return optimizer
+
+
+def shard_gradients(optimizer):
+    """ZeRO stage-2 semantics (``GroupShardedStage2`` parity): mark the
+    optimizer so the jitted TrainStep constrains every gradient to
+    ``P("sharding")`` on dim 0. XLA then lowers the data-parallel grad
+    all-reduce to reduce-scatter, the optimizer update consumes the
+    local grad shard, and (with stage-1 sharded accumulators) the param
+    write-back all-gathers — the reference's reduce-scatter-hook
+    machinery, expressed as a sharding constraint."""
+    optimizer._shard_grads = True
+    return optimizer
+
+
+def constrain_grad_shards(grads, params=None, axis="sharding"):
+    """Apply the stage-2 grad sharding constraint to a list of (traced)
+    grad arrays. ``params`` (matching Tensors, optional) let the
+    constraint respect existing layouts: a grad whose param is already
+    sharded on dim 0 (stage-3/mp) is skipped, and other dims keep the
+    param's spec so mp-sharded grads are not resharded to replicated."""
+    mesh = _env.get_mesh()
+    degree = mesh_axis_size(axis)
+    if mesh is None or degree <= 1:
+        return grads
+    params = params or [None] * len(grads)
+    out = []
+    for g, p in zip(grads, params):
+        if g is None or getattr(g, "ndim", 0) < 1 \
+                or g.shape[0] % degree != 0:
+            out.append(g)
+            continue
+        pspec = getattr(p, "dist_spec", None) if p is not None else None
+        rest = [None] * (g.ndim - 1)
+        if pspec is not None:
+            entries = list(pspec) + [None] * (g.ndim - len(pspec))
+            if entries[0] is not None:
+                out.append(g)  # dim 0 already owned by another axis
+                continue
+            rest = entries[1:g.ndim]
+        spec = P(*([axis] + rest))
+        out.append(jax.lax.with_sharding_constraint(
+            g, NamedSharding(mesh, spec)))
+    return out
+
+
+class GroupShardedScaler:
+    """``GroupShardedScaler`` parity wrapper. The reference overrides
+    ``unscale_`` to all-reduce the found-inf flag across shard ranks
+    (each rank only checks its grad shard). Under GSPMD the finite
+    check in ``amp.GradScaler`` reduces over full logical grad arrays,
+    so the flag is already globally consistent — delegation IS the
+    TPU-correct implementation; the class exists so reference scripts
+    (`scaler = GroupShardedScaler(scaler)`) run unchanged."""
+
+    def __init__(self, scaler):
+        self._scaler = scaler
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_scaler"], name)
 
 
 class ShardingOptimizerStage2:

@@ -230,6 +230,14 @@ class TrainStep:
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_params)
 
+            # ZeRO stage-2: constrain grads to the sharding axis so XLA
+            # reduce-scatters them and updates on local shards
+            if getattr(opt, "_shard_grads", False):
+                from ..distributed.sharding import constrain_grad_shards
+                t_objs = [p for (_, p), t in zip(binder.param_items,
+                                                 trainable) if t]
+                grads = constrain_grad_shards(grads, params=t_objs)
+
             # grad clip (operates on Tensor pairs — pure jnp inside)
             if opt._grad_clip is not None:
                 pairs = [( _wrap_out(p), _wrap_out(g))
