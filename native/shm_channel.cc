@@ -167,6 +167,10 @@ int shmch_push(void* handle, const void* buf, uint64_t len,
   while (h->capacity - used(h) < need && !h->closed) {
     int r = timed ? pthread_cond_timedwait(&h->nonfull, &h->mu, &ts)
                   : pthread_cond_wait(&h->nonfull, &h->mu);
+    if (r == EOWNERDEAD) {  // peer died holding mu during the wait
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
     if (r == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -2;
@@ -197,6 +201,10 @@ int64_t shmch_pop(void* handle, void* out, uint64_t cap,
   while (used(h) == 0 && !h->closed) {
     int r = timed ? pthread_cond_timedwait(&h->nonempty, &h->mu, &ts)
                   : pthread_cond_wait(&h->nonempty, &h->mu);
+    if (r == EOWNERDEAD) {  // peer died holding mu during the wait
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
     if (r == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -2;
@@ -225,6 +233,10 @@ int64_t shmch_peek_len(void* handle, int64_t timeout_ms) {
   while (used(h) == 0 && !h->closed) {
     int r = timed ? pthread_cond_timedwait(&h->nonempty, &h->mu, &ts)
                   : pthread_cond_wait(&h->nonempty, &h->mu);
+    if (r == EOWNERDEAD) {  // peer died holding mu during the wait
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
     if (r == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -2;

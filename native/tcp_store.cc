@@ -11,6 +11,7 @@
 // park inside their connection thread.
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -28,6 +29,11 @@
 #include <vector>
 
 namespace {
+
+// untrusted length-prefix ceiling: rendezvous values are tiny
+// (endpoints, ranks); 64 MiB leaves headroom without letting a rogue
+// peer OOM rank 0 with a 4 GiB allocation
+constexpr uint64_t kMaxValLen = 64ull << 20;
 
 enum Cmd : uint8_t {
   kSet = 1,
@@ -106,7 +112,7 @@ struct Server {
 
       if (cmd == kSet) {
         uint64_t vlen = 0;
-        if (!recv_all(fd, &vlen, 8) || vlen > (1ull << 32)) break;
+        if (!recv_all(fd, &vlen, 8) || vlen > kMaxValLen) break;
         std::vector<char> val(vlen);
         if (vlen && !recv_all(fd, val.data(), vlen)) break;
         {
@@ -208,13 +214,19 @@ struct Client {
   std::mutex mu;  // one request in flight per client
 };
 
+
 }  // namespace
 
 extern "C" {
 
 // Returns the bound port (>0) on success (port=0 picks a free one),
-// negative errno on failure. *out_handle receives the server.
-int64_t tcps_server_start(int port, void** out_handle) {
+// negative errno on failure. *out_handle receives the server. host
+// limits the listening interface (the store is unauthenticated —
+// binding INADDR_ANY would let any network peer write keys / push
+// large values at rank 0); null/empty falls back to all interfaces
+// for multi-host rendezvous.
+int64_t tcps_server_start_host(const char* host, int port,
+                               void** out_handle) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -errno;
   int one = 1;
@@ -222,6 +234,22 @@ int64_t tcps_server_start(int port, void** out_handle) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host && host[0] &&
+      ::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    // not a literal IP: resolve (e.g. "localhost", pod DNS names)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host, nullptr, &hints, &res) == 0 && res) {
+      addr.sin_addr = reinterpret_cast<sockaddr_in*>(
+          res->ai_addr)->sin_addr;
+      freeaddrinfo(res);
+    } else {
+      ::close(fd);
+      return -EINVAL;
+    }
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       ::listen(fd, 128) < 0) {
@@ -236,6 +264,11 @@ int64_t tcps_server_start(int port, void** out_handle) {
   s->accept_thread = std::thread([s] { s->accept_loop(); });
   *out_handle = s;
   return ntohs(addr.sin_port);
+}
+
+// back-compat: bind all interfaces
+int64_t tcps_server_start(int port, void** out_handle) {
+  return tcps_server_start_host(nullptr, port, out_handle);
 }
 
 void tcps_server_stop(void* h) {

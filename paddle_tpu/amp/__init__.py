@@ -38,6 +38,10 @@ class _AmpState(threading.local):
         self.enabled = False
         self.dtype = "bfloat16"
         self.level = "O1"
+        # effective per-context lists (reentrancy: nested auto_cast with
+        # custom lists must not corrupt the module-global defaults)
+        self.white = None
+        self.black = None
 
 
 _state = _AmpState()
@@ -55,11 +59,13 @@ def _cast_for_op(op_name, arrays):
     if not _state.enabled or _state.level != "O1":
         return arrays
     low = convert_dtype(_state.dtype).np_dtype
-    if op_name in WHITE_LIST:
+    white = _state.white if _state.white is not None else WHITE_LIST
+    black = _state.black if _state.black is not None else BLACK_LIST
+    if op_name in white:
         return [a.astype(low) if hasattr(a, "dtype")
                 and jnp.issubdtype(a.dtype, jnp.floating) else a
                 for a in arrays]
-    if op_name in BLACK_LIST:
+    if op_name in black:
         return [a.astype(np.float32) if hasattr(a, "dtype")
                 and a.dtype == low else a for a in arrays]
     return arrays
@@ -71,20 +77,20 @@ _set_amp_hook(_cast_for_op)
 @contextlib.contextmanager
 def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
               level="O1", dtype="bfloat16", use_promote=True):
-    prev = (_state.enabled, _state.dtype, _state.level)
-    added_w = set(custom_white_list or ())
-    added_b = set(custom_black_list or ())
-    WHITE_LIST.update(added_w)
-    BLACK_LIST.update(added_b)
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white,
+            _state.black)
+    base_w = _state.white if _state.white is not None else WHITE_LIST
+    base_b = _state.black if _state.black is not None else BLACK_LIST
+    _state.white = base_w | set(custom_white_list or ())
+    _state.black = base_b | set(custom_black_list or ())
     _state.enabled = enable
     _state.dtype = dtype
     _state.level = level
     try:
         yield
     finally:
-        _state.enabled, _state.dtype, _state.level = prev
-        WHITE_LIST.difference_update(added_w)
-        BLACK_LIST.difference_update(added_b)
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = prev
 
 
 autocast = auto_cast
@@ -131,6 +137,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
 
     def scale(self, var):
         if not self._enable or self._scale == 1.0:
@@ -140,22 +147,34 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if self._unscaled:
+            # Paddle raises here too: a second unscale_ would divide
+            # the gradients by the scale twice and silently stall
+            raise RuntimeError(
+                "unscale_() has already been called on this optimizer "
+                "since the last update()")
+        self._unscaled = True
         inv = 1.0 / self._scale
-        found = False
+        new_grads = []
+        finite_flags = []
         for p in optimizer._parameter_list:
             if p.grad is not None:
                 g = as_jax(p.grad) * inv
-                finite = bool(jnp.all(jnp.isfinite(g)))
-                if not finite:
-                    found = True
-                p._grad = _wrap_out(g)
+                new_grads.append((p, g))
+                finite_flags.append(jnp.all(jnp.isfinite(g)))
+        # ONE fused finite-check + ONE host sync for the whole param set
+        # (check_finite_and_unscale op parity) — not one per parameter
+        found = bool(jnp.logical_not(
+            jnp.all(jnp.stack(finite_flags)))) if finite_flags else False
+        for p, g in new_grads:
+            p._grad = _wrap_out(g)
         self._found_inf = found
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
-        if self._scale != 1.0:
+        if self._scale != 1.0 and not self._unscaled:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
@@ -165,6 +184,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
+        self._unscaled = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

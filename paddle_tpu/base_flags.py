@@ -10,6 +10,7 @@ import os
 from typing import Any, Dict
 
 _FLAGS: Dict[str, Any] = {}
+_version = 0  # bumped on set_flags so hot-path consumers can cache
 _DEFAULTS: Dict[str, Any] = {
     "FLAGS_check_nan_inf": False,
     "FLAGS_check_nan_inf_level": 0,
@@ -48,9 +49,31 @@ def get_flag(name: str, default=None):
     return default
 
 
+def register_flag(name: str, default):
+    """Register an extension flag (``PHI_DEFINE_EXPORTED_*`` parity)."""
+    _DEFAULTS.setdefault(name, default)
+
+
 def set_flags(flags: dict):
+    global _version
+    _version += 1
     for k, v in flags.items():
-        _FLAGS[k] = v
+        if k not in _DEFAULTS:
+            if not k.startswith("FLAGS_"):
+                # not even flag-shaped — reject (gflags parity)
+                raise ValueError(
+                    f"unknown flag {k!r}; known flags: "
+                    f"{sorted(_DEFAULTS)} (register_flag to add one)")
+            # flag-shaped but unregistered: accept as an inert knob so
+            # reference scripts setting CUDA-era flags keep running,
+            # but say so — this also surfaces typos
+            import warnings
+            warnings.warn(
+                f"set_flags: {k!r} is not consumed by paddle_tpu "
+                "(accepted as a no-op knob; register_flag() to "
+                "silence)")
+            _DEFAULTS[k] = v
+        _FLAGS[k] = _coerce(_DEFAULTS[k], v) if isinstance(v, str) else v
 
 
 def get_flags(flags):

@@ -128,6 +128,17 @@ def _placements_to_spec(mesh: ProcessMesh, placements, ndim: int):
     tensor dims."""
     tensor_axes: List = [None] * ndim
     for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Partial):
+            # GSPMD arrays hold global values; a user-visible pending-
+            # reduction state does not exist outside compiled programs.
+            # Silently replicating would be numerically wrong by a
+            # factor of the mesh-dim size — refuse instead.
+            raise NotImplementedError(
+                f"Partial placement on mesh dim {mesh_dim} is not "
+                "representable on materialized arrays (partial-sum "
+                "states only exist transiently inside compiled GSPMD "
+                "programs). psum the value onto Replicate() first, or "
+                "use Shard(dim).")
         if isinstance(pl, Shard):
             name = mesh.dim_names[mesh_dim]
             cur = tensor_axes[pl.dim]

@@ -132,6 +132,7 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     # position of each (token, k) within its expert's queue
     onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [s, k, e]
 
+    sel = None
     if second_expert_policy == "random" and rng_key is not None \
             and top_k >= 2:
         u = jax.random.uniform(rng_key, (s, top_k))
@@ -151,11 +152,16 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     ce = jnp.mean(onehot[:, 0].astype(jnp.float32), axis=0)
     aux = e * jnp.sum(me * ce)
 
+    # random-skipped slots are zeroed BEFORE normalization (GShard/
+    # fairseq top2gating order): a token whose 2nd expert was skipped
+    # combines with weight ~1.0, not g1/(g1+g2)
+    eff_prob = topk_prob if sel is None \
+        else topk_prob * sel.astype(topk_prob.dtype)
     if normalize_gates:
-        gates = topk_prob / jnp.maximum(
-            jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+        gates = eff_prob / jnp.maximum(
+            jnp.sum(eff_prob, axis=-1, keepdims=True), 1e-9)
     else:
-        gates = topk_prob
+        gates = eff_prob
     gates = jnp.where(keep, gates, 0.0).astype(x.dtype)
 
     # dispatch mask [s, k, e, c]

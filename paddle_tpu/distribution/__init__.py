@@ -336,9 +336,11 @@ class Multinomial(Distribution):
         out_shape = _shape(shape, self.batch_shape)
         n_cat = p.shape[-1]
         logits = jnp.log(p)
+        # categorical requires the logits batch dims to be a SUFFIX of
+        # the draw shape: put total_count in front, then move it last
         draws = jax.random.categorical(
-            key, logits, shape=out_shape + (self.total_count,))
-        counts = jax.nn.one_hot(draws, n_cat, dtype=jnp.float32).sum(-2)
+            key, logits, shape=(self.total_count,) + out_shape)
+        counts = jax.nn.one_hot(draws, n_cat, dtype=jnp.float32).sum(0)
         return _wrap_out(counts)
 
     def log_prob(self, value):

@@ -467,6 +467,40 @@ def set_amp_hook(hook):
     _amp_hook = hook
 
 
+# static-graph dispatch gate: False until paddle.static.data() creates
+# the first placeholder in this process
+_static_graph_seen = False
+
+
+def _mark_static_graph_used():
+    global _static_graph_seen
+    _static_graph_seen = True
+
+
+def _is_symbolic(x) -> bool:
+    return isinstance(x, Tensor) and (
+        getattr(x, "_feed_name", None) is not None
+        or getattr(x, "_node", None) is not None)
+
+
+def _any_symbolic(inputs) -> bool:
+    return any(_is_symbolic(x) for x in inputs)
+
+
+def tree_to_arrays(tree):
+    """Pytree of Tensors -> raw arrays (shared by jit and static.nn)."""
+    return jax.tree_util.tree_map(
+        lambda x: as_jax(x) if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def tree_to_tensors(tree):
+    """Raw arrays/tracers in a pytree -> Tensors."""
+    return jax.tree_util.tree_map(
+        lambda x: _wrap_out(x) if isinstance(x, (jax.Array, jnp.ndarray))
+        or hasattr(x, "aval") else x, tree)
+
+
 def as_jax(x):
     """Tensor | array-like → jax array (no copy for Tensors)."""
     if isinstance(x, Tensor):
@@ -489,6 +523,33 @@ def _wrap_out(arr, stop_gradient=True) -> Tensor:
     return t
 
 
+# FLAGS_check_nan_inf consumer (reference: nan_inf_utils_detail.* hooks
+# every kernel output — SURVEY §5.2). Cached against the flag-registry
+# version so the off-path costs one int compare per op.
+_nan_check_cache = (-1, False)
+
+
+def _nan_check_enabled() -> bool:
+    global _nan_check_cache
+    from .. import base_flags as bf
+    if _nan_check_cache[0] != bf._version:
+        _nan_check_cache = (bf._version,
+                            bool(bf.get_flag("FLAGS_check_nan_inf")))
+    return _nan_check_cache[1]
+
+
+def _check_nan_inf(op_name: str, outputs):
+    for o in outputs:
+        if hasattr(o, "dtype") and jnp.issubdtype(o.dtype, jnp.inexact) \
+                and not _is_tracer(o):
+            bad = int(jnp.sum(~jnp.isfinite(o)))
+            if bad:
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: op {op_name!r} produced "
+                    f"{bad} non-finite value(s) in output shape "
+                    f"{tuple(o.shape)} dtype {o.dtype}")
+
+
 def apply_jax(op_name: str, fn: Callable, *inputs, n_outputs: int = 1,
               **ignored):
     """Execute ``fn(*arrays)`` over the inputs' arrays, recording autograd.
@@ -498,6 +559,14 @@ def apply_jax(op_name: str, fn: Callable, *inputs, n_outputs: int = 1,
     input requires grad and grad mode is on, a ``jax.vjp`` pullback is
     recorded as a GradNode.
     """
+    # static-graph mode: any symbolic input turns this op into a lazy
+    # Program node instead of executing (``paddle.static`` DAG build).
+    # _static_graph_seen is flipped once by static.data(), so eager-only
+    # workloads never pay the per-input scan.
+    if _static_graph_seen and _any_symbolic(inputs):
+        from ..static.program import record_static_op
+        return record_static_op(op_name, fn, inputs, n_outputs)
+
     # python scalars stay raw: jax weak typing then matches Paddle's
     # promotion (float32 tensor + 2 -> float32)
     arrays = [x if isinstance(x, (int, float, bool, complex))
@@ -513,6 +582,10 @@ def apply_jax(op_name: str, fn: Callable, *inputs, n_outputs: int = 1,
                 diff_idx.append(i)
     if not diff_idx:
         out = fn(*arrays)
+        if _nan_check_enabled():
+            _check_nan_inf(op_name,
+                           out if isinstance(out, (tuple, list)) else
+                           (out,))
         if n_outputs == 1 and not isinstance(out, (tuple, list)):
             return _wrap_out(out)
         return tuple(_wrap_out(o) for o in out)
@@ -527,6 +600,8 @@ def apply_jax(op_name: str, fn: Callable, *inputs, n_outputs: int = 1,
         return res if isinstance(res, tuple) else (res,)
 
     outs, vjp_fn = jax.vjp(g, *diff_arrays)
+    if _nan_check_enabled():
+        _check_nan_inf(op_name, outs)
     out_tensors = [_wrap_out(o, stop_gradient=False) for o in outs]
     node = GradNode(op_name, vjp_fn, [inputs[i] for i in diff_idx],
                     out_tensors)

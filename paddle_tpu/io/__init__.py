@@ -323,6 +323,50 @@ def default_collate_fn(batch):
     return batch
 
 
+def _spawn_worker_main(w, n, shm_name, capacity, loader):
+    """Entry point of a spawned DataLoader worker: open the parent's shm
+    ring and stream this worker's share of batches into it. Runs in a
+    fresh interpreter (spawn), so no inherited JAX locks."""
+    from ..native import ShmChannel
+    channel = ShmChannel(shm_name, capacity=capacity, create=False)
+    code = 0
+    try:
+        global _worker_info
+        _worker_info = _WorkerInfo(w, n, loader.dataset)
+        if loader.worker_init_fn is not None:
+            loader.worker_init_fn(w)
+        if loader.batch_sampler is not None and not loader._iterable_ds:
+            # map-style: skip foreign batches BEFORE touching the
+            # dataset (no wasted decode)
+            def my_batches():
+                for b, idxs in enumerate(loader.batch_sampler):
+                    if b % n == w:
+                        yield loader.collate_fn(
+                            [loader.dataset[i] for i in idxs])
+            it = my_batches()
+        elif loader._iterable_ds:
+            # iterable: sharding is the dataset's job via
+            # get_worker_info() (torch/paddle semantics); an extra b%n
+            # filter here would drop data from datasets that DO shard
+            it = loader._raw_iter()
+        else:
+            it = (item for b, item in enumerate(loader._raw_iter())
+                  if b % n == w)
+        for item in it:
+            channel.put(("ok", _tree_to_numpy(item)),
+                        timeout=loader.timeout)
+    except BaseException:
+        code = 1
+        try:
+            channel.put(("error", traceback.format_exc()),
+                        timeout=loader.timeout)
+        except BaseException:
+            pass
+    finally:
+        channel.close_write()
+        os._exit(code)  # skip atexit/teardown in the worker
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -380,81 +424,52 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def _mp_iter(self):
-        """Forked worker processes push collated batches through the
+        """Spawned worker processes push collated batches through the
         native shm ring. Worker w owns batches w, w+n, w+2n…, so the
-        parent preserves sampler order by round-robin popping."""
+        parent preserves sampler order by round-robin popping.
+
+        spawn (not fork): the parent runs multithreaded JAX, and a
+        forked child inheriting its mutexes can deadlock (jax itself
+        warns on fork). spawn re-imports in a clean child; the loader
+        state (dataset/sampler/collate_fn) rides over by pickle — if it
+        is unpicklable, fall back to the threaded prefetcher."""
+        import multiprocessing as _mp
         from ..native import ShmChannel
         n = self.num_workers
         uid = uuid.uuid4().hex[:8]
         cap = int(os.environ.get("FLAGS_dataloader_shm_size",
                                  64 * 1024 * 1024))
-        channels = [ShmChannel(f"/ptdl_{os.getpid()}_{uid}_{i}",
-                               capacity=cap, create=True)
-                    for i in range(n)]
-        pids = []
+        names = [f"/ptdl_{os.getpid()}_{uid}_{i}" for i in range(n)]
+        channels = [ShmChannel(nm, capacity=cap, create=True)
+                    for nm in names]
+        ctx = _mp.get_context("spawn")
+        procs = []
         try:
-            for w in range(n):
-                pid = os.fork()
-                if pid == 0:  # worker
-                    code = 0
-                    try:
-                        global _worker_info
-                        _worker_info = _WorkerInfo(w, n, self.dataset)
-                        if self.worker_init_fn is not None:
-                            self.worker_init_fn(w)
-                        if (self.batch_sampler is not None
-                                and not self._iterable_ds):
-                            # map-style: skip foreign batches BEFORE
-                            # touching the dataset (no wasted decode)
-                            def my_batches():
-                                for b, idxs in enumerate(
-                                        self.batch_sampler):
-                                    if b % n == w:
-                                        yield self.collate_fn(
-                                            [self.dataset[i]
-                                             for i in idxs])
-                            it = my_batches()
-                        elif self._iterable_ds:
-                            # iterable: sharding is the dataset's job via
-                            # get_worker_info() (torch/paddle semantics);
-                            # an extra b%n filter here would drop data
-                            # from datasets that DO shard themselves
-                            it = self._raw_iter()
-                        else:
-                            it = (item for b, item in
-                                  enumerate(self._raw_iter())
-                                  if b % n == w)
-                        for item in it:
-                            channels[w].put(
-                                ("ok", _tree_to_numpy(item)),
-                                timeout=self.timeout)
-                    except BaseException:
-                        code = 1
-                        try:
-                            channels[w].put(
-                                ("error", traceback.format_exc()),
-                                timeout=self.timeout)
-                        except BaseException:
-                            pass
-                    finally:
-                        channels[w].close_write()
-                        os._exit(code)  # skip parent atexit/jax teardown
-                pids.append(pid)
-
-            reaped = {}
+            try:
+                for w in range(n):
+                    p = ctx.Process(
+                        target=_spawn_worker_main,
+                        args=(w, n, names[w], cap, self), daemon=True)
+                    p.start()  # pickles args here
+                    procs.append(p)
+            except Exception as exc:
+                import warnings
+                warnings.warn(
+                    f"DataLoader: could not spawn workers ({exc!r}); "
+                    "falling back to threaded prefetching. Make the "
+                    "dataset/sampler/collate_fn picklable to enable "
+                    "multiprocess loading.")
+                for pr in procs:
+                    pr.terminate()
+                for ch in channels:
+                    ch.close_write()
+                    ch.close()
+                channels = []
+                yield from self._threaded_iter()
+                return
 
             def _alive(i):
-                if pids[i] in reaped:
-                    return False
-                try:
-                    p, status = os.waitpid(pids[i], os.WNOHANG)
-                except ChildProcessError:
-                    reaped[pids[i]] = None
-                    return False
-                if p == pids[i]:
-                    reaped[pids[i]] = status
-                    return False
-                return True
+                return procs[i].is_alive()
 
             done = [False] * n
             w = 0
@@ -479,7 +494,9 @@ class DataLoader:
                             except (TimeoutError, EOFError):
                                 raise RuntimeError(
                                     f"DataLoader worker {w} (pid "
-                                    f"{pids[w]}) exited unexpectedly")
+                                    f"{procs[w].pid}, exitcode "
+                                    f"{procs[w].exitcode}) exited "
+                                    "unexpectedly")
                         if (deadline is not None
                                 and time.monotonic() > deadline):
                             raise TimeoutError(
@@ -499,18 +516,14 @@ class DataLoader:
                 w = (w + 1) % n
         finally:
             # unblock workers parked in push BEFORE reaping, then a
-            # bounded blocking wait so early loop exit leaves no zombies
+            # bounded join so early loop exit leaves no zombies
             for ch in channels:
                 ch.close_write()
-            for pid in pids:
-                try:
-                    for _ in range(100):  # <=5s per worker
-                        p, _st = os.waitpid(pid, os.WNOHANG)
-                        if p == pid:
-                            break
-                        time.sleep(0.05)
-                except ChildProcessError:
-                    pass
+            for pr in procs:
+                pr.join(timeout=5)
+                if pr.is_alive():
+                    pr.terminate()
+                    pr.join(timeout=1)
             for ch in channels:
                 ch.close()
 
@@ -518,11 +531,14 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._raw_iter()
             return
-        if self.use_shared_memory and hasattr(os, "fork"):
+        if self.use_shared_memory:
             from .. import native
             if native.is_available():
                 yield from self._mp_iter()
                 return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
         # threaded prefetch: decode-ahead while the device runs
         q: "queue.Queue" = queue.Queue(
             maxsize=self.prefetch_factor * max(1, self.num_workers))

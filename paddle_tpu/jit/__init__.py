@@ -76,16 +76,8 @@ class _LayerBinder:
                 b._data = arr
 
 
-def _tree_to_arrays(tree):
-    return jax.tree_util.tree_map(
-        lambda x: as_jax(x) if isinstance(x, Tensor) else x, tree,
-        is_leaf=lambda x: isinstance(x, Tensor))
-
-
-def _tree_to_tensors(tree):
-    return jax.tree_util.tree_map(
-        lambda x: _wrap_out(x) if isinstance(x, (jax.Array, jnp.ndarray))
-        or hasattr(x, "aval") else x, tree)
+from ..framework.core import tree_to_arrays as _tree_to_arrays
+from ..framework.core import tree_to_tensors as _tree_to_tensors
 
 
 class StaticFunction:
@@ -110,15 +102,17 @@ class StaticFunction:
                 return _tree_to_arrays(out), new_buffers
         else:
             def pure(param_arrays, buffer_arrays, args, kwargs):
+                # hand the user fn Tensors (not raw tracers) so the
+                # paddle API surface — including failure modes like
+                # .numpy() mid-trace — behaves as in eager
                 with functional_mode(), no_grad():
-                    out = self._fn(*args, **kwargs)
+                    out = self._fn(*_tree_to_tensors(args),
+                                   **_tree_to_tensors(kwargs))
                 return _tree_to_arrays(out), []
         return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
-        if not _to_static_enabled:
-            if self._layer is not None:
-                return self._fn(*args, **kwargs)
+        if not _to_static_enabled or getattr(self, "_fallback", False):
             return self._fn(*args, **kwargs)
         if self._jitted is None:
             self._jitted = self._build()
@@ -129,7 +123,26 @@ class StaticFunction:
             b = self._binder.buffer_arrays()
         else:
             p, b = [], []
-        out, new_buffers = self._jitted(p, b, args_arrays, kwargs_arrays)
+        try:
+            out, new_buffers = self._jitted(p, b, args_arrays,
+                                            kwargs_arrays)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as exc:
+            # SOT-style graph break (reference: jit/sot graph-break
+            # fallback): the function does something untraceable (Python
+            # control flow on tensor values, .numpy() mid-graph, ...) —
+            # run it eagerly from now on instead of crashing. Use
+            # paddle.static.nn.cond/while_loop to make it compilable.
+            import warnings
+            warnings.warn(
+                f"to_static: {getattr(self._fn, '__name__', self._fn)} "
+                f"is not traceable ({type(exc).__name__}); falling back "
+                "to eager execution. Use paddle.static.nn.cond/"
+                "while_loop for data-dependent control flow.")
+            self._fallback = True
+            return self._fn(*args, **kwargs)
         if self._binder is not None:
             for (_, buf), arr in zip(self._binder.buffer_items, new_buffers):
                 buf._data = arr
@@ -168,13 +181,16 @@ class TrainStep:
     reference's fused optimizer + CINN path and the entry point used by
     ``paddle.Model.fit`` and ``bench.py``."""
 
-    def __init__(self, layer, loss_fn, optimizer, donate=True):
+    def __init__(self, layer, loss_fn, optimizer, donate=None):
         self.layer = layer
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.binder = _LayerBinder(layer)
         self._jitted = None
         self._state_keys: List[List[str]] = []
+        if donate is None:
+            from ..base_flags import get_flag
+            donate = bool(get_flag("FLAGS_paddle_tpu_donate_buffers"))
         self._donate = donate
 
     # -- optimizer state as a pytree -----------------------------------
@@ -294,6 +310,13 @@ class TrainStep:
         self.optimizer._step_count += 1
         if hasattr(self.optimizer._learning_rate, "step"):
             pass  # scheduler stepping stays caller-controlled (Paddle parity)
+        from ..framework.core import _nan_check_enabled
+        if _nan_check_enabled():
+            val = float(np.asarray(loss))
+            if not np.isfinite(val):
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: non-finite loss {val} at "
+                    f"train step {self._step_idx - 1}")
         return _wrap_out(loss)
 
 
@@ -301,11 +324,36 @@ class TrainStep:
 # jit.save / jit.load
 # ---------------------------------------------------------------------------
 
-def _spec_to_sds(spec):
+def _specs_to_sds(specs):
+    """InputSpecs -> ShapeDtypeStructs. None/-1 dims become jax.export
+    symbolic dimensions (shared scope), so the exported StableHLO module
+    accepts any size there — matching InputSpec([None, ...]) dynamic-
+    batch semantics instead of silently baking batch=1."""
     import numpy as _np
-    shape = [1 if d is None or (isinstance(d, int) and d < 0) else d
-             for d in spec.shape]
-    return jax.ShapeDtypeStruct(tuple(shape), _np.dtype(spec.dtype))
+    from jax import export as jexport
+    scope = None
+    out = []
+    for si, s in enumerate(specs):
+        dim_strs = []
+        dynamic = False
+        for di, d in enumerate(s.shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                # name by dim POSITION so the dynamic batch dim of
+                # multi-input models unifies to one variable (x + mask
+                # with two independent batch symbols cannot trace)
+                dim_strs.append(f"_dyn_d{di}")
+                dynamic = True
+            else:
+                dim_strs.append(str(int(d)))
+        if dynamic:
+            if scope is None:
+                scope = jexport.SymbolicScope()
+            shape = jexport.symbolic_shape(",".join(dim_strs),
+                                           scope=scope)
+        else:
+            shape = tuple(int(d) for d in s.shape)
+        out.append(jax.ShapeDtypeStruct(shape, _np.dtype(s.dtype)))
+    return out
 
 
 def save(layer, path, input_spec=None, **configs):
@@ -343,7 +391,7 @@ def save(layer, path, input_spec=None, **configs):
         from jax import export as jexport
         exported = jexport.export(jax.jit(fwd))(
             [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params],
-            *[_spec_to_sds(s) for s in specs])
+            *_specs_to_sds(specs))
         with open(path + ".pdmodel", "wb") as f:
             f.write(exported.serialize())
         meta["param_names"] = [n for n, _ in binder.param_items]

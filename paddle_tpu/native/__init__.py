@@ -64,6 +64,10 @@ def load_library() -> ctypes.CDLL:
         lib.tcps_server_start.restype = ctypes.c_int64
         lib.tcps_server_start.argtypes = [ctypes.c_int,
                                           ctypes.POINTER(c)]
+        lib.tcps_server_start_host.restype = ctypes.c_int64
+        lib.tcps_server_start_host.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_int,
+                                               ctypes.POINTER(c)]
         lib.tcps_server_stop.argtypes = [c]
         lib.tcps_connect.restype = c
         lib.tcps_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
@@ -127,8 +131,20 @@ class TCPStore:
         self.timeout_ms = int(timeout * 1000)
         if is_master:
             handle = ctypes.c_void_p()
-            bound = lib.tcps_server_start(int(port),
-                                          ctypes.byref(handle))
+            # bind the requested interface only — the store is
+            # unauthenticated, so INADDR_ANY would expose rank 0.
+            # NAT/docker deployments advertise an address no local
+            # interface owns: fall back to all interfaces with a warning
+            bound = lib.tcps_server_start_host(host.encode(), int(port),
+                                               ctypes.byref(handle))
+            if bound < 0:
+                import warnings
+                warnings.warn(
+                    f"TCPStore: cannot bind {host!r} (errno "
+                    f"{-int(bound)}); listening on all interfaces — "
+                    "the advertised address is NAT/forwarded?")
+                bound = lib.tcps_server_start(int(port),
+                                              ctypes.byref(handle))
             if bound < 0:
                 raise OSError(-bound, "TCPStore bind failed")
             self._server = handle

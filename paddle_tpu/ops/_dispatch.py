@@ -44,6 +44,10 @@ def binary(name: str, fn: Callable):
 
 def nodiff(fn: Callable, *inputs):
     """Run an op outside the tape (integer/bool outputs: argmax, indices...)."""
+    from ..framework import core as _core
+    if _core._static_graph_seen and _core._any_symbolic(inputs):
+        from ..static.program import record_static_op
+        return record_static_op("nodiff", fn, inputs, 1)
     arrays = [as_jax(x) if not isinstance(x, _SCALAR_TYPES) else x
               for x in inputs]
     out = fn(*arrays)

@@ -3,8 +3,12 @@
 Paddle's static graph Program/Executor is structurally replaced by jax.jit
 (SURVEY.md §7.2): ``paddle.jit.to_static`` is the supported compile path.
 These entry points keep source compatibility for scripts that toggle modes.
+``static.nn`` provides the control-flow ops (cond/while_loop/switch_case)
+that Dy2Static lowers Python control flow to in the reference.
 """
 from __future__ import annotations
+
+from . import nn
 
 _static_mode = False
 
@@ -17,6 +21,10 @@ def enable_static():
 def disable_static():
     global _static_mode
     _static_mode = False
+    # restore the zero-cost eager dispatch path (drops the per-op
+    # symbolic-input scan); live SymbolicTensors error on use after this
+    from ..framework import core as _core
+    _core._static_graph_seen = False
 
 
 def in_dynamic_mode() -> bool:
@@ -42,10 +50,9 @@ class InputSpec:
                 f"name={self.name})")
 
 
-def default_main_program():
-    raise NotImplementedError(
-        "Program-based static graph is replaced by jax.jit; use "
-        "paddle.jit.to_static")
+from .program import (Executor, Program, SymbolicTensor, data,
+                      default_main_program, default_startup_program,
+                      global_scope, program_guard, scope_guard)
 
 
 def name_scope(prefix=None):

@@ -1,0 +1,149 @@
+"""Static control-flow ops (``paddle.static.nn.cond`` /
+``while_loop`` / ``switch_case`` — reference
+``python/paddle/static/nn/control_flow.py``; the Dy2Static AST
+transformers in ``python/paddle/jit/dy2static/`` lower Python ``if``/
+``while`` to these same ops).
+
+TPU-first: under a trace (``to_static``/``TrainStep``) they lower to
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` — XLA-compilable
+data-dependent control flow with static shapes. In eager mode the
+predicate is concrete, so plain Python dispatch runs the chosen branch
+(and the autograd tape records through it naturally).
+
+``while_loop`` under a trace is forward-only (``lax.while_loop`` has no
+reverse-mode rule); use Python loops or ``cond`` chains where gradients
+through the loop are needed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (Tensor, _is_symbolic, _is_tracer, as_jax,
+                              tree_to_arrays as _to_arrays,
+                              tree_to_tensors as _to_tensors)
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _reject_symbolic(*values, op="control flow"):
+    for v in values:
+        if _is_symbolic(v):
+            raise NotImplementedError(
+                f"static Program mode does not support {op} over "
+                "symbolic variables; build the branchy computation "
+                "under paddle.jit.to_static instead (static.nn lowers "
+                "to lax.cond/lax.while_loop there)")
+
+
+def _pred_array(pred):
+    _reject_symbolic(pred, op="cond/while predicates")
+    p = as_jax(pred) if isinstance(pred, Tensor) else pred
+    if isinstance(p, (bool, int)):
+        return bool(p), False
+    p = jnp.asarray(p)
+    if p.ndim != 0:
+        p = p.reshape(())
+    if _is_tracer(p):
+        return p.astype(jnp.bool_), True
+    return bool(p), False
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """``paddle.static.nn.cond`` parity. Branch outputs must be
+    matching pytrees of Tensors (lax.cond requirement under a trace)."""
+    p, traced = _pred_array(pred)
+    if not traced:
+        return true_fn() if p else (false_fn() if false_fn else None)
+
+    def t_branch(_):
+        return _to_arrays(true_fn())
+
+    def f_branch(_):
+        return _to_arrays(false_fn())
+
+    out = jax.lax.cond(p, t_branch, f_branch, operand=None)
+    return _to_tensors(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """``paddle.static.nn.while_loop`` parity. loop_vars: list/tuple of
+    Tensors; body must be shape-preserving under a trace."""
+    _reject_symbolic(*loop_vars, op="while_loop")
+    traced_any = any(
+        _is_tracer(as_jax(v)) for v in loop_vars if isinstance(v, Tensor))
+    if not traced_any:
+        vars_ = list(loop_vars)
+        while bool(as_jax(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+    def c(arrs):
+        r = cond_fn(*_to_tensors(list(arrs)))
+        return as_jax(r).reshape(()).astype(jnp.bool_)
+
+    def b(arrs):
+        out = body_fn(*_to_tensors(list(arrs)))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(_to_arrays(out))
+
+    init = tuple(_to_arrays(list(loop_vars)))
+    final = jax.lax.while_loop(c, b, init)
+    return [_to_tensors(a) for a in final]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """``paddle.static.nn.switch_case`` parity: branch_fns is a dict
+    {index: fn} or list of (index, fn) / fns; lowers to lax.switch."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    _reject_symbolic(branch_index, op="switch_case")
+    idx = as_jax(branch_index) if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    idx = idx.reshape(()).astype(jnp.int32)
+
+    if not _is_tracer(idx):
+        i = int(idx)
+        for k, f in items:
+            if i == k:
+                return f()
+        return default()
+
+    # map sparse keys -> dense branch list with default fallthrough
+    def make(f):
+        return lambda _: _to_arrays(f())
+
+    dense = [make(default)] * (max(keys) + 2)
+    for k, f in items:
+        dense[k] = make(f)
+    sel = jnp.where(
+        jnp.isin(idx, jnp.asarray(keys)), idx, len(dense) - 1)
+    out = jax.lax.switch(sel, dense, None)
+    return _to_tensors(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """``paddle.static.nn.case`` parity: first true predicate wins;
+    expressed as nested ``cond``s."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        return default() if default else None
+    (pred, fn), rest = pairs[0], pairs[1:]
+
+    def fallthrough():
+        return case(rest, default=default)
+
+    if rest or default is not None:
+        return cond(pred, fn, fallthrough)
+    return cond(pred, fn, fn)

@@ -1,0 +1,278 @@
+"""Static-graph Program/Executor compatibility layer
+(reference: ``python/paddle/static/`` + ``paddle/fluid/framework/
+new_executor/`` — Program build via op recording, StandaloneExecutor
+run with feed/fetch).
+
+TPU-first design: instead of a ProgramDesc + interpreter, static mode
+records a **lazy op DAG**. ``static.data`` creates symbolic feed
+tensors; every op dispatched through ``apply_jax`` whose inputs include
+a symbolic tensor records a node (the op's pure jax function + its
+inputs) and returns symbolic outputs whose metadata comes from
+``jax.eval_shape``. ``Executor.run`` topologically evaluates the
+fetches inside ONE ``jax.jit`` program per feed signature — the whole
+Program compiles to a single fused XLA executable, which is the
+InterpreterCore+CINN role collapsed into the compiler.
+
+Scope: inference/forward graphs (feed → ops → fetch). Static-mode
+*training* (append_backward, optimizer ops inside Programs) is not
+supported — use ``paddle.jit.to_static`` / ``TrainStep``, the supported
+compile path for training (SURVEY.md §7.2).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax
+
+__all__ = ["Program", "Executor", "program_guard", "data",
+           "default_main_program", "default_startup_program",
+           "SymbolicTensor"]
+
+
+class SymbolicTensor(Tensor):
+    """A value in a static Program: either a feed placeholder
+    (``_feed_name``) or an op output (``_node`` = (fn, inputs, out_idx,
+    n_outputs)). ``_data`` holds a ShapeDtypeStruct-backed zero-size
+    marker; reading values requires Executor.run."""
+
+    def __init__(self, sds, feed_name=None, node=None, name=None):
+        # do not call Tensor.__init__ (no concrete data exists)
+        self._data = _Abstract(sds)
+        self.stop_gradient = True
+        self.grad_node = None
+        self._grad = None
+        self.name = name or feed_name
+        self.persistable = False
+        self._hooks = None
+        self.is_leaf_override = None
+        self._feed_name = feed_name
+        self._node = node
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} lives in a static Program; run it "
+            "through static.Executor(...).run(feed=..., fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"SymbolicTensor(name={self.name}, shape={self.shape}, "
+                f"dtype={self._data.dtype})")
+
+
+class _Abstract:
+    """Minimal array-like metadata carrier for SymbolicTensor._data."""
+
+    def __init__(self, sds):
+        self.shape = tuple(sds.shape)
+        self.dtype = jnp.dtype(sds.dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        return _Abstract(jax.ShapeDtypeStruct(self.shape, dtype))
+
+
+def _sds_of(x):
+    if isinstance(x, SymbolicTensor):
+        # -1/None dims were normalized to 1 at data() time
+        return jax.ShapeDtypeStruct(x._data.shape, x._data.dtype)
+    if isinstance(x, Tensor):
+        a = as_jax(x)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    a = jnp.asarray(x)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def record_static_op(op_name, fn, inputs, n_outputs):
+    """Called from apply_jax when an input is symbolic: record the node,
+    return symbolic outputs (metadata via jax.eval_shape)."""
+    sds_in = [_sds_of(x) for x in inputs]
+    out_sds = jax.eval_shape(fn, *sds_in)
+    prog = default_main_program()
+    if isinstance(out_sds, (tuple, list)):
+        node = (fn, list(inputs), n_outputs)
+        outs = tuple(
+            SymbolicTensor(s, node=(node, i),
+                           name=f"{op_name}_{prog._next_id()}_{i}")
+            for i, s in enumerate(out_sds))
+        return outs
+    node = (fn, list(inputs), 1)
+    return SymbolicTensor(out_sds, node=(node, 0),
+                          name=f"{op_name}_{prog._next_id()}")
+
+
+class Program:
+    """``paddle.static.Program`` parity (a recording namespace; the ops
+    live in the SymbolicTensor DAG)."""
+
+    def __init__(self):
+        self._feed_vars: Dict[str, SymbolicTensor] = {}
+        self._counter = 0
+
+    def _next_id(self):
+        self._counter += 1
+        return self._counter
+
+    def global_block(self):
+        return self
+
+    @property
+    def vars(self):
+        return dict(self._feed_vars)
+
+    def clone(self, for_test=False):
+        return self
+
+    def __repr__(self):
+        return (f"Program(feeds={sorted(self._feed_vars)}, "
+                f"ops~{self._counter})")
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """``paddle.static.program_guard`` parity."""
+
+    def __init__(self, main_program, startup_program=None):
+        self._main = main_program
+        self._startup = startup_program
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        self._saved = (_default_main, _default_startup)
+        _default_main = self._main
+        if self._startup is not None:
+            _default_startup = self._startup
+        return self._main
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = self._saved
+        return False
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """``paddle.static.data`` parity: a named feed placeholder.
+    None/-1 dims are accepted; metadata shows 1 for them (the Executor
+    compiles per actual feed shape, so runtime shapes are exact — but
+    ops that bake Python-side shape arithmetic at build time see 1)."""
+    norm = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                 else int(d) for d in shape)
+    sds = jax.ShapeDtypeStruct(norm, jnp.dtype(np.dtype(dtype)))
+    var = SymbolicTensor(sds, feed_name=name, name=name)
+    default_main_program()._feed_vars[name] = var
+    from ..framework.core import _mark_static_graph_used
+    _mark_static_graph_used()
+    return var
+
+
+def _evaluate(t, env, memo):
+    """Topological evaluation of a SymbolicTensor against feed env.
+    Iterative post-order walk (an explicit stack): deep Programs — a
+    transformer forward records thousands of chained ops — must not hit
+    Python's recursion limit."""
+
+    def leaf_val(x):
+        return as_jax(x) if isinstance(x, Tensor) else jnp.asarray(x)
+
+    if not isinstance(t, SymbolicTensor):
+        return leaf_val(t)
+
+    stack = [(t, False)]
+    while stack:
+        node_t, expanded = stack.pop()
+        key = id(node_t)
+        if key in memo:
+            continue
+        if node_t._feed_name is not None:
+            if node_t._feed_name not in env:
+                raise KeyError(
+                    f"feed missing for placeholder "
+                    f"{node_t._feed_name!r}; fed: {sorted(env)}")
+            memo[key] = env[node_t._feed_name]
+            continue
+        node, idx = node_t._node
+        fn, inputs, _n_out = node
+        if id(node) in memo:
+            out = memo[id(node)]
+            memo[key] = out[idx] if isinstance(out, (tuple, list)) \
+                else out
+            continue
+        if not expanded:
+            stack.append((node_t, True))
+            for x in inputs:
+                if isinstance(x, SymbolicTensor) and id(x) not in memo:
+                    stack.append((x, False))
+            continue
+        args = [memo[id(x)] if isinstance(x, SymbolicTensor)
+                else leaf_val(x) for x in inputs]
+        out = fn(*args)
+        # memoize per op NODE (shared by multi-output siblings), so an
+        # n-output op traces once, not once per consumed output
+        memo[id(node)] = out
+        memo[key] = out[idx] if isinstance(out, (tuple, list)) else out
+    return memo[id(t)]
+
+
+class Executor:
+    """``paddle.static.Executor`` parity: compiles the fetch DAG into
+    one jitted XLA program per feed signature."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._compiled = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        names = sorted(feed)
+        arrays = [jnp.asarray(np.asarray(feed[n])) for n in names]
+        sig = (id(program), tuple(map(id, fetch_list)), tuple(names),
+               tuple((a.shape, str(a.dtype)) for a in arrays))
+
+        jitted = self._compiled.get(sig)
+        if jitted is None:
+            fetches = list(fetch_list)
+
+            def f(*feed_arrays):
+                env = dict(zip(names, feed_arrays))
+                memo = {}
+                return [_evaluate(t, env, memo) for t in fetches]
+
+            jitted = jax.jit(f)
+            self._compiled[sig] = jitted
+        outs = jitted(*arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        self._compiled.clear()
+
+
+# `exe.run(paddle.static.default_main_program(), ...)` compatibility
+def scope_guard(scope):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+def global_scope():
+    return None

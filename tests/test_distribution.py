@@ -191,3 +191,20 @@ def test_montecarlo_kl_matches_analytic():
     mc = float((p.log_prob(s) - q.log_prob(s)).mean())
     analytic = float(kl_divergence(p, q))
     assert abs(mc - analytic) < 0.05, (mc, analytic)
+
+
+def test_multinomial_batched_probs_sample():
+    """Batched probs (batch_shape != ()) must sample (ADVICE r1)."""
+    import numpy as np
+    from paddle_tpu.distribution import Multinomial
+    probs = paddle.to_tensor(np.array(
+        [[0.2, 0.3, 0.5], [0.7, 0.2, 0.1]], np.float32))
+    m = Multinomial(10, probs)
+    s = m.sample()
+    assert s.shape == [2, 3]
+    counts = np.asarray(s.numpy())
+    np.testing.assert_allclose(counts.sum(-1), [10.0, 10.0])
+    s2 = m.sample((4,))
+    assert s2.shape == [4, 2, 3]
+    np.testing.assert_allclose(np.asarray(s2.numpy()).sum(-1),
+                               np.full((4, 2), 10.0))

@@ -1,0 +1,111 @@
+"""Flag system consumers (reference: ``nan_inf_utils_detail`` hooks +
+gflags rejection of unknown flags — SURVEY §5.2, §5.6)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    from paddle_tpu import base_flags
+    saved = dict(base_flags._FLAGS)
+    yield
+    base_flags._FLAGS.clear()
+    base_flags._FLAGS.update(saved)
+    base_flags._version += 1
+
+
+def test_unknown_flag_warns_or_rejects():
+    import warnings
+    # FLAGS_-shaped but unregistered: accepted as inert knob + warning
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        paddle.set_flags({"FLAGS_cudnn_exhaustive_search": True})
+        assert any("not consumed" in str(m.message) for m in w)
+    # not flag-shaped at all: hard error
+    with pytest.raises(ValueError, match="unknown flag"):
+        paddle.set_flags({"check_nan_inf": True})
+
+
+def test_register_flag_allows_extension():
+    from paddle_tpu.base_flags import register_flag
+    register_flag("FLAGS_my_ext_knob", 7)
+    paddle.set_flags({"FLAGS_my_ext_knob": 9})
+    assert paddle.get_flags("FLAGS_my_ext_knob")["FLAGS_my_ext_knob"] == 9
+
+
+def test_check_nan_inf_catches_injected_nan():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+    with pytest.raises(RuntimeError, match="non-finite"):
+        x / 0.0  # 1/0 -> inf
+    with pytest.raises(RuntimeError, match="non-finite"):
+        paddle.log(paddle.to_tensor(np.array([-1.0], np.float32)))
+
+
+def test_check_nan_inf_off_by_default():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    y = x / 0.0  # no raise
+    assert np.isinf(y.numpy()).all()
+
+
+def test_check_nan_inf_trainstep():
+    from paddle_tpu.jit import TrainStep
+    import paddle_tpu.nn as nn
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(1e30, parameters=model.parameters())
+    step = TrainStep(model, lambda out, a, k: (out * out).mean(), opt)
+    x = paddle.to_tensor(np.full((2, 4), 1e30, np.float32))
+    with pytest.raises(RuntimeError, match="non-finite loss"):
+        for _ in range(5):
+            step(x)
+
+
+def test_donate_flag_honored():
+    from paddle_tpu.jit import TrainStep
+    import paddle_tpu.nn as nn
+    paddle.set_flags({"FLAGS_paddle_tpu_donate_buffers": False})
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    step = TrainStep(model, lambda out, a, k: out.mean(), opt)
+    assert step._donate is False
+
+
+def test_amp_autocast_reentrant_lists():
+    from paddle_tpu.amp import WHITE_LIST, amp_state
+    base = set(WHITE_LIST)
+    with paddle.amp.auto_cast(custom_white_list={"op_outer"}):
+        assert "op_outer" in amp_state().white
+        with paddle.amp.auto_cast(custom_white_list={"op_inner"}):
+            assert {"op_outer", "op_inner"} <= amp_state().white
+            assert "op_inner" not in WHITE_LIST  # globals untouched
+        assert "op_inner" not in amp_state().white
+    assert amp_state().white is None
+    assert set(WHITE_LIST) == base
+
+
+def test_partial_placement_errors():
+    import jax
+    import paddle_tpu.distributed as dist
+    mesh = dist.ProcessMesh([[0, 1], [2, 3]], dim_names=["x", "y"])
+    w = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with pytest.raises(NotImplementedError, match="Partial"):
+        dist.shard_tensor(w, mesh, [dist.Partial(), dist.Replicate()])
+
+
+def test_grad_scaler_double_unscale_raises():
+    import paddle_tpu.nn as nn
+    model = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    loss = scaler.scale(model(x).sum())
+    loss.backward()
+    scaler.unscale_(opt)
+    with pytest.raises(RuntimeError, match="already been called"):
+        scaler.unscale_(opt)
+    scaler.step(opt)   # must NOT unscale a second time
+    scaler.update()

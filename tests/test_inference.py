@@ -118,3 +118,53 @@ def test_lenet_export(tmp_path):
     predictor = inference.create_predictor(inference.Config(path))
     outs = predictor.run([x])
     np.testing.assert_allclose(outs[0], expected, rtol=1e-4, atol=1e-5)
+
+
+def test_jit_save_dynamic_batch_spec(tmp_path):
+    """InputSpec([None, d]) exports a symbolic-batch module: every batch
+    size must work at load time (not just 1)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+    paddle.seed(0)
+    layer = nn.Linear(6, 3)
+    path = str(tmp_path / "dynmodel")
+    paddle.jit.save(layer, path,
+                    input_spec=[InputSpec([None, 6], "float32")])
+    loaded = paddle.jit.load(path)
+    for b in (1, 4, 9):
+        x = np.random.RandomState(b).randn(b, 6).astype(np.float32)
+        ref = layer(paddle.to_tensor(x)).numpy()
+        out = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_dynamic_batch_two_inputs(tmp_path):
+    """Two dynamic-batch inputs must share one batch symbol (x + y)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    class AddNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x, y):
+            return self.fc(x) + y
+
+    paddle.seed(1)
+    net = AddNet()
+    path = str(tmp_path / "dyn2")
+    paddle.jit.save(net, path, input_spec=[
+        InputSpec([None, 4], "float32"), InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    y = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    ref = net(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+    out = loaded(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-5,
+                               atol=1e-6)

@@ -89,26 +89,57 @@ def test_shm_channel_eof():
         prod.close()
 
 
+def _xproc_producer(name):
+    child = ShmChannel(name, create=False)
+    for i in range(10):
+        child.put(np.full((100,), i, np.int32))
+    child.close_write()
+
+
 def test_shm_channel_cross_process():
+    import multiprocessing as mp
     prod = ShmChannel("/pt_t_xproc", capacity=1 << 20, create=True)
-    pid = os.fork()
-    if pid == 0:
-        try:
-            child = ShmChannel("/pt_t_xproc", create=False)
-            for i in range(10):
-                child.put(np.full((100,), i, np.int32))
-            child.close_write()
-            os._exit(0)
-        except BaseException:
-            os._exit(1)
+    p = mp.get_context("spawn").Process(target=_xproc_producer,
+                                        args=("/pt_t_xproc",))
+    p.start()
     try:
         for i in range(10):
             np.testing.assert_array_equal(
-                prod.get(timeout=10), np.full((100,), i, np.int32))
-        _, status = os.waitpid(pid, 0)
-        assert os.waitstatus_to_exitcode(status) == 0
+                prod.get(timeout=30), np.full((100,), i, np.int32))
+        p.join(timeout=10)
+        assert p.exitcode == 0
     finally:
         prod.close()
+
+
+class _BadDataset(paddle.io.Dataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("boom at 5")
+        return np.zeros((2,), np.float32)
+
+    def __len__(self):
+        return 8
+
+
+class _HangDataset(paddle.io.Dataset):
+    def __getitem__(self, i):
+        import signal
+        if i >= 4:
+            os.kill(os.getpid(), signal.SIGKILL)  # worker dies hard
+        return np.zeros((2,), np.float32)
+
+    def __len__(self):
+        return 64
+
+
+class _ShardedIterable(paddle.io.IterableDataset):
+    def __iter__(self):
+        info = paddle.io.get_worker_info()
+        wid = info.id if info else 0
+        nw = info.num_workers if info else 1
+        for i in range(wid, 32, nw):
+            yield np.asarray([i], np.int64)
 
 
 class _SlowDataset(paddle.io.Dataset):
@@ -124,7 +155,7 @@ class _SlowDataset(paddle.io.Dataset):
 
 
 def test_dataloader_multiprocess_workers():
-    """num_workers>0 + use_shared_memory spawns fork workers over the shm
+    """num_workers>0 + use_shared_memory spawns workers over the shm
     ring; batches come back in sampler order."""
     ds = _SlowDataset(64)
     loader = paddle.io.DataLoader(ds, batch_size=8, num_workers=2,
@@ -139,16 +170,7 @@ def test_dataloader_multiprocess_workers():
 
 
 def test_dataloader_mp_worker_error_propagates():
-    class Bad(paddle.io.Dataset):
-        def __getitem__(self, i):
-            if i == 5:
-                raise ValueError("boom at 5")
-            return np.zeros((2,), np.float32)
-
-        def __len__(self):
-            return 8
-
-    loader = paddle.io.DataLoader(Bad(), batch_size=2, num_workers=2,
+    loader = paddle.io.DataLoader(_BadDataset(), batch_size=2, num_workers=2,
                                   use_shared_memory=True)
     with pytest.raises(RuntimeError, match="boom at 5"):
         list(loader)
@@ -156,18 +178,7 @@ def test_dataloader_mp_worker_error_propagates():
 
 def test_dataloader_mp_killed_worker_raises():
     """A SIGKILLed worker (OOM-killer scenario) must raise, not hang."""
-    import signal
-
-    class Hang(paddle.io.Dataset):
-        def __getitem__(self, i):
-            if i >= 4:
-                os.kill(os.getpid(), signal.SIGKILL)  # worker dies hard
-            return np.zeros((2,), np.float32)
-
-        def __len__(self):
-            return 64
-
-    loader = paddle.io.DataLoader(Hang(), batch_size=2, num_workers=2,
+    loader = paddle.io.DataLoader(_HangDataset(), batch_size=2, num_workers=2,
                                   use_shared_memory=True)
     with pytest.raises(RuntimeError, match="exited unexpectedly"):
         list(loader)
@@ -176,16 +187,7 @@ def test_dataloader_mp_killed_worker_raises():
 def test_dataloader_mp_iterable_worker_sharding():
     """IterableDataset shards itself via get_worker_info(); the loader
     must not filter again on top (no double-sharding)."""
-
-    class Sharded(paddle.io.IterableDataset):
-        def __iter__(self):
-            info = paddle.io.get_worker_info()
-            wid = info.id if info else 0
-            nw = info.num_workers if info else 1
-            for i in range(wid, 32, nw):
-                yield np.asarray([i], np.int64)
-
-    loader = paddle.io.DataLoader(Sharded(), batch_size=4,
+    loader = paddle.io.DataLoader(_ShardedIterable(), batch_size=4,
                                   num_workers=2, use_shared_memory=True)
     seen = sorted(int(v) for b in loader for v in b.numpy().ravel())
     assert seen == list(range(32))

@@ -1,0 +1,118 @@
+"""static.nn control flow + to_static graph-break fallback
+(reference: ``test/dygraph_to_static`` — same model eager vs to_static,
+outputs compared)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def test_cond_eager_concrete_pred():
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    out = snn.cond(x.sum() > 1.0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    out = snn.cond(x.sum() > 9.0, lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [1.0])
+
+
+def test_cond_under_to_static():
+    @paddle.jit.to_static
+    def f(x):
+        return snn.cond(x.sum() > 0, lambda: x * 2, lambda: -x)
+
+    xp = np.array([1.0, 2.0], np.float32)
+    xn = np.array([-1.0, -2.0], np.float32)
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(xp)).numpy(), xp * 2)
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(xn)).numpy(), -xn)
+
+
+def test_cond_gradient_eager():
+    x = paddle.to_tensor(np.array([3.0], np.float32),
+                         stop_gradient=False)
+    y = snn.cond(x.sum() > 0, lambda: x * x, lambda: x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.array(0, np.int64))
+    s = paddle.to_tensor(np.array(0.0, np.float32))
+    i2, s2 = snn.while_loop(lambda i, s: i < 5,
+                            lambda i, s: [i + 1, s + 2.0], [i, s])
+    assert int(i2.numpy()) == 5
+    np.testing.assert_allclose(s2.numpy(), 10.0)
+
+
+def test_while_loop_under_to_static():
+    @paddle.jit.to_static
+    def f(n, x):
+        def cond_fn(i, acc):
+            return i < n
+
+        def body(i, acc):
+            return [i + 1, acc * 2.0]
+
+        i0 = paddle.to_tensor(np.array(0, np.int64))
+        _, acc = snn.while_loop(cond_fn, body, [i0, x])
+        return acc
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    out = f(paddle.to_tensor(np.array(3, np.int64)), x)
+    np.testing.assert_allclose(out.numpy(), [8.0])
+    out = f(paddle.to_tensor(np.array(5, np.int64)), x)
+    np.testing.assert_allclose(out.numpy(), [32.0])
+
+
+def test_switch_case():
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    fns = {0: lambda: x + 1, 2: lambda: x + 2}
+    np.testing.assert_allclose(
+        snn.switch_case(paddle.to_tensor(np.array(2, np.int64)), fns,
+                        default=lambda: x).numpy(), [3.0])
+    np.testing.assert_allclose(
+        snn.switch_case(paddle.to_tensor(np.array(7, np.int64)), fns,
+                        default=lambda: x).numpy(), [1.0])
+
+    @paddle.jit.to_static
+    def f(i):
+        return snn.switch_case(i, {0: lambda: x + 1, 2: lambda: x + 2},
+                               default=lambda: x * 10)
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array(0, np.int64))).numpy(), [2.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array(3, np.int64))).numpy(), [10.0])
+
+
+def test_case_first_true_wins():
+    x = paddle.to_tensor(np.array([5.0], np.float32))
+    out = snn.case([(x.sum() > 10, lambda: x * 0),
+                    (x.sum() > 1, lambda: x * 2)],
+                   default=lambda: x)
+    np.testing.assert_allclose(out.numpy(), [10.0])
+
+
+def test_to_static_graph_break_falls_back_eager():
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x):
+        calls.append(1)
+        if float(x.sum().numpy()) > 0:  # untraceable host read
+            return x * 2
+        return -x
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(x)
+        assert any("falling back to eager" in str(m.message) for m in w)
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    # subsequent calls run eagerly without re-warning
+    out2 = f(paddle.to_tensor(np.array([-1.0], np.float32)))
+    np.testing.assert_allclose(out2.numpy(), [1.0])

@@ -1,0 +1,108 @@
+"""Static Program/Executor (reference: ``python/paddle/static`` +
+new_executor; tested dygraph/static-parity style per SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+def test_program_feed_fetch_roundtrip():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 4], "float32")
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[y])
+    np.testing.assert_allclose(out, 3 * np.ones((2, 4)))
+
+
+def test_program_layer_forward_matches_eager():
+    paddle.seed(0)
+    layer = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    xv = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    eager = layer(paddle.to_tensor(xv)).numpy()
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 4], "float32")
+        y = layer(x)
+    exe = static.Executor()
+    out, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    np.testing.assert_allclose(out, eager, rtol=1e-5, atol=1e-6)
+
+
+def test_program_recompiles_per_batch_size():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [-1, 3], "float32")
+        s = x.sum()
+    exe = static.Executor()
+    a, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                 fetch_list=[s])
+    b, = exe.run(main, feed={"x": np.ones((7, 3), np.float32)},
+                 fetch_list=[s])
+    assert float(a) == 6.0 and float(b) == 21.0
+
+
+def test_symbolic_tensor_guards_value_reads():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        with pytest.raises(RuntimeError, match="static Program"):
+            x.numpy()
+
+
+def test_missing_feed_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + 1.0
+    exe = static.Executor()
+    with pytest.raises(KeyError, match="feed missing"):
+        exe.run(main, feed={}, fetch_list=[y])
+
+
+def test_enable_disable_static_flags():
+    assert paddle.in_dynamic_mode()
+    static.enable_static()
+    assert static.in_static_mode()
+    static.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_deep_program_no_recursion_limit():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("xd", [-1, 4], "float32")
+        y = x
+        for _ in range(1200):
+            y = y + 1.0
+    exe = static.Executor()
+    out, = exe.run(main, feed={"xd": np.zeros((2, 4), np.float32)},
+                   fetch_list=[y])
+    assert float(out[0, 0]) == 1200.0
+
+
+def test_nodiff_ops_record_in_program():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("xn", [-1, 4], "float32")
+        m = x.sum() > 0
+        am = x.argmax(axis=-1)
+    exe = static.Executor()
+    mo, ao = exe.run(main, feed={"xn": np.eye(4, dtype=np.float32)},
+                     fetch_list=[m, am])
+    assert bool(mo)
+    np.testing.assert_array_equal(ao, [0, 1, 2, 3])
+
+
+def test_static_nn_rejects_symbolic_control_flow():
+    from paddle_tpu.static import nn as snn
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("xs", [2], "float32")
+        with pytest.raises(NotImplementedError, match="to_static"):
+            snn.cond(x.sum() > 0, lambda: x, lambda: x)
