@@ -137,7 +137,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
-        self._unscaled = False
+        self._unscaled_opts = set()
 
     def scale(self, var):
         if not self._enable or self._scale == 1.0:
@@ -147,13 +147,13 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        if self._unscaled:
+        if id(optimizer) in self._unscaled_opts:
             # Paddle raises here too: a second unscale_ would divide
             # the gradients by the scale twice and silently stall
             raise RuntimeError(
                 "unscale_() has already been called on this optimizer "
                 "since the last update()")
-        self._unscaled = True
+        self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
         new_grads = []
         finite_flags = []
@@ -174,7 +174,8 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
-        if self._scale != 1.0 and not self._unscaled:
+        if self._scale != 1.0 and id(optimizer) not in \
+                self._unscaled_opts:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
@@ -184,7 +185,7 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self):
-        self._unscaled = False
+        self._unscaled_opts.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -21,6 +21,7 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_use_stream_safe_cuda_allocator": True,
     "FLAGS_benchmark": False,
     "FLAGS_paddle_tpu_donate_buffers": True,
+    "FLAGS_dataloader_start_method": "spawn",  # or "fork"/"forkserver"
     "FLAGS_paddle_tpu_default_matmul_precision": "default",
     "FLAGS_log_level": 0,
 }

@@ -442,7 +442,12 @@ class DataLoader:
         names = [f"/ptdl_{os.getpid()}_{uid}_{i}" for i in range(n)]
         channels = [ShmChannel(nm, capacity=cap, create=True)
                     for nm in names]
-        ctx = _mp.get_context("spawn")
+        # spawn is the safe default (forking a multithreaded JAX parent
+        # can deadlock) but requires __main__ guards + picklable state;
+        # scripts that relied on fork semantics can flip the flag
+        from ..base_flags import get_flag
+        method = get_flag("FLAGS_dataloader_start_method", "spawn")
+        ctx = _mp.get_context(method)
         procs = []
         try:
             try:

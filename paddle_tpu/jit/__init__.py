@@ -338,10 +338,11 @@ def _specs_to_sds(specs):
         dynamic = False
         for di, d in enumerate(s.shape):
             if d is None or (isinstance(d, int) and d < 0):
-                # name by dim POSITION so the dynamic batch dim of
-                # multi-input models unifies to one variable (x + mask
-                # with two independent batch symbols cannot trace)
-                dim_strs.append(f"_dyn_d{di}")
+                # share a symbol per (dim position, rank): same-rank
+                # inputs unify their batch dim (x + mask must trace),
+                # while a rank-1 dynamic input does not get chained to
+                # a rank-2 input's batch size
+                dim_strs.append(f"_dyn_d{di}_r{len(s.shape)}")
                 dynamic = True
             else:
                 dim_strs.append(str(int(d)))

@@ -137,12 +137,19 @@ class TCPStore:
             # interface owns: fall back to all interfaces with a warning
             bound = lib.tcps_server_start_host(host.encode(), int(port),
                                                ctypes.byref(handle))
-            if bound < 0:
+            # fall back to all interfaces ONLY when the advertised
+            # address is not locally bindable (NAT/docker forwarding:
+            # EADDRNOTAVAIL, or unresolvable: EINVAL) — other errors
+            # (e.g. EADDRINUSE) must surface, not silently widen the
+            # unauthenticated store's exposure
+            import errno as _errno
+            if bound < 0 and -int(bound) in (_errno.EADDRNOTAVAIL,
+                                             _errno.EINVAL):
                 import warnings
                 warnings.warn(
-                    f"TCPStore: cannot bind {host!r} (errno "
+                    f"TCPStore: {host!r} is not a local address (errno "
                     f"{-int(bound)}); listening on all interfaces — "
-                    "the advertised address is NAT/forwarded?")
+                    "NAT/forwarded deployment assumed")
                 bound = lib.tcps_server_start(int(port),
                                               ctypes.byref(handle))
             if bound < 0:

@@ -33,7 +33,29 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
-NEG_INF = -1e30
+import contextlib
+
+
+@contextlib.contextmanager
+def disable_x64():
+    """Trace-scoped 32-bit mode (jax.experimental.disable_x64 is gone in
+    jax 0.9). The framework runs with jax_enable_x64 on; tracing the
+    Pallas kernels in that mode lets weak-f64 constants leak in, and
+    Mosaic cannot legalize the resulting f64->f32 truncf."""
+    prev = jax.config.jax_enable_x64
+    if prev:
+        jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        if prev:
+            jax.config.update("jax_enable_x64", True)
+
+
+# strongly-typed f32 scalar: under jax_enable_x64 (which the framework
+# turns on) a bare Python float traces as a weak f64 constant and the
+# resulting f64->f32 tpu.truncf cannot be legalized by Mosaic
+NEG_INF = np.float32(-1e30)
 
 
 def _block_sizes(seq_len, block_q, block_k):
@@ -99,10 +121,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ki == n_kv - 1)
     def _finish():
         l = l_scr[:, :1]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        safe_l = jnp.where(l == 0.0, np.float32(1.0), l)
         o_ref[0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(safe_l)
-        lse_ref[0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse[:, 0])
+        lse_ref[0, 0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse[:, 0])
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
@@ -118,7 +140,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
         n_kv=n_kv)
     grid = (bh, n_q, n_kv)
-    o, lse = pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -128,11 +150,14 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # row stats ride as [BH, 1, L]: a (1, bq) block over
+            # [BH, L] violates the (8, 128) tile rule, while the
+            # (1, 1, bq) block's last two dims are (full dim, 128-mult)
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -142,7 +167,9 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )
+    with disable_x64():
+        o, lse = call(q, k, v)
     return o, lse
 
 
@@ -169,8 +196,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -216,8 +243,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -258,9 +285,9 @@ def _bwd(scale, causal, block_q, block_k, res, do):
     n_kv = lk // bk
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)  # [BH, L]
+                    axis=-1)[:, None, :]  # [BH, 1, L] (tile rule)
 
-    dq = pl.pallas_call(
+    dq_call = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_kv=n_kv),
         grid=(bh, n_q, n_kv),
@@ -269,8 +296,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
@@ -278,9 +305,11 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )
+    with disable_x64():
+        dq = dq_call(q, k, v, do, lse, delta)
 
-    dk, dv = pl.pallas_call(
+    dkv_call = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, block_k=bk, n_q=n_q),
         grid=(bh, n_kv, n_q),
@@ -289,8 +318,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -307,7 +336,9 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )
+    with disable_x64():
+        dk, dv = dkv_call(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 

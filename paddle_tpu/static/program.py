@@ -77,21 +77,22 @@ class _Abstract:
         return _Abstract(jax.ShapeDtypeStruct(self.shape, dtype))
 
 
-def _sds_of(x):
+def _eval_arg(x):
+    """eval_shape argument: abstract only the symbolic placeholders —
+    concrete Tensors/scalars pass through unchanged, preserving JAX
+    weak typing (a Python 2.0 must not harden to f64 under x64, or the
+    recorded dtype diverges from what the jitted run produces)."""
     if isinstance(x, SymbolicTensor):
-        # -1/None dims were normalized to 1 at data() time
         return jax.ShapeDtypeStruct(x._data.shape, x._data.dtype)
     if isinstance(x, Tensor):
-        a = as_jax(x)
-        return jax.ShapeDtypeStruct(a.shape, a.dtype)
-    a = jnp.asarray(x)
-    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return as_jax(x)
+    return x
 
 
 def record_static_op(op_name, fn, inputs, n_outputs):
     """Called from apply_jax when an input is symbolic: record the node,
     return symbolic outputs (metadata via jax.eval_shape)."""
-    sds_in = [_sds_of(x) for x in inputs]
+    sds_in = [_eval_arg(x) for x in inputs]
     out_sds = jax.eval_shape(fn, *sds_in)
     prog = default_main_program()
     if isinstance(out_sds, (tuple, list)):
