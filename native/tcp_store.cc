@@ -305,9 +305,18 @@ void* tcps_connect(const char* host, int port, int timeout_ms) {
 }
 
 void tcps_close(void* h) {
+  // Shut down under the request mutex, and do NOT free: another thread
+  // (e.g. a heartbeat daemon) may be blocked inside an RPC on this
+  // client — freeing here is a use-after-free/SIGSEGV. The in-flight
+  // RPC fails cleanly on the closed fd; the small struct is leaked
+  // intentionally (bounded by the number of stores a process closes).
   auto* c = static_cast<Client*>(h);
-  if (c->fd >= 0) ::close(c->fd);
-  delete c;
+  if (c->fd >= 0) ::shutdown(c->fd, SHUT_RDWR);  // unblock in-flight RPC
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->fd >= 0) {
+    ::close(c->fd);
+    c->fd = -1;
+  }
 }
 
 static bool send_req_header(Client* c, uint8_t cmd, const char* key) {

@@ -168,9 +168,14 @@ class GradScaler:
             jnp.all(jnp.stack(finite_flags)))) if finite_flags else False
         for p, g in new_grads:
             p._grad = _wrap_out(g)
-        self._found_inf = found
+        # accumulate (don't overwrite): with several optimizers, one
+        # optimizer's inf must veto every step until update()
+        self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
+        """Paddle semantics: step does NOT update the scale — call
+        ``update()`` once per iteration (after stepping every
+        optimizer), as the reference does."""
         if not self._enable:
             optimizer.step()
             return
@@ -179,10 +184,10 @@ class GradScaler:
             self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
         self._unscaled_opts.clear()

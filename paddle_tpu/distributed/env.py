@@ -82,8 +82,66 @@ def init_parallel_env(strategy=None):
                 num_processes=env.world_size, process_id=env.rank)
         except Exception:
             pass  # already initialized or single-host emulation
+    if os.environ.get("PADDLE_ELASTIC_ENABLE") == "1" \
+            and env.world_size > 1:
+        try:
+            _start_elastic_heartbeat(env, coord)
+        except Exception as exc:
+            import warnings
+            warnings.warn(
+                f"elastic heartbeat disabled: could not reach the "
+                f"liveness store ({exc!r}); training continues without "
+                "hang detection")
     _initialized = True
     return env
+
+
+def _start_elastic_heartbeat(env, coord):
+    """Opt-in (PADDLE_ELASTIC_ENABLE=1): register this rank with the
+    native-TCPStore ElasticManager and beat in a daemon thread so the
+    launch controller's watch loop sees liveness (SURVEY §5.3)."""
+    import threading
+    import time
+    from .fleet.elastic import ElasticManager
+    host = (coord or "127.0.0.1").split(":")[0]
+    port = int(os.environ.get("PADDLE_ELASTIC_PORT", "6179"))
+    interval = float(os.environ.get("PADDLE_ELASTIC_BEAT_S", "5"))
+    # PADDLE_ELASTIC_EXTERNAL=1: the launch controller hosts the store
+    # (it outlives pod restarts); otherwise rank 0 hosts it in-process
+    external = os.environ.get("PADDLE_ELASTIC_EXTERNAL") == "1"
+    mgr = ElasticManager(host=host, port=port, rank=env.rank,
+                         world_size=env.world_size,
+                         is_master=(not external) and env.rank == 0,
+                         timeout=3 * interval)
+    mgr.register()
+
+    def beat():
+        while not getattr(mgr, "_stop_beat", False):
+            time.sleep(interval)
+            try:
+                mgr.heartbeat()
+            except Exception:
+                return  # store gone: job is tearing down
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name="paddle-elastic-heartbeat")
+    t.start()
+
+    def _stop_at_exit():
+        # a daemon thread killed mid-ctypes-RPC at interpreter shutdown
+        # segfaults — stop it, join, then shut the socket down (close
+        # unblocks any straggling RPC safely: tcp_store.cc close locks
+        # the request mutex and only invalidates the fd)
+        mgr._stop_beat = True
+        t.join(timeout=interval + 1.0)
+        try:
+            mgr.close()
+        except Exception:
+            pass
+
+    import atexit
+    atexit.register(_stop_at_exit)
+    env.elastic_manager = mgr
 
 
 def is_initialized() -> bool:

@@ -1,0 +1,192 @@
+"""Elastic training / failure detection (reference:
+``python/paddle/distributed/fleet/elastic/manager.py`` — etcd-backed
+``ElasticManager`` watching peer liveness and triggering relaunch;
+SURVEY.md §5.3).
+
+TPU-first design: multi-controller JAX has no in-job elasticity — a
+lost host invalidates the mesh — so the recovery unit is the *job*:
+detect the failure fast, relaunch the processes (launch controller's
+``--max_restarts``), and resume from the latest checkpoint with
+reshard-on-load (orbax handles a different mesh/degree at restore).
+The rendezvous/liveness store is the native C++ TCPStore
+(``native/tcp_store.cc``) instead of etcd — same keyed watch pattern,
+no external service.
+
+Pieces:
+- ``ElasticManager``: heartbeat registration + liveness watch over the
+  TCPStore; ``watch()`` reports dead ranks, ``ready()`` gates job start
+  on np in [min, max].
+- ``save_checkpoint`` / ``resume_or_start``: the checkpoint-restart-
+  reshard recipe (step-numbered orbax dirs, latest-wins, pruning).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus", "save_checkpoint",
+           "resume_or_start", "latest_checkpoint"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Liveness bookkeeping over the native TCPStore.
+
+    rank 0 passes ``is_master=True`` (hosts the store in-process); every
+    rank calls ``register()`` then ``heartbeat()`` periodically (the
+    reference's etcd lease refresh). The watcher (usually the launch
+    controller) polls ``watch()``; a rank whose heartbeat is older than
+    ``timeout`` is dead -> ElasticStatus.RESTART.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, rank=0, world_size=1,
+                 is_master=None, np_range=None, timeout=30.0,
+                 join_timeout=60.0):
+        from ....native import TCPStore
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        if is_master is None:
+            is_master = self.rank == 0
+        # join_timeout covers the initial rendezvous (rank 0 may bring
+        # the store up seconds later); liveness polls use the
+        # non-blocking try_get, so no RPC timeout applies there
+        self._store = TCPStore(host=host, port=port, is_master=is_master,
+                               world_size=world_size,
+                               timeout=join_timeout)
+        self.port = self._store.port
+        self.timeout = float(timeout)
+        if np_range is None:
+            self.np_min = self.np_max = self.world_size
+        else:
+            self.np_min, self.np_max = np_range
+
+    # -- worker side ----------------------------------------------------
+    def register(self):
+        self._store.set(f"elastic/rank/{self.rank}/registered", "1")
+        self.heartbeat()
+
+    def heartbeat(self):
+        self._store.set(f"elastic/rank/{self.rank}/beat",
+                        repr(time.time()))
+
+    def deregister(self):
+        self._store.set(f"elastic/rank/{self.rank}/registered", "0")
+
+    # -- watcher side ---------------------------------------------------
+    def _beat_age(self, rank) -> Optional[float]:
+        raw = self._store.try_get(f"elastic/rank/{rank}/beat")
+        if raw is None:
+            return None
+        try:
+            return time.time() - float(raw.decode())
+        except ValueError:
+            return None
+
+    def alive_ranks(self) -> List[int]:
+        out = []
+        for r in range(self.world_size):
+            age = self._beat_age(r)
+            if age is not None and age <= self.timeout:
+                reg = self._store.try_get(
+                    f"elastic/rank/{r}/registered")
+                if reg == b"1":
+                    out.append(r)
+        return out
+
+    def dead_ranks(self) -> List[int]:
+        alive = set(self.alive_ranks())
+        return [r for r in range(self.world_size) if r not in alive]
+
+    def ready(self) -> bool:
+        """Enough registered+alive ranks to (re)start the job."""
+        return len(self.alive_ranks()) >= self.np_min
+
+    def watch(self) -> str:
+        """One poll of the reference's watch loop."""
+        n = len(self.alive_ranks())
+        if n >= self.world_size:
+            return ElasticStatus.COMPLETED  # full strength
+        if n >= self.np_min:
+            return ElasticStatus.HOLD       # degraded but viable
+        return ElasticStatus.RESTART        # below min -> relaunch
+
+    def reset(self):
+        """Clear all rank liveness keys (controller calls this between
+        pod restart attempts so stale beats don't mask a dead rank)."""
+        for r in range(self.world_size):
+            self._store.delete_key(f"elastic/rank/{r}/beat")
+            self._store.delete_key(f"elastic/rank/{r}/registered")
+
+    def close(self):
+        self._store.close()
+
+
+# -----------------------------------------------------------------------
+# checkpoint-restart-reshard recipe
+# -----------------------------------------------------------------------
+
+_STEP_RE = re.compile(r"^checkpoint-(\d+)$")
+
+
+def latest_checkpoint(ckpt_dir) -> Optional[str]:
+    """Path of the newest ``checkpoint-<step>`` subdir, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    best_step = -1
+    for name in os.listdir(ckpt_dir):
+        m = _STEP_RE.match(name)
+        if m and os.path.exists(
+                os.path.join(ckpt_dir, name, "_COMPLETE")):
+            step = int(m.group(1))
+            if step > best_step:
+                best_step, best = step, os.path.join(ckpt_dir, name)
+    return best
+
+
+def save_checkpoint(ckpt_dir, step, state_dict, keep_last=3):
+    """Write ``checkpoint-<step>`` (orbax sharded) + commit marker;
+    prune older checkpoints beyond ``keep_last``. The commit marker
+    makes a preemption mid-write invisible to resume."""
+    from ...checkpoint import save_state_dict
+    path = os.path.join(ckpt_dir, f"checkpoint-{int(step)}")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    save_state_dict(state_dict, os.path.join(path, "state"))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"step": int(step), "time": time.time()}, f)
+    with open(os.path.join(path, "_COMPLETE"), "w") as f:
+        f.write("ok")
+    steps = sorted(
+        (int(_STEP_RE.match(n).group(1)) for n in os.listdir(ckpt_dir)
+         if _STEP_RE.match(n)), reverse=True)
+    for old in steps[keep_last:]:
+        shutil.rmtree(os.path.join(ckpt_dir, f"checkpoint-{old}"),
+                      ignore_errors=True)
+    return path
+
+
+def resume_or_start(ckpt_dir, state_dict) -> int:
+    """Restore the newest complete checkpoint into ``state_dict`` IN
+    PLACE (resharded to each tensor's CURRENT sharding — the restart may
+    run on a different mesh). Returns the step to resume from (0 if no
+    checkpoint exists)."""
+    from ...checkpoint import load_state_dict
+    path = latest_checkpoint(ckpt_dir)
+    if path is None:
+        return 0
+    load_state_dict(state_dict, os.path.join(path, "state"))
+    with open(os.path.join(path, "meta.json")) as f:
+        return int(json.load(f)["step"])

@@ -26,15 +26,25 @@ def parse_args(argv):
     p.add_argument("--rank", type=int, default=0)
     p.add_argument("--log_dir", default="log")
     p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: relaunch the pod this many times "
+                        "after a worker failure (checkpoint-resume is "
+                        "the training script's job)")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">0 enables heartbeat hang-detection: workers "
+                        "register with the controller's TCPStore and a "
+                        "rank whose heartbeat stops (hung, not just "
+                        "exited) triggers pod restart")
+    p.add_argument("--elastic_timeout", type=float, default=30.0,
+                   help="seconds without a heartbeat before a rank is "
+                        "declared dead (with --elastic_level > 0)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs="...")
     return p.parse_args(argv)
 
 
-def launch(argv=None):
-    args = parse_args(argv if argv is not None else sys.argv[1:])
-    nprocs = args.nproc_per_node or 1
-    os.makedirs(args.log_dir, exist_ok=True)
+def _spawn_pod(args, nprocs, attempt, elastic_port=None):
+    """Start one process per rank; returns [(Popen, log_file)]."""
     endpoints = ",".join(f"127.0.0.1:{6170 + i}" for i in range(nprocs))
     procs = []
     for rank in range(nprocs):
@@ -45,23 +55,102 @@ def launch(argv=None):
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
             "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
             "PADDLE_MASTER": args.master or "127.0.0.1:6170",
+            "PADDLE_RESTART_ATTEMPT": str(attempt),
             "FLAGS_selected_gpus": str(rank),
         })
+        if elastic_port is not None:
+            env.update({
+                "PADDLE_ELASTIC_ENABLE": "1",
+                "PADDLE_ELASTIC_PORT": str(elastic_port),
+                "PADDLE_ELASTIC_EXTERNAL": "1",  # controller owns store
+            })
+        suffix = f".{attempt}" if attempt else ""
         log = open(os.path.join(args.log_dir,
-                                f"workerlog.{rank}"), "w")
+                                f"workerlog.{rank}{suffix}"), "w")
         cmd = [sys.executable, args.training_script] + \
             list(args.training_script_args)
         procs.append((subprocess.Popen(
             cmd, env=env,
             stdout=log if rank != 0 else None,
             stderr=subprocess.STDOUT if rank != 0 else None), log))
-    code = 0
-    for p, log in procs:
-        rc = p.wait()
+    return procs
+
+
+def _watch_pod(procs, poll_s=0.2, watcher=None):
+    """Reference controller watch loop: poll children; on the FIRST
+    non-zero exit kill the whole pod (a half-dead mesh cannot make
+    progress) and report failure. With an ElasticManager ``watcher``,
+    a hung rank (heartbeat stopped, process still alive) also fails
+    the pod. Returns 0 when all exit clean."""
+    import time
+    from ..fleet.elastic import ElasticStatus
+    live = list(procs)
+    failed = 0
+    all_registered = False
+    while live and not failed:
+        time.sleep(poll_s)
+        for p, _log in list(live):
+            rc = p.poll()
+            if rc is None:
+                continue
+            live.remove((p, _log))
+            if rc != 0:
+                failed = rc
+                break
+        if not failed and watcher is not None and live:
+            n_alive = len(watcher.alive_ranks())
+            if n_alive >= watcher.world_size:
+                all_registered = True
+            elif all_registered and watcher.watch() == \
+                    ElasticStatus.RESTART:
+                print("[launch] heartbeat lost for ranks "
+                      f"{watcher.dead_ranks()}; failing the pod",
+                      file=sys.stderr)
+                failed = 1
+    if failed:
+        for p, _log in live:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 10
+        for p, _log in live:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+    for _p, log in procs:
         log.close()
-        code = code or rc
-    if code:
-        raise SystemExit(code)
+    return failed
+
+
+def launch(argv=None):
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    nprocs = args.nproc_per_node or 1
+    os.makedirs(args.log_dir, exist_ok=True)
+    watcher = None
+    elastic_port = None
+    if args.elastic_level:
+        from ..fleet.elastic import ElasticManager
+        # controller hosts the liveness store; workers only connect
+        watcher = ElasticManager(port=0, world_size=nprocs,
+                                 is_master=True,
+                                 timeout=args.elastic_timeout)
+        elastic_port = watcher.port
+    attempt = 0
+    while True:
+        procs = _spawn_pod(args, nprocs, attempt,
+                           elastic_port=elastic_port)
+        code = _watch_pod(procs, watcher=watcher)
+        if code == 0:
+            return
+        if attempt >= args.max_restarts:
+            raise SystemExit(code)
+        attempt += 1
+        if watcher is not None:
+            watcher.reset()  # stale beats must not mask the next pod
+        print(f"[launch] pod failed (rc={code}); elastic restart "
+              f"{attempt}/{args.max_restarts}", file=sys.stderr)
 
 
 def main():

@@ -337,12 +337,19 @@ def _specs_to_sds(specs):
         dim_strs = []
         dynamic = False
         for di, d in enumerate(s.shape):
-            if d is None or (isinstance(d, int) and d < 0):
-                # share a symbol per (dim position, rank): same-rank
-                # inputs unify their batch dim (x + mask must trace),
-                # while a rank-1 dynamic input does not get chained to
-                # a rank-2 input's batch size
-                dim_strs.append(f"_dyn_d{di}_r{len(s.shape)}")
+            if isinstance(d, str):
+                # explicit symbol name: dims sharing a name unify, so
+                # users control cross-input equality precisely
+                dim_strs.append(d)
+                dynamic = True
+            elif d is None or (isinstance(d, int) and d < 0):
+                # Paddle convention: dim 0 is the batch — share ONE
+                # symbol across all inputs (ids [None, L] + mask
+                # [None, 1, L, L] must trace together); other dynamic
+                # dims stay per-(input, dim). Use string dims in the
+                # InputSpec shape to override.
+                dim_strs.append("_dyn_batch" if di == 0
+                                else f"_dyn_{si}_{di}")
                 dynamic = True
             else:
                 dim_strs.append(str(int(d)))

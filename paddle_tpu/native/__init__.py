@@ -189,6 +189,17 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore get({key!r}) failed")
         return buf.raw[:min(int(n), len(buf))]
 
+    def try_get(self, key: str):
+        """Non-blocking get: None when the key does not exist (no
+        server-side wait, unlike get())."""
+        buf = ctypes.create_string_buffer(1 << 16)
+        n = self._lib.tcps_try_get(self._client, key.encode(),
+                                   ctypes.cast(buf, ctypes.c_void_p),
+                                   len(buf))
+        if n < 0:
+            return None
+        return buf.raw[:min(int(n), len(buf))]
+
     def add(self, key: str, amount: int) -> int:
         r = self._lib.tcps_add(self._client, key.encode(), int(amount))
         if r == -(2 ** 63):
@@ -212,14 +223,17 @@ class TCPStore:
     def num_keys(self) -> int:
         return int(self._lib.tcps_num_keys(self._client))
 
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.tcps_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.tcps_server_stop(self._server)
+            self._server = None
+
     def __del__(self):
         try:
-            if getattr(self, "_client", None):
-                self._lib.tcps_close(self._client)
-                self._client = None
-            if getattr(self, "_server", None):
-                self._lib.tcps_server_stop(self._server)
-                self._server = None
+            self.close()
         except Exception:
             pass
 
