@@ -135,6 +135,7 @@ def _start_elastic_heartbeat(env, coord):
         mgr._stop_beat = True
         t.join(timeout=interval + 1.0)
         try:
+            mgr.deregister()  # clean exit != death: no spurious restart
             mgr.close()
         except Exception:
             pass

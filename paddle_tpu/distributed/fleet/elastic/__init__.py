@@ -93,33 +93,51 @@ class ElasticManager:
         except ValueError:
             return None
 
-    def alive_ranks(self) -> List[int]:
-        out = []
+    def poll(self) -> Dict[str, List[int]]:
+        """ONE sweep of the store classifying every rank:
+        ``alive`` (registered, fresh beat), ``finished`` (deregistered —
+        clean exit, NOT a failure), ``dead`` (registered, beat stale),
+        ``pending`` (never registered)."""
+        out = {"alive": [], "finished": [], "dead": [], "pending": []}
         for r in range(self.world_size):
-            age = self._beat_age(r)
-            if age is not None and age <= self.timeout:
-                reg = self._store.try_get(
-                    f"elastic/rank/{r}/registered")
-                if reg == b"1":
-                    out.append(r)
+            reg = self._store.try_get(f"elastic/rank/{r}/registered")
+            if reg is None:
+                out["pending"].append(r)
+            elif reg == b"0":
+                out["finished"].append(r)
+            else:
+                age = self._beat_age(r)
+                if age is not None and age <= self.timeout:
+                    out["alive"].append(r)
+                else:
+                    out["dead"].append(r)
         return out
 
+    def alive_ranks(self) -> List[int]:
+        return self.poll()["alive"]
+
     def dead_ranks(self) -> List[int]:
-        alive = set(self.alive_ranks())
-        return [r for r in range(self.world_size) if r not in alive]
+        return self.poll()["dead"]
 
     def ready(self) -> bool:
         """Enough registered+alive ranks to (re)start the job."""
         return len(self.alive_ranks()) >= self.np_min
 
+    def status_of(self, polled: Dict[str, List[int]]) -> str:
+        """Classify one poll() result (reference watch-loop decision):
+        RESTART only on actual deaths that drop the job below np_min;
+        pending ranks (still starting) and deaths above np_min HOLD;
+        clean exits (finished) never count against the job."""
+        n_ok = len(polled["alive"]) + len(polled["finished"])
+        if polled["dead"] and n_ok < self.np_min:
+            return ElasticStatus.RESTART
+        if polled["dead"] or polled["pending"]:
+            return ElasticStatus.HOLD
+        return ElasticStatus.COMPLETED
+
     def watch(self) -> str:
         """One poll of the reference's watch loop."""
-        n = len(self.alive_ranks())
-        if n >= self.world_size:
-            return ElasticStatus.COMPLETED  # full strength
-        if n >= self.np_min:
-            return ElasticStatus.HOLD       # degraded but viable
-        return ElasticStatus.RESTART        # below min -> relaunch
+        return self.status_of(self.poll())
 
     def reset(self):
         """Clear all rank liveness keys (controller calls this between

@@ -267,6 +267,7 @@ class PipelineParallel(Layer):
             if scaler is not None:
                 scaler.scale(loss).backward()
                 scaler.step(optimizer)
+                scaler.update()
             else:
                 loss.backward()
                 optimizer.step()
@@ -293,6 +294,7 @@ class PipelineParallel(Layer):
             total += float(loss) * w
         if scaler is not None:
             scaler.step(optimizer)
+            scaler.update()
         else:
             optimizer.step()
         optimizer.clear_grad()

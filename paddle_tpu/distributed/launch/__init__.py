@@ -76,17 +76,17 @@ def _spawn_pod(args, nprocs, attempt, elastic_port=None):
     return procs
 
 
-def _watch_pod(procs, poll_s=0.2, watcher=None):
+def _watch_pod(procs, poll_s=0.2, watcher=None, register_deadline=120.0):
     """Reference controller watch loop: poll children; on the FIRST
     non-zero exit kill the whole pod (a half-dead mesh cannot make
     progress) and report failure. With an ElasticManager ``watcher``,
-    a hung rank (heartbeat stopped, process still alive) also fails
-    the pod. Returns 0 when all exit clean."""
+    a hung rank also fails the pod — whether it hung after starting
+    (beat went stale) or during startup (never registered within
+    ``register_deadline`` seconds). Returns 0 when all exit clean."""
     import time
-    from ..fleet.elastic import ElasticStatus
     live = list(procs)
     failed = 0
-    all_registered = False
+    t0 = time.monotonic()
     while live and not failed:
         time.sleep(poll_s)
         for p, _log in list(live):
@@ -98,14 +98,17 @@ def _watch_pod(procs, poll_s=0.2, watcher=None):
                 failed = rc
                 break
         if not failed and watcher is not None and live:
-            n_alive = len(watcher.alive_ranks())
-            if n_alive >= watcher.world_size:
-                all_registered = True
-            elif all_registered and watcher.watch() == \
-                    ElasticStatus.RESTART:
+            polled = watcher.poll()  # ONE store sweep per tick
+            if polled["dead"]:
                 print("[launch] heartbeat lost for ranks "
-                      f"{watcher.dead_ranks()}; failing the pod",
+                      f"{polled['dead']}; failing the pod",
                       file=sys.stderr)
+                failed = 1
+            elif polled["pending"] and \
+                    time.monotonic() - t0 > register_deadline:
+                print("[launch] ranks never registered within "
+                      f"{register_deadline}s: {polled['pending']}; "
+                      "failing the pod", file=sys.stderr)
                 failed = 1
     if failed:
         for p, _log in live:
@@ -141,7 +144,9 @@ def launch(argv=None):
     while True:
         procs = _spawn_pod(args, nprocs, attempt,
                            elastic_port=elastic_port)
-        code = _watch_pod(procs, watcher=watcher)
+        code = _watch_pod(procs, watcher=watcher,
+                          register_deadline=max(
+                              60.0, 10 * args.elastic_timeout))
         if code == 0:
             return
         if attempt >= args.max_restarts:

@@ -191,13 +191,18 @@ class TCPStore:
 
     def try_get(self, key: str):
         """Non-blocking get: None when the key does not exist (no
-        server-side wait, unlike get())."""
+        server-side wait, unlike get()). RPC failures raise — a broken
+        connection must not read as 'key missing' (a liveness watcher
+        would misdeclare every rank dead)."""
         buf = ctypes.create_string_buffer(1 << 16)
         n = self._lib.tcps_try_get(self._client, key.encode(),
                                    ctypes.cast(buf, ctypes.c_void_p),
                                    len(buf))
-        if n < 0:
+        if n == -3:
             return None
+        if n < 0:
+            raise RuntimeError(f"TCPStore try_get({key!r}) failed "
+                               f"(code {int(n)})")
         return buf.raw[:min(int(n), len(buf))]
 
     def add(self, key: str, amount: int) -> int:

@@ -43,15 +43,42 @@ def test_elastic_np_range_hold_vs_restart():
                          np_range=(1, 3), timeout=5.0)
     try:
         mgr.register()
-        # 1 of 3 alive but np_min=1 -> degraded HOLD, not RESTART
+        # 1 of 3 alive, others pending (still starting) -> HOLD
         assert mgr.watch() == ElasticStatus.HOLD
         assert mgr.ready()
+        # a DEAD rank (registered, stale beat) below np_min -> RESTART
         strict = ElasticManager(rank=2, world_size=3, is_master=False,
                                 port=mgr.port, np_range=(3, 3),
-                                timeout=5.0)
+                                timeout=0.3)
+        time.sleep(0.5)  # rank 0's beat goes stale for `strict`
+        polled = strict.poll()
+        assert polled["dead"] == [0] and polled["pending"] == [1, 2]
         assert strict.watch() == ElasticStatus.RESTART
         assert not strict.ready()
         strict.close()
+    finally:
+        mgr.close()
+
+
+def test_elastic_finished_ranks_not_dead():
+    """A deregistered (cleanly exited) rank is 'finished', never
+    triggering a restart of a completing job."""
+    mgr = ElasticManager(rank=0, world_size=2, is_master=True,
+                         timeout=0.5)
+    try:
+        mgr.register()
+        peer = ElasticManager(rank=1, world_size=2, is_master=False,
+                              port=mgr.port, timeout=0.5)
+        peer.register()
+        peer.deregister()   # clean exit
+        time.sleep(0.7)     # peer's beat is stale now
+        mgr.heartbeat()
+        polled = mgr.poll()
+        assert polled["alive"] == [0]
+        assert polled["finished"] == [1]
+        assert polled["dead"] == []
+        assert mgr.watch() != ElasticStatus.RESTART
+        peer.close()
     finally:
         mgr.close()
 
