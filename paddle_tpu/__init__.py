@@ -42,8 +42,14 @@ from . import device
 from . import distributed
 from . import incubate
 from . import utils
+from .framework import errors
+# NOTE: not `from .framework import log` — that would shadow the
+# paddle.log math op with the logging module
+from .framework.log import get_logger, logger, vlog
 from . import profiler
 from . import sparse
+from . import audio
+from . import quantization
 from . import fft
 from . import inference
 from . import distribution

@@ -1,0 +1,5 @@
+"""``paddle.audio`` (reference ``python/paddle/audio/``): feature
+layers + functional over jnp FFT."""
+from . import features, functional
+
+__all__ = ["features", "functional"]

@@ -1,0 +1,128 @@
+"""``paddle.audio.features`` layers (reference ``python/paddle/audio/
+features/layers.py``): Spectrogram / MelSpectrogram / LogMelSpectrogram
+/ MFCC — framed STFT via jnp FFT (one rfft batch, MXU-friendly
+filterbank matmuls)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn.layer.layers import Layer
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(x, n_fft, hop_length, win, center, power,
+                pad_mode="reflect"):
+    """x: [B, T] -> power spectrogram [B, 1 + n_fft//2, frames]."""
+    if center:
+        pad = n_fft // 2
+        x = jnp.pad(x, ((0, 0), (pad, pad)), mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(n_fft)[None, :])
+    frames = x[:, idx]                      # [B, frames, n_fft]
+    frames = frames * win[None, None, :]
+    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+    mag = jnp.abs(spec)
+    if power != 1.0:
+        mag = mag ** power
+    return mag.transpose(0, 2, 1)           # [B, bins, frames]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = as_jax(F.get_window(window, self.win_length))
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - self.win_length - lpad))
+        self._win = w
+
+    def forward(self, x):
+        def f(a):
+            squeeze = a.ndim == 1
+            if squeeze:
+                a = a[None]
+            out = _stft_power(a, self.n_fft, self.hop_length, self._win,
+                              self.center, self.power, self.pad_mode)
+            return out[0] if squeeze else out
+        return apply_jax("spectrogram", f, x)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center)
+        self.fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+
+        def f(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+        return apply_jax("mel_spectrogram", f, spec, self.fbank)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                   window, power, center, n_mels, f_min,
+                                   f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+
+        def f(m):
+            out = 10.0 * jnp.log10(jnp.maximum(m, self.amin))
+            out = out - 10.0 * jnp.log10(
+                jnp.maximum(self.ref_value, self.amin))
+            if self.top_db is not None:
+                out = jnp.maximum(out, jnp.max(out) - self.top_db)
+            return out
+        return apply_jax("log_mel", f, mel)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db)
+        self.dct = F.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        lm = self._log_mel(x)
+
+        def f(m, d):
+            return jnp.einsum("mk,...mt->...kt", d, m)
+        return apply_jax("mfcc", f, lm, self.dct)

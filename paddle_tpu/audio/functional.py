@@ -1,0 +1,136 @@
+"""``paddle.audio.functional`` (reference ``python/paddle/audio/
+functional/``): window functions, mel filterbanks, DCT — pure jnp."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "compute_fbank_matrix", "create_dct", "get_window",
+           "power_to_db", "fft_frequencies"]
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not hasattr(freq, "shape") and not isinstance(freq, Tensor)
+    f = np.asarray(freq, np.float32) if scalar else \
+        np.asarray(as_jax(freq) if isinstance(freq, Tensor) else freq)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar else _wrap_out(jnp.asarray(mel))
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not hasattr(mel, "shape") and not isinstance(mel, Tensor)
+    m = np.asarray(mel, np.float32) if scalar else \
+        np.asarray(as_jax(mel) if isinstance(mel, Tensor) else mel)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                      hz)
+    return float(hz) if scalar else _wrap_out(jnp.asarray(hz))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return _wrap_out(jnp.asarray(
+        np.asarray([mel_to_hz(float(m), htk) for m in mels],
+                   np.float32)))
+
+
+def fft_frequencies(sr, n_fft):
+    return _wrap_out(jnp.linspace(0, float(sr) / 2,
+                                  1 + n_fft // 2).astype(jnp.float32))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, 1 + n_fft//2] mel filterbank (librosa/paddle parity)."""
+    f_max = f_max or float(sr) / 2
+    fft_f = np.asarray(fft_frequencies(sr, n_fft).numpy())
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max,
+                                       htk).numpy())
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return _wrap_out(jnp.asarray(weights.astype(np.float32)))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (paddle parity layout)."""
+    n = np.arange(float(n_mels))
+    k = np.arange(float(n_mfcc))
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return _wrap_out(jnp.asarray(dct.astype(np.float32)))
+
+
+def get_window(window, win_length, fftbins=True):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian windows."""
+    M = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length, dtype=np.float32)
+    denom = max(M, 1)
+    if isinstance(window, tuple):
+        name, arg = window
+    else:
+        name, arg = window, None
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / denom)
+             + 0.08 * np.cos(4 * math.pi * n / denom))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * n / denom - 1.0)
+    elif name == "kaiser":
+        beta = 14.0 if arg is None else float(arg)
+        w = np.i0(beta * np.sqrt(np.clip(
+            1 - (2 * n / denom - 1) ** 2, 0, 1))) / np.i0(beta)
+    elif name == "gaussian":
+        std = 7.0 if arg is None else float(arg)
+        w = np.exp(-0.5 * ((n - M / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return _wrap_out(jnp.asarray(w.astype(np.float32)))
+
+
+def power_to_db(magnitude, ref_value=1.0, amin=1e-10, top_db=80.0):
+    x = as_jax(magnitude) if isinstance(magnitude, Tensor) \
+        else jnp.asarray(magnitude)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return _wrap_out(log_spec)

@@ -82,6 +82,13 @@ def init_parallel_env(strategy=None):
                 num_processes=env.world_size, process_id=env.rank)
         except Exception:
             pass  # already initialized or single-host emulation
+    log_dir = os.environ.get("PADDLE_LOG_DIR")
+    if log_dir:
+        from ..framework.log import init_per_rank_logging
+        init_per_rank_logging(log_dir, rank=env.rank)
+    from ..framework.log import vlog
+    vlog(1, "init_parallel_env: rank %d / world %d", env.rank,
+         env.world_size)
     if os.environ.get("PADDLE_ELASTIC_ENABLE") == "1" \
             and env.world_size > 1:
         try:

@@ -56,6 +56,7 @@ def _spawn_pod(args, nprocs, attempt, elastic_port=None):
             "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
             "PADDLE_MASTER": args.master or "127.0.0.1:6170",
             "PADDLE_RESTART_ATTEMPT": str(attempt),
+            "PADDLE_LOG_DIR": args.log_dir,
             "FLAGS_selected_gpus": str(rank),
         })
         if elastic_port is not None:

@@ -74,9 +74,11 @@ def ulysses_attention(q, k, v, mesh: Mesh = None, axis: str = "sep",
 
     n_heads = q_arr.shape[2]
     if n_heads % sp != 0:
-        raise ValueError(
+        from ..framework.errors import InvalidArgumentError
+        raise InvalidArgumentError(
             f"ulysses_attention: num_heads={n_heads} not divisible by "
-            f"sep degree {sp}; use sep_mechanism='ring' for this shape")
+            f"sep degree {sp}",
+            hint="use sep_mechanism='ring' for this shape")
 
     def per_device(ql, kl, vl):
         # [B, L/sp, H, D] -> all_to_all -> [B, L, H/sp, D]

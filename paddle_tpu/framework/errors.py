@@ -1,0 +1,142 @@
+"""Error taxonomy + enforce helpers (reference:
+``paddle/common/errors.h`` error codes and the ``PADDLE_ENFORCE_*``
+macro family in ``paddle/fluid/platform/enforce.h``).
+
+TPU-first: the reference's macros capture C++ stack traces and map CUDA
+error codes; here the taxonomy is Python exception classes that ALSO
+subclass the naturally corresponding builtin (InvalidArgumentError is a
+ValueError, OutOfRangeError an IndexError, ...), so reference scripts
+catching either the Paddle class or the builtin keep working. Messages
+follow Paddle's ``(ErrorKind) message\n  [Hint: ...]`` shape.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "ResourceExhaustedError",
+    "PreconditionNotMetError", "PermissionDeniedError",
+    "ExecutionTimeoutError", "UnimplementedError", "UnavailableError",
+    "FatalError", "ExternalError", "enforce", "enforce_eq",
+    "enforce_ne", "enforce_gt", "enforce_ge", "enforce_lt",
+    "enforce_le", "enforce_not_none", "enforce_shape",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of every enforce failure (``platform::EnforceNotMet``)."""
+
+    kind = "EnforceNotMet"
+
+    def __init__(self, message, hint=None):
+        text = f"({self.kind}) {message}"
+        if hint:
+            text += f"\n  [Hint: {hint}]"
+        super().__init__(text)
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    kind = "InvalidArgument"
+
+
+class NotFoundError(EnforceNotMet, LookupError):
+    kind = "NotFound"
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    kind = "OutOfRange"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    kind = "AlreadyExists"
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    kind = "ResourceExhausted"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    kind = "PreconditionNotMet"
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    kind = "PermissionDenied"
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    kind = "ExecutionTimeout"
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    kind = "Unimplemented"
+
+
+class UnavailableError(EnforceNotMet):
+    kind = "Unavailable"
+
+
+class FatalError(EnforceNotMet):
+    kind = "Fatal"
+
+
+class ExternalError(EnforceNotMet):
+    kind = "External"
+
+
+def enforce(condition, message, error=InvalidArgumentError, hint=None):
+    """``PADDLE_ENFORCE(cond, ...)``: raise ``error`` unless condition."""
+    if not condition:
+        raise error(message, hint=hint)
+
+
+def _cmp(name, op, a, b, message, error, hint):
+    if not op(a, b):
+        msg = message or f"expected {a!r} {name} {b!r}"
+        raise error(msg, hint=hint)
+
+
+def enforce_eq(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp("==", lambda x, y: x == y, a, b, message, error, hint)
+
+
+def enforce_ne(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp("!=", lambda x, y: x != y, a, b, message, error, hint)
+
+
+def enforce_gt(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp(">", lambda x, y: x > y, a, b, message, error, hint)
+
+
+def enforce_ge(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp(">=", lambda x, y: x >= y, a, b, message, error, hint)
+
+
+def enforce_lt(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp("<", lambda x, y: x < y, a, b, message, error, hint)
+
+
+def enforce_le(a, b, message=None, error=InvalidArgumentError,
+               hint=None):
+    _cmp("<=", lambda x, y: x <= y, a, b, message, error, hint)
+
+
+def enforce_not_none(value, name="value", error=InvalidArgumentError,
+                     hint=None):
+    if value is None:
+        raise error(f"{name} must not be None", hint=hint)
+    return value
+
+
+def enforce_shape(tensor, expected, name="tensor"):
+    """Shape check: ``expected`` dims of None are wildcards."""
+    shape = list(tensor.shape)
+    ok = len(shape) == len(expected) and all(
+        e is None or s == e for s, e in zip(shape, expected))
+    if not ok:
+        raise InvalidArgumentError(
+            f"{name} has shape {shape}, expected "
+            f"{[e if e is not None else '*' for e in expected]}")

@@ -1,0 +1,87 @@
+"""Leveled logging + per-rank log files (reference: glog ``VLOG(n)`` /
+``GLOG_v`` gating throughout the C++ stack, and the launch module's
+per-rank ``workerlog.N`` files — SURVEY §5.5).
+
+``vlog(n, ...)`` emits only when n <= the active verbosity, which is
+``GLOG_v`` (env, glog parity) or ``FLAGS_log_level``. The logger is the
+ordinary ``logging`` logger named "paddle_tpu", so applications can
+attach their own handlers; ``init_per_rank_logging`` adds the
+rank-tagged file handler the reference launch controller provides.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+__all__ = ["logger", "vlog", "vlog_level", "init_per_rank_logging",
+           "get_logger"]
+
+logger = logging.getLogger("paddle_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+
+
+def get_logger(name: Optional[str] = None, level=None):
+    lg = logger if name is None else logger.getChild(name)
+    if level is not None:
+        lg.setLevel(level)
+    return lg
+
+
+_cached = (-1, 0)
+
+
+def vlog_level() -> int:
+    """Active verbosity: GLOG_v env wins (glog parity), else
+    FLAGS_log_level; cached against the flag-registry version."""
+    global _cached
+    from .. import base_flags as bf
+    if _cached[0] != bf._version:
+        env = os.environ.get("GLOG_v")
+        if env is not None:
+            try:
+                level = int(env)
+            except ValueError:
+                level = 0
+        else:
+            level = int(bf.get_flag("FLAGS_log_level", 0))
+        _cached = (bf._version, level)
+    return _cached[1]
+
+
+def vlog(level: int, msg, *args):
+    """``VLOG(level) << msg`` parity: emitted when level <= verbosity."""
+    if level <= vlog_level():
+        # format the caller's message separately so literal % in a
+        # plain message can't corrupt the combined format string
+        text = (str(msg) % args) if args else str(msg)
+        logger.info("[v%d] %s", level, text)
+
+
+def init_per_rank_logging(log_dir, rank: Optional[int] = None,
+                          level=logging.INFO):
+    """Attach a ``workerlog.<rank>`` file handler tagged with the rank
+    (the reference launch controller's per-rank log layout). Called
+    automatically by ``init_parallel_env`` when PADDLE_LOG_DIR is set."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    os.makedirs(log_dir, exist_ok=True)
+    path = os.path.join(log_dir, f"workerlog.{rank}")
+    for h in logger.handlers:
+        if isinstance(h, logging.FileHandler) and \
+                getattr(h, "_paddle_rank_file", None) == path:
+            return logger  # already attached
+    handler = logging.FileHandler(path)
+    handler._paddle_rank_file = path
+    handler.setFormatter(logging.Formatter(
+        f"%(asctime)s rank={rank} %(levelname)s %(message)s"))
+    handler.setLevel(level)
+    logger.addHandler(handler)
+    return logger

@@ -115,7 +115,8 @@ class GenerationMixin:
         if max_pos is not None and prompt_len + max_new > max_pos:
             # beyond the rope/position tables the dynamic slices clamp
             # and silently reuse the last position — error instead
-            raise ValueError(
+            from ..framework.errors import InvalidArgumentError
+            raise InvalidArgumentError(
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new}) "
                 f"exceeds max_position_embeddings ({max_pos})")
 

@@ -177,7 +177,8 @@ class Predictor:
     def run(self, inputs: Optional[List[np.ndarray]] = None):
         if inputs is not None:
             if len(inputs) != len(self._input_names):
-                raise ValueError(
+                from ..framework.errors import InvalidArgumentError
+                raise InvalidArgumentError(
                     f"run() got {len(inputs)} inputs; the exported "
                     f"program expects {len(self._input_names)} "
                     f"({self._input_names})")

@@ -1,0 +1,288 @@
+"""``paddle.quantization`` (reference: ``python/paddle/quantization/``
+— QuantConfig + QAT/PTQ flows over observer/quanter factories).
+
+TPU-first: fake-quant is a pure jax op with a straight-through-estimator
+custom VJP (the reference's ``fake_quantize_dequantize_moving_average_
+abs_max`` CUDA kernel pair); observers are plain running statistics on
+the host-visible activations. Quantized layers stay jit-compatible —
+the QDQ ops fuse into the surrounding matmuls under XLA, and at export
+time the scales are ordinary weights.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "BaseQuanter",
+           "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver",
+           "quanterize", "QuantedLinear"]
+
+
+# ---------------------------------------------------------------------------
+# fake quantize-dequantize with STE gradient
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def fake_quant_dequant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax - 1, qmax)
+    return q * s / qmax
+
+
+def _fqd_fwd(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    in_range = jnp.abs(x) <= s
+    return fake_quant_dequant(x, scale, qmax), in_range
+
+
+def _fqd_bwd(res, g):
+    # straight-through: pass gradients inside the clip range, zero out
+    in_range = res
+    return (jnp.where(in_range, g, 0.0), None, None)
+
+
+fake_quant_dequant.defvjp(_fqd_fwd, _fqd_bwd)
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters
+# ---------------------------------------------------------------------------
+
+class BaseQuanter(Layer):
+    """Observes ranges and applies QDQ; subclasses define the scale."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def scales(self):
+        raise NotImplementedError
+
+    def forward(self, x):
+        scale = self.scales()
+
+        def f(a, s):
+            return fake_quant_dequant(a, s.astype(jnp.float32),
+                                      jnp.float32(self.qmax))
+        return apply_jax("fake_quant", f, x, scale)
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ observer: tracks max(|x|) over calibration batches; forward
+    is identity until ``convert`` swaps in QDQ."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._absmax = jnp.zeros((), jnp.float32)
+        self._observing = True
+
+    def scales(self):
+        return _wrap_out(jnp.maximum(
+            jnp.asarray(self._absmax, jnp.float32), 1e-9))
+
+    def forward(self, x):
+        if self._observing:
+            arr = as_jax(x)
+            # device-side update (no host sync); traced calibration
+            # steps can't update host state -> skip (observe eagerly)
+            if not isinstance(arr, jax.core.Tracer):
+                self._absmax = jnp.maximum(
+                    self._absmax, jnp.max(jnp.abs(arr))
+                    .astype(jnp.float32))
+            return x
+        return super().forward(x)
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT quanter (``FakeQuanterWithAbsMaxObserver`` parity): a moving
+    average of per-batch abs-max drives the scale; QDQ applies from the
+    first step with the STE backward."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, **kw):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._state = jnp.zeros((), jnp.float32)
+        self._initialized = False
+
+    def scales(self):
+        return _wrap_out(jnp.maximum(
+            jnp.asarray(self._state, jnp.float32), 1e-9))
+
+    def forward(self, x):
+        arr = as_jax(x)
+        # device-side moving average — no per-step host sync; the
+        # scale consumed by QDQ stays one step stale, matching the
+        # reference's moving-average semantics
+        if not isinstance(arr, jax.core.Tracer) and self.training:
+            cur = jnp.max(jnp.abs(arr)).astype(jnp.float32)
+            if not self._initialized:
+                self._state = cur
+                self._initialized = True
+            else:
+                r = jnp.float32(self.moving_rate)
+                self._state = r * self._state + (1 - r) * cur
+        return super().forward(x)
+
+
+def quanterize(cls=FakeQuanterWithAbsMaxObserver, **kwargs):
+    """Factory helper (reference's quanter config entries)."""
+    return functools.partial(cls, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# quantized layers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with weight + activation fake-quant (the reference's
+    ``quanted.Linear``). Shares the wrapped layer's parameter objects so
+    optimizers keep updating the same weights."""
+
+    def __init__(self, linear, weight_quanter, act_quanter):
+        super().__init__()
+        self._inner = linear
+        self.weight_quanter = weight_quanter
+        self.activation_quanter = act_quanter
+        # expose the same params (shared objects, not copies)
+        self.weight = linear.weight
+        if getattr(linear, "bias", None) is not None:
+            self.bias = linear.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..ops.linalg import matmul
+        out = matmul(x, w)
+        if getattr(self._inner, "bias", None) is not None:
+            from ..ops.math import add
+            out = add(out, self._inner.bias)
+        return out
+
+
+_QUANTABLE: Dict[str, Type[Layer]] = {}
+
+
+def _quantable_types():
+    if not _QUANTABLE:
+        from ..nn.layer.common import Linear
+        _QUANTABLE["Linear"] = Linear
+    return _QUANTABLE
+
+
+# ---------------------------------------------------------------------------
+# config + flows
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """``paddle.quantization.QuantConfig`` parity (subset): per-layer
+    and per-type quanter assignment."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default_activation = activation
+        self.default_weight = weight
+        self._layer_cfg = {}   # id(layer) -> (act, weight)
+        self._type_cfg = {}    # type -> (act, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _factories_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self.default_activation or self.default_weight:
+            return (self.default_activation, self.default_weight)
+        return None
+
+
+def _swap_layers(model, make_wrapper):
+    """Replace quantable sublayers in place (recursively); the set of
+    swappable types is the _QUANTABLE registry."""
+    quantable = tuple(_quantable_types().values())
+    replaced = 0
+    for name, child in list(getattr(model, "_sub_layers", {}).items()):
+        if isinstance(child, quantable):
+            wrapper = make_wrapper(child)
+            if wrapper is not None:
+                model._sub_layers[name] = wrapper
+                replaced += 1
+        else:
+            replaced += _swap_layers(child, make_wrapper)
+    return replaced
+
+
+class QAT:
+    """Quantization-aware training flow (``paddle.quantization.QAT``)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        cfg = self.config
+
+        def wrap(linear):
+            factories = cfg._factories_for(linear)
+            if factories is None:
+                return None
+            act_f, w_f = factories
+            return QuantedLinear(linear,
+                                 w_f() if w_f else None,
+                                 act_f() if act_f else None)
+
+        n = _swap_layers(model, wrap)
+        model._quanted_layers = n
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe during calibration, then
+    ``convert`` freezes scales and activates QDQ."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=True):
+        cfg = self.config
+
+        def wrap(linear):
+            factories = cfg._factories_for(linear)
+            if factories is None:
+                return None
+            act_f, w_f = factories
+            act = act_f() if act_f else None
+            w = w_f() if w_f else None
+            return QuantedLinear(linear, w, act)
+
+        model._quanted_layers = _swap_layers(model, wrap)
+        return model
+
+    def convert(self, model, inplace=True):
+        """Stop observing: every AbsmaxObserver switches to QDQ."""
+        def visit(layer):
+            for child in getattr(layer, "_sub_layers", {}).values():
+                if isinstance(child, AbsmaxObserver):
+                    child._observing = False
+                visit(child)
+        visit(model)
+        return model

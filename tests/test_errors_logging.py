@@ -1,0 +1,109 @@
+"""Error taxonomy + VLOG logging (reference: ``paddle/common/errors.h``
+PADDLE_ENFORCE family + glog VLOG/GLOG_v — SURVEY §2.1, §5.5)."""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import errors
+from paddle_tpu.framework.log import (init_per_rank_logging, logger,
+                                      vlog, vlog_level)
+
+
+def test_error_kinds_subclass_builtins():
+    assert issubclass(errors.InvalidArgumentError, ValueError)
+    assert issubclass(errors.OutOfRangeError, IndexError)
+    assert issubclass(errors.NotFoundError, LookupError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+    assert issubclass(errors.ExecutionTimeoutError, TimeoutError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+    for name in ("InvalidArgumentError", "NotFoundError",
+                 "PreconditionNotMetError", "UnavailableError"):
+        assert issubclass(getattr(errors, name), errors.EnforceNotMet)
+
+
+def test_error_message_format():
+    e = errors.InvalidArgumentError("axis must be positive",
+                                    hint="got axis=-3")
+    assert str(e) == ("(InvalidArgument) axis must be positive\n"
+                      "  [Hint: got axis=-3]")
+
+
+def test_enforce_helpers():
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.InvalidArgumentError, match="boom"):
+        errors.enforce(False, "boom")
+    errors.enforce_eq(3, 3)
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_eq(3, 4)
+    with pytest.raises(errors.NotFoundError):
+        errors.enforce_gt(1, 2, "missing", error=errors.NotFoundError)
+    errors.enforce_not_none(0, "x")  # 0 is not None
+    with pytest.raises(errors.InvalidArgumentError, match="must not"):
+        errors.enforce_not_none(None, "weight")
+
+
+def test_enforce_shape_wildcards():
+    t = paddle.to_tensor(np.zeros((2, 5), np.float32))
+    errors.enforce_shape(t, [None, 5])
+    with pytest.raises(errors.InvalidArgumentError, match="shape"):
+        errors.enforce_shape(t, [None, 4], name="logits")
+
+
+def test_predictor_error_is_taxonomy(tmp_path):
+    """Boundary adoption: Predictor.run raises the taxonomy class (and
+    thus still ValueError for old callers)."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+    layer = nn.Linear(4, 2)
+    path = str(tmp_path / "m")
+    paddle.jit.save(layer, path, input_spec=[InputSpec([2, 4],
+                                                       "float32")])
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(path))
+    with pytest.raises(errors.InvalidArgumentError):
+        pred.run([np.zeros((2, 4), np.float32),
+                  np.zeros((2, 4), np.float32)])
+
+
+def test_vlog_gated_by_flag(caplog):
+    logger.propagate = True  # caplog listens on the root logger
+    paddle.set_flags({"FLAGS_log_level": 0})
+    try:
+        with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+            vlog(2, "hidden %d", 42)
+        assert "hidden" not in caplog.text
+        paddle.set_flags({"FLAGS_log_level": 3})
+        assert vlog_level() == 3
+        with caplog.at_level(logging.INFO, logger="paddle_tpu"):
+            vlog(2, "visible %d", 42)
+        assert "visible 42" in caplog.text
+    finally:
+        paddle.set_flags({"FLAGS_log_level": 0})
+        logger.propagate = False
+
+
+def test_glog_v_env_wins(monkeypatch):
+    from paddle_tpu import base_flags
+    monkeypatch.setenv("GLOG_v", "4")
+    base_flags._version += 1  # invalidate the cache
+    assert vlog_level() == 4
+    monkeypatch.delenv("GLOG_v")
+    base_flags._version += 1
+
+
+def test_per_rank_log_file(tmp_path):
+    lg = init_per_rank_logging(str(tmp_path), rank=3)
+    lg.info("hello from a rank")
+    # idempotent: second call must not duplicate handlers
+    n = len(logger.handlers)
+    init_per_rank_logging(str(tmp_path), rank=3)
+    assert len(logger.handlers) == n
+    for h in list(logger.handlers):
+        if getattr(h, "_paddle_rank_file", None):
+            h.flush()
+            logger.removeHandler(h)
+    content = open(os.path.join(tmp_path, "workerlog.3")).read()
+    assert "rank=3" in content and "hello from a rank" in content

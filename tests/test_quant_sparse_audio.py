@@ -1,0 +1,201 @@
+"""quantization / sparse (BCOO) / audio coverage (reference:
+``python/paddle/quantization``, ``paddle/phi/kernels/sparse``,
+``python/paddle/audio`` — SURVEY §2.5 'Others')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ---------------------------------------------------------------- quant
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_qat_quantize_swaps_linears():
+    from paddle_tpu.quantization import (QAT, QuantConfig,
+                                         FakeQuanterWithAbsMaxObserver,
+                                         QuantedLinear, quanterize)
+    q = quanterize(FakeQuanterWithAbsMaxObserver, moving_rate=0.9)
+    model = _mlp()
+    qat = QAT(QuantConfig(activation=q, weight=q))
+    qat.quantize(model)
+    assert model._quanted_layers == 2
+    assert isinstance(model[0], QuantedLinear)
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype(np.float32))
+    out = model(x)
+    assert out.shape == [4, 4]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_qat_output_close_and_trains():
+    from paddle_tpu.quantization import (QAT, QuantConfig,
+                                         FakeQuanterWithAbsMaxObserver,
+                                         quanterize)
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+    ref_model = _mlp()
+    ref = ref_model(x).numpy()
+
+    model = _mlp()  # same seed -> same init
+    q = quanterize(FakeQuanterWithAbsMaxObserver)
+    QAT(QuantConfig(activation=q, weight=q)).quantize(model)
+    model.train()
+    out = model(x).numpy()
+    # int8 QDQ: close but not equal
+    assert np.abs(out - ref).max() < 0.2
+    assert np.abs(out - ref).max() > 0
+
+    # STE gradients flow to the ORIGINAL weight objects
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    before = model[0].weight.numpy().copy()
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    g = model[0].weight.grad
+    assert g is not None and np.abs(g.numpy()).max() > 0
+    opt.step()
+    assert not np.allclose(before, model[0].weight.numpy())
+
+
+def test_ptq_observe_then_convert():
+    from paddle_tpu.quantization import (PTQ, QuantConfig,
+                                         AbsmaxObserver, quanterize)
+    rng = np.random.RandomState(2)
+    model = _mlp()
+    x = paddle.to_tensor(rng.randn(32, 8).astype(np.float32))
+    ref = model(x).numpy()
+    ptq = PTQ(QuantConfig(activation=quanterize(AbsmaxObserver),
+                          weight=quanterize(AbsmaxObserver)))
+    ptq.quantize(model)
+    model.eval()
+    calibrated = model(x).numpy()          # observing: identity QDQ
+    np.testing.assert_allclose(calibrated, ref, rtol=1e-5, atol=1e-6)
+    ptq.convert(model)
+    quanted = model(x).numpy()             # now QDQ active
+    assert 0 < np.abs(quanted - ref).max() < 0.2
+
+
+# --------------------------------------------------------------- sparse
+
+def test_sparse_coo_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    idx = np.array([[0, 1, 1], [1, 0, 2]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    s = paddle.sparse.sparse_coo_tensor(idx, vals, (2, 3))
+    assert s.nnz() == 3
+    np.testing.assert_allclose(s.to_dense().numpy(), dense)
+    np.testing.assert_allclose(np.sort(s.values().numpy()), [1, 2, 3])
+
+
+def test_sparse_add_multiply_relu():
+    import paddle_tpu.sparse as sp
+    a = sp.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, -2.0], (2, 2))
+    b = sp.sparse_coo_tensor([[0, 1], [0, 0]], [5.0, 7.0], (2, 2))
+    s = sp.add(a, b)
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[6, 0], [7, -2]])
+    r = sp.relu(a)
+    np.testing.assert_allclose(r.to_dense().numpy(), [[1, 0], [0, 0]])
+    dense = paddle.to_tensor(np.full((2, 2), 3.0, np.float32))
+    m = sp.multiply(a, dense)
+    np.testing.assert_allclose(m.to_dense().numpy(), [[3, 0], [0, -6]])
+
+
+def test_sparse_matmul_and_masked_matmul():
+    import paddle_tpu.sparse as sp
+    rng = np.random.RandomState(3)
+    dense_a = rng.randn(4, 5).astype(np.float32)
+    dense_a[dense_a < 0.5] = 0  # sparsify
+    s = paddle.sparse.sparse_coo_tensor(
+        np.argwhere(dense_a).T, dense_a[dense_a != 0], (4, 5))
+    y = rng.randn(5, 3).astype(np.float32)
+    out = sp.matmul(s, paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), dense_a @ y, rtol=1e-5,
+                               atol=1e-5)
+
+    # SDDMM: sample x@y at a sparse mask
+    x = rng.randn(4, 6).astype(np.float32)
+    y2 = rng.randn(6, 5).astype(np.float32)
+    mask = paddle.sparse.sparse_coo_tensor(
+        [[0, 2, 3], [1, 4, 0]], [1.0, 1.0, 1.0], (4, 5))
+    got = sp.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y2),
+                           mask).to_dense().numpy()
+    full = x @ y2
+    expect = np.zeros_like(full)
+    for r, c in [(0, 1), (2, 4), (3, 0)]:
+        expect[r, c] = full[r, c]
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_csr_constructor():
+    s = paddle.sparse.sparse_csr_tensor(
+        crows=[0, 2, 3], cols=[0, 2, 1], values=[1.0, 2.0, 3.0],
+        shape=(2, 3))
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[1, 0, 2], [0, 3, 0]])
+
+
+# ---------------------------------------------------------------- audio
+
+def test_window_and_fbank_shapes():
+    from paddle_tpu.audio import functional as AF
+    w = AF.get_window("hann", 64)
+    assert w.shape == [64]
+    assert abs(float(w.numpy()[0])) < 1e-6  # hann starts at 0
+    fb = AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == [40, 257]
+    assert float(fb.numpy().min()) >= 0
+    # every fft bin above f_min covered by some filter
+    assert (fb.numpy().sum(0)[5:200] > 0).all()
+
+
+def test_mel_hz_roundtrip():
+    from paddle_tpu.audio import functional as AF
+    for hz in (60.0, 440.0, 4000.0):
+        assert abs(AF.mel_to_hz(AF.hz_to_mel(hz)) - hz) < 1e-2 * hz
+
+
+def test_spectrogram_sine_peak():
+    """A pure tone's spectrogram peaks at the right fft bin."""
+    from paddle_tpu.audio.features import Spectrogram
+    sr, f = 16000, 1000.0
+    t = np.arange(sr, dtype=np.float32) / sr
+    x = paddle.to_tensor(np.sin(2 * np.pi * f * t)[None])
+    spec = Spectrogram(n_fft=512, hop_length=256)(x)
+    bins, frames = spec.shape[1], spec.shape[2]
+    assert bins == 257 and frames > 10
+    peak_bin = int(np.asarray(spec.numpy())[0].mean(axis=1).argmax())
+    expect = round(f * 512 / sr)
+    assert abs(peak_bin - expect) <= 1
+
+
+def test_mfcc_pipeline_shapes():
+    from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                           MelSpectrogram)
+    x = paddle.to_tensor(
+        np.random.RandomState(4).randn(2, 8000).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 40
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert logmel.shape == mel.shape
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert mfcc.shape[0] == 2 and mfcc.shape[1] == 13
+    assert np.isfinite(mfcc.numpy()).all()
+
+
+def test_sparse_scalar_and_sparse_sparse_multiply():
+    import paddle_tpu.sparse as sp
+    s = sp.sparse_coo_tensor([[0, 1], [1, 2]], [1.0, 2.0], (3, 3))
+    scaled = s * 2.0                          # scalar broadcast
+    np.testing.assert_allclose(scaled.values().numpy(), [2.0, 4.0])
+    t = sp.sparse_coo_tensor([[0, 2], [1, 0]], [10.0, 5.0], (3, 3))
+    prod = sp.multiply(s, t)                  # intersect patterns
+    dense = np.zeros((3, 3), np.float32)
+    dense[0, 1] = 1.0 * 10.0                  # only shared coordinate
+    np.testing.assert_allclose(prod.to_dense().numpy(), dense)
+    with pytest.raises(ValueError, match="shape mismatch"):
+        sp.add(s, sp.sparse_coo_tensor([[0], [0]], [1.0], (2, 2)))
