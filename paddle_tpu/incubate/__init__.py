@@ -7,6 +7,7 @@ and route to the same Pallas/XLA kernels as the nn.functional ops.
 from __future__ import annotations
 
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

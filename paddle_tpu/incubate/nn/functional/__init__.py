@@ -137,7 +137,178 @@ def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
     return F.layer_norm(h, [h.shape[-1]], ln_scale, ln_bias, ln_epsilon)
 
 
-def masked_multihead_attention(*args, **kwargs):
-    raise NotImplementedError(
-        "masked_multihead_attention: decode-time MMHA lands with the "
-        "inference stack milestone")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Fused MHA (reference ``fused_attention`` op /
+    ``incubate.nn.functional.fused_multi_head_attention``):
+    [pre-LN ->] qkv proj -> attention -> out proj -> dropout ->
+    +residual [-> post-LN], one XLA fusion region. x: [B, L, E];
+    qkv_weight: [3, H, D, E] (or [E, 3*E] with transpose_qkv_wb +
+    num_heads)."""
+    from ....framework.errors import (InvalidArgumentError,
+                                      UnimplementedError)
+    if cache_kv is not None:
+        raise UnimplementedError(
+            "fused_multi_head_attention with cache_kv",
+            hint="use masked_multihead_attention for cached decode")
+    if transpose_qkv_wb and num_heads <= 0:
+        raise InvalidArgumentError(
+            "transpose_qkv_wb=True requires num_heads > 0 "
+            "(reference asserts the same)")
+    residual = x
+    h = x
+    if pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+
+    if transpose_qkv_wb:
+        e = as_jax(qkv_weight).shape[0]
+        n_head = num_heads
+        d_head = e // num_heads
+    else:
+        three, n_head, d_head, _e = as_jax(qkv_weight).shape
+    from ....ops.pallas.flash_attention import (flash_attention_core,
+                                                mask_to_bias)
+    mask_arr = mask_to_bias(attn_mask, as_jax(x).dtype) \
+        if attn_mask is not None else None
+    use_attn_dropout = training and attn_dropout_rate > 0
+    drop_key = None
+    if use_attn_dropout:
+        from ....framework import random as _random
+        drop_key = _random.next_key()
+
+    def attn(h_a, w, lw, *maybe_bias):
+        b, l, _ = h_a.shape
+        if transpose_qkv_wb:
+            w = w.reshape(w.shape[0], 3, n_head, d_head)\
+                 .transpose(1, 2, 3, 0)
+        qkv = jnp.einsum("ble,csre->blcsr", h_a, w)  # [B, L, 3, H, D]
+        if maybe_bias:
+            qkv = qkv + maybe_bias[0].reshape(
+                3, n_head, d_head)[None, None]
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        if use_attn_dropout:
+            # explicit path: the reference drops attention PROBS, which
+            # the flash kernel cannot expose
+            s = jnp.einsum("blhd,bmhd->bhlm", q, k) / jnp.sqrt(
+                jnp.float32(d_head)).astype(q.dtype)
+            if mask_arr is not None:
+                s = s + mask_arr
+            probs = jax.nn.softmax(s, axis=-1)
+            keep = jax.random.bernoulli(drop_key,
+                                        1.0 - attn_dropout_rate,
+                                        probs.shape)
+            probs = jnp.where(keep,
+                              probs / (1.0 - attn_dropout_rate), 0.0)
+            ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
+        else:
+            ctx = flash_attention_core(q, k, v, bias=mask_arr)
+        ctx = ctx.reshape(b, l, n_head * d_head)
+        return jnp.einsum("blh,he->ble", ctx,
+                          lw.reshape(n_head * d_head, -1))
+
+    # every learnable input rides apply_jax so autograd records it
+    if qkv_bias is not None:
+        out = apply_jax("fused_multi_head_attention", attn, h,
+                        qkv_weight, linear_weight, qkv_bias)
+    else:
+        out = apply_jax("fused_multi_head_attention", attn, h,
+                        qkv_weight, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, sequence_lengths=None,
+                               rotary_tensor=None, num_heads=None,
+                               head_dim=None, seq_len=1, name=None,
+                               **kwargs):
+    """Decode-step MMHA (reference ``fused/masked_multihead_attention``
+    — the generation hot op): x holds ONE step's fused qkv
+    [B, 3*hidden]; cache_kv [2, B, H, max_len, D] is updated at the
+    current length and attention runs against the full cache. Returns
+    (out [B, hidden], new_cache_kv).
+
+    Current length: ``sequence_lengths`` (scalar or [B] with EQUAL
+    entries — ragged batches are rejected), else derived from
+    ``src_mask``'s trailing dim (reference behavior: mask covers t+1
+    positions). ``src_mask`` is applied additively."""
+    from ....framework.errors import (InvalidArgumentError,
+                                      UnimplementedError)
+    if rotary_tensor is not None:
+        raise UnimplementedError(
+            "masked_multihead_attention with rotary_tensor",
+            hint="apply fused_rotary_position_embedding to q/k before "
+                 "the fused qkv concat, or use model-level RoPE")
+    x_arr = as_jax(x)
+    cache = as_jax(cache_kv)
+    two, b, n_head, max_len, d_head = cache.shape
+    if num_heads is None:
+        num_heads = n_head
+    if head_dim is None:
+        head_dim = d_head
+    mask_arr = None
+    if src_mask is not None:
+        mask_arr = as_jax(src_mask)
+    if sequence_lengths is not None:
+        seq = as_jax(sequence_lengths)
+        if seq.ndim:
+            flat = seq.reshape(-1)
+            if not isinstance(flat, jax.core.Tracer):
+                import numpy as _np
+                vals = _np.asarray(flat)
+                if not (vals == vals[0]).all():
+                    raise InvalidArgumentError(
+                        "masked_multihead_attention: ragged "
+                        f"sequence_lengths {vals.tolist()} unsupported "
+                        "(per-row cache offsets not implemented)",
+                        hint="left-pad the batch to equal lengths")
+            offset = flat[0].astype(jnp.int32)
+        else:
+            offset = seq.astype(jnp.int32)
+    elif mask_arr is not None:
+        # reference: the mask spans the live prefix INCLUDING this step
+        offset = jnp.asarray(mask_arr.shape[-1] - 1, jnp.int32)
+    else:
+        offset = jnp.zeros((), jnp.int32)
+    if bias is not None:
+        x_arr = x_arr + as_jax(bias)
+
+    def step(xa, kc):
+        qkv = xa.reshape(b, 1, 3, num_heads, head_dim)
+        q, k_new, v_new = (qkv[:, :, i] for i in range(3))
+        # cache layout [2, B, H, S, D] -> cached_attention's [B, S, H, D]
+        kc_b = kc[0].transpose(0, 2, 1, 3)
+        vc_b = kc[1].transpose(0, 2, 1, 3)
+        extra = None
+        if mask_arr is not None:
+            m = mask_arr.astype(jnp.float32)
+            extra = m.reshape(b, 1, 1, m.shape[-1])
+        from ....models.llama import cached_attention
+        out, kc2, vc2 = cached_attention(q, k_new, v_new, kc_b, vc_b,
+                                         offset, head_dim,
+                                         extra_bias=extra)
+        new_cache = jnp.stack([kc2.transpose(0, 2, 1, 3),
+                               vc2.transpose(0, 2, 1, 3)])
+        return out.reshape(b, num_heads * head_dim), new_cache
+
+    out, new_cache = apply_jax("masked_multihead_attention", step,
+                               Tensor(x_arr), Tensor(cache),
+                               n_outputs=2)
+    return out, new_cache

@@ -1,0 +1,37 @@
+"""``paddle.incubate.optimizer`` — DistributedFusedLamb
+(reference ``python/paddle/incubate/optimizer/distributed_fused_lamb.py``
++ the fused CUDA multi-tensor kernels it drives).
+
+TPU-first: the reference hand-fuses the LAMB update across parameter
+chunks and overlaps its collectives; here the whole update is one
+jitted XLA program already (TrainStep), so "fused" is the default —
+this class adds the *distributed* part: optimizer states sharded over
+the ``sharding`` mesh axis and gradients reduce-scattered (ZeRO-2),
+which is what the reference's chunked allreduce+shard scheme computes.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Lamb
+
+__all__ = ["DistributedFusedLamb"]
+
+
+class DistributedFusedLamb(Lamb):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True,
+                 is_grad_scaled_by_nranks=True, alignment=128,
+                 use_master_param_norm=True, gradient_accumulation_steps=1,
+                 use_master_acc_grad=True, nproc_per_node=None,
+                 name=None):
+        super().__init__(learning_rate, lamb_weight_decay, beta1, beta2,
+                         epsilon, parameters, grad_clip,
+                         exclude_from_weight_decay_fn,
+                         multi_precision=use_master_param_norm)
+        from ...distributed.shard_utils import mesh_axis_size
+        if mesh_axis_size("sharding") > 1:
+            from ...distributed.sharding import (shard_gradients,
+                                                 shard_optimizer_states)
+            shard_optimizer_states(self)
+            shard_gradients(self)

@@ -87,12 +87,15 @@ def _rope_tables(seq_len, head_dim, theta):
     return np.cos(freqs), np.sin(freqs)
 
 
-def cached_attention(qh, kh, vh, kc, vc, off, head_dim):
+def cached_attention(qh, kh, vh, kc, vc, off, head_dim,
+                     extra_bias=None):
     """Shared KV-cache attention step (Llama/GPT families): write this
     chunk's heads [B, L, H', D] into the static cache at ``off``, attend
-    q against the full cache under a causal-with-offset mask. Returns
-    (out [B, L, H, D], new_k_cache, new_v_cache). GQA: cache holds KV
-    heads; repeat to the query head count here."""
+    q against the full cache under a causal-with-offset mask (plus an
+    optional additive ``extra_bias`` broadcastable to [B, H, L, S] —
+    e.g. a decode src_mask). Returns (out [B, L, H, D], new_k_cache,
+    new_v_cache). GQA: cache holds KV heads; repeat to the query head
+    count here."""
     b, l = qh.shape[0], qh.shape[1]
     off = off.astype(jnp.int32) if hasattr(off, "astype") else off
     zero = jnp.zeros((), jnp.int32)
@@ -107,6 +110,14 @@ def cached_attention(qh, kh, vh, kc, vc, off, head_dim):
     rows = off + jnp.arange(l)[:, None]
     cols = jnp.arange(S)[None, :]
     bias = jnp.where(cols <= rows, 0.0, -1e9)[None, None]
+    if extra_bias is not None:
+        pad = S - extra_bias.shape[-1]
+        if pad > 0:  # mask covers the live prefix; mask out the tail
+            extra_bias = jnp.pad(extra_bias,
+                                 [(0, 0)] * (extra_bias.ndim - 1)
+                                 + [(0, pad)],
+                                 constant_values=-1e9)
+        bias = bias + extra_bias
     out = jax.nn.dot_product_attention(
         qh, kf.astype(qh.dtype), vf.astype(qh.dtype),
         bias=bias.astype(qh.dtype), scale=1.0 / math.sqrt(head_dim))

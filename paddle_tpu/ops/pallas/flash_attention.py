@@ -80,16 +80,22 @@ def flash_attention_core(q, k, v, bias=None, is_causal=False, scale=None):
     return _xla_attention(q, k, v, bias, is_causal, scale)
 
 
+def mask_to_bias(mask, dtype):
+    """Bool mask (True = keep) -> additive bias; float masks pass
+    through. Single home for the convention — every attention entry
+    point shares it."""
+    if mask is None:
+        return None
+    m = as_jax(mask)
+    if jnp.issubdtype(m.dtype, jnp.bool_):
+        return jnp.where(m, 0.0, -1e9).astype(dtype)
+    return m
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    bias = None
-    if attn_mask is not None:
-        m = as_jax(attn_mask)
-        if jnp.issubdtype(m.dtype, jnp.bool_):
-            bias = jnp.where(m, 0.0, -1e9).astype(as_jax(query).dtype)
-        else:
-            bias = m
+    bias = mask_to_bias(attn_mask, as_jax(query).dtype)
 
     def f(q, k, v):
         out = flash_attention_core(q, k, v, bias=bias, is_causal=is_causal)

@@ -199,3 +199,149 @@ def test_sparse_scalar_and_sparse_sparse_multiply():
     np.testing.assert_allclose(prod.to_dense().numpy(), dense)
     with pytest.raises(ValueError, match="shape mismatch"):
         sp.add(s, sp.sparse_coo_tensor([[0], [0]], [1.0], (2, 2)))
+
+
+# -------------------------------------------------------- incubate fused
+
+def test_fused_multi_head_attention_matches_manual():
+    import jax.numpy as jnp
+    import jax
+    from paddle_tpu.incubate.nn.functional import \
+        fused_multi_head_attention
+    rng = np.random.RandomState(5)
+    B, L, H, D = 2, 8, 2, 4
+    E = H * D
+    x = rng.randn(B, L, E).astype(np.float32)
+    qkv_w = rng.randn(3, H, D, E).astype(np.float32) * 0.2
+    lin_w = rng.randn(E, E).astype(np.float32) * 0.2
+    ln_s = np.ones(E, np.float32)
+    ln_b = np.zeros(E, np.float32)
+    out = fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(qkv_w),
+        paddle.to_tensor(lin_w), pre_layer_norm=False,
+        ln_scale=paddle.to_tensor(ln_s), ln_bias=paddle.to_tensor(ln_b),
+        dropout_rate=0.0, attn_dropout_rate=0.0)
+    # manual reference
+    qkv = np.einsum("ble,csre->blcsr", x, qkv_w)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ref_ctx = np.asarray(jax.nn.dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        scale=1.0 / np.sqrt(D)))
+    proj = ref_ctx.reshape(B, L, E) @ lin_w + x
+    mean = proj.mean(-1, keepdims=True)
+    var = proj.var(-1, keepdims=True)
+    ref = (proj - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_masked_multihead_attention_decode_matches_full():
+    """MMHA over a growing cache == full attention over the prefix."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn.functional import \
+        masked_multihead_attention
+    rng = np.random.RandomState(6)
+    B, H, D, S = 1, 2, 4, 6
+    hidden = H * D
+    cache = np.zeros((2, B, H, S, D), np.float32)
+    steps = [rng.randn(B, 3 * hidden).astype(np.float32)
+             for _ in range(3)]
+    outs = []
+    c = paddle.to_tensor(cache)
+    for t, xt in enumerate(steps):
+        o, c = masked_multihead_attention(
+            paddle.to_tensor(xt), cache_kv=c,
+            sequence_lengths=paddle.to_tensor(np.int32(t)))
+        outs.append(o.numpy())
+    # reference: full attention over all 3 steps at once
+    qkv = np.stack(steps, 1).reshape(B, 3, 3, H, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ref = np.asarray(jax.nn.dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), is_causal=True,
+        scale=1.0 / np.sqrt(D)))
+    for t in range(3):
+        np.testing.assert_allclose(
+            outs[t][0], ref[0, t].reshape(hidden), rtol=1e-4, atol=1e-5)
+
+
+def test_distributed_fused_lamb_trains():
+    from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+    paddle.seed(0)
+    model = nn.Linear(4, 4)
+    opt = DistributedFusedLamb(0.01, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.RandomState(7)
+                         .randn(8, 4).astype(np.float32))
+    before = model.weight.numpy().copy()
+    loss = (model(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert not np.allclose(before, model.weight.numpy())
+
+
+def test_fused_mha_gradients_reach_qkv_weight():
+    """Review r2: qkv_weight/bias must receive gradients."""
+    from paddle_tpu.incubate.nn.functional import \
+        fused_multi_head_attention
+    rng = np.random.RandomState(8)
+    B, L, H, D = 1, 4, 2, 4
+    E = H * D
+    x = paddle.to_tensor(rng.randn(B, L, E).astype(np.float32))
+    qkv_w = paddle.to_tensor(
+        (rng.randn(3, H, D, E) * 0.2).astype(np.float32),
+        stop_gradient=False)
+    qkv_b = paddle.to_tensor(np.zeros(3 * E, np.float32),
+                             stop_gradient=False)
+    lin_w = paddle.to_tensor(
+        (rng.randn(E, E) * 0.2).astype(np.float32), stop_gradient=False)
+    out = fused_multi_head_attention(
+        x, qkv_w, lin_w, qkv_bias=qkv_b, dropout_rate=0.0,
+        attn_dropout_rate=0.0,
+        ln_scale=paddle.to_tensor(np.ones(E, np.float32)),
+        ln_bias=paddle.to_tensor(np.zeros(E, np.float32)))
+    out.sum().backward()
+    for t, name in ((qkv_w, "qkv_weight"), (qkv_b, "qkv_bias"),
+                    (lin_w, "linear_weight")):
+        assert t.grad is not None, name
+        assert np.abs(t.grad.numpy()).max() > 0, name
+
+
+def test_mmha_offset_from_src_mask():
+    """sequence_lengths omitted: offset derives from src_mask width."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.incubate.nn.functional import \
+        masked_multihead_attention
+    rng = np.random.RandomState(9)
+    B, H, D, S = 1, 2, 4, 6
+    hidden = H * D
+    cache = np.zeros((2, B, H, S, D), np.float32)
+    steps = [rng.randn(B, 3 * hidden).astype(np.float32)
+             for _ in range(3)]
+    c = paddle.to_tensor(cache)
+    outs = []
+    for t, xt in enumerate(steps):
+        mask = np.zeros((B, 1, 1, t + 1), np.float32)  # all-visible
+        o, c = masked_multihead_attention(
+            paddle.to_tensor(xt), cache_kv=c,
+            src_mask=paddle.to_tensor(mask))
+        outs.append(o.numpy())
+    qkv = np.stack(steps, 1).reshape(B, 3, 3, H, D)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ref = np.asarray(jax.nn.dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), is_causal=True,
+        scale=1.0 / np.sqrt(D)))
+    for t in range(3):
+        np.testing.assert_allclose(
+            outs[t][0], ref[0, t].reshape(hidden), rtol=1e-4, atol=1e-5)
+
+
+def test_mmha_rejects_ragged_lengths():
+    from paddle_tpu.incubate.nn.functional import \
+        masked_multihead_attention
+    cache = paddle.to_tensor(np.zeros((2, 2, 2, 4, 4), np.float32))
+    x = paddle.to_tensor(np.zeros((2, 3 * 8), np.float32))
+    with pytest.raises(ValueError, match="ragged"):
+        masked_multihead_attention(
+            x, cache_kv=cache,
+            sequence_lengths=paddle.to_tensor(
+                np.array([2, 1], np.int32)))
