@@ -14,6 +14,7 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
 from . import fleet
 from . import auto_parallel
 from . import checkpoint
+from . import rpc
 from . import sharding as sharding_mod
 from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,
                             Replicate, Shard, Strategy, dtensor_from_fn,

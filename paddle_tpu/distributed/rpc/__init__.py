@@ -1,0 +1,216 @@
+"""``paddle.distributed.rpc`` (reference: ``paddle/fluid/distributed/
+rpc/`` brpc-based RPC + ``python/paddle/distributed/rpc/`` API:
+init_rpc / rpc_sync / rpc_async / shutdown / get_worker_info).
+
+TPU-first: the heavy brpc stack serves the parameter-server world; for
+the heterogeneous-job coordination this API actually gets used for
+(control messages, small python payloads between workers), a socket
+server per worker plus the native TCPStore for address discovery is the
+whole requirement. Calls pickle (fn, args, kwargs), execute on the
+callee's worker thread pool, and return the pickled result — same
+at-most-once, raise-on-error semantics as the reference.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip, port):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return (f"WorkerInfo(name={self.name}, rank={self.rank}, "
+                f"ip={self.ip}, port={self.port})")
+
+
+_state: Dict[str, Any] = {}
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_msg(conn, payload: bytes):
+    conn.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(conn) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(conn, 8))
+    if n > (256 << 20):
+        raise ValueError(f"rpc payload too large: {n} bytes")
+    return _recv_exact(conn, n)
+
+
+def _serve_loop(server_sock, pool):
+    while True:
+        try:
+            conn, _ = server_sock.accept()
+        except OSError:
+            return  # closed during shutdown
+
+        def handle(c):
+            try:
+                with c:
+                    try:
+                        req = pickle.loads(_recv_msg(c))
+                        fn, args, kwargs = req
+                        result = ("ok", fn(*args, **kwargs))
+                    except Exception as exc:  # ship the callee error
+                        result = ("err", exc)
+                    try:
+                        payload = pickle.dumps(result)
+                    except Exception as exc:  # unpicklable result/error
+                        payload = pickle.dumps(
+                            ("err", RuntimeError(
+                                f"rpc result not picklable: {exc!r}")))
+                    _send_msg(c, payload)
+            except (ConnectionError, OSError):
+                pass
+
+        pool.submit(handle, conn)
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and register its endpoint with
+    the rendezvous store (rank 0 hosts it at master_endpoint)."""
+    import os
+    from ...native import TCPStore
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) \
+        if rank is None else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+    master = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:8813")
+    host, port = master.rsplit(":", 1)
+
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("0.0.0.0", 0))
+    server.listen(128)
+    my_port = server.getsockname()[1]
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") \
+        else socket.gethostbyname(socket.gethostname())
+
+    store = TCPStore(host=host, port=int(port), is_master=rank == 0,
+                     world_size=world_size, timeout=60.0)
+    store.set(f"rpc/worker/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+
+    # DISTINCT pools for inbound service vs outbound client calls:
+    # sharing one pool deadlocks when outbound calls saturate it and
+    # the inbound handlers (that would produce their responses) queue
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=8, thread_name_prefix="paddle-rpc-srv")
+    client_pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=8, thread_name_prefix="paddle-rpc-cli")
+    thread = threading.Thread(target=_serve_loop, args=(server, pool),
+                              daemon=True, name="paddle-rpc-server")
+    thread.start()
+
+    # wait for the full roster (init_rpc is a barrier in the reference)
+    infos = {}
+    for r in range(world_size):
+        infos[r] = pickle.loads(store.get(f"rpc/worker/{r}"))
+    _state.update(dict(name=name, rank=rank, world_size=world_size,
+                       store=store, server=server, pool=pool,
+                       client_pool=client_pool, infos=infos))
+    return infos[rank]
+
+
+def _resolve(to) -> WorkerInfo:
+    if not _state:
+        raise RuntimeError("call init_rpc first")
+    infos = _state["infos"]
+    if isinstance(to, int):
+        return infos[to]
+    for info in infos.values():
+        if info.name == to:
+            return info
+    raise KeyError(f"unknown rpc worker {to!r}; known: "
+                   f"{[i.name for i in infos.values()]}")
+
+
+def _call(info: WorkerInfo, fn, args, kwargs, timeout):
+    with socket.create_connection((info.ip, info.port),
+                                  timeout=timeout or None) as conn:
+        _send_msg(conn, pickle.dumps((fn, args or (), kwargs or {})))
+        status, payload = pickle.loads(_recv_msg(conn))
+    if status == "err":
+        raise payload
+    return payload
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Execute ``fn(*args, **kwargs)`` on worker ``to``; returns the
+    result (callee exceptions re-raise here)."""
+    return _call(_resolve(to), fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=180.0):
+    """Like rpc_sync but returns a Future (``.wait()`` parity)."""
+    info = _resolve(to)
+    fut = _state["client_pool"].submit(_call, info, fn, args,
+                                       kwargs, timeout)
+    fut.wait = fut.result  # paddle Future surface
+    return fut
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    if not _state:
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return _state["infos"][_state["rank"]]
+    return _resolve(name)
+
+
+def get_all_worker_infos():
+    if not _state:
+        raise RuntimeError("call init_rpc first")
+    return list(_state["infos"].values())
+
+
+def shutdown():
+    """Graceful two-phase barrier then stop (reference: shutdown
+    synchronizes). Workers announce, the master (who HOSTS the store)
+    waits for every announcement, publishes the all-clear, and only
+    then tears the store down — so no peer polls a dead store."""
+    if not _state:
+        return
+    import time
+    store = _state["store"]
+    rank = _state["rank"]
+    store.set(f"rpc/shutdown/{rank}", "1")
+    try:
+        if rank == 0:
+            for r in range(_state["world_size"]):
+                store.wait(f"rpc/shutdown/{r}", timeout=60)
+            store.set("rpc/shutdown/all", "1")
+            time.sleep(0.3)  # let peers read the all-clear
+        else:
+            store.wait("rpc/shutdown/all", timeout=60)
+    except Exception:
+        pass  # a vanished peer/store must not block teardown
+    _state["server"].close()
+    _state["pool"].shutdown(wait=False)
+    _state["client_pool"].shutdown(wait=False)
+    store.close()
+    _state.clear()

@@ -207,8 +207,11 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             keep = jax.random.bernoulli(drop_key,
                                         1.0 - attn_dropout_rate,
                                         probs.shape)
-            probs = jnp.where(keep,
-                              probs / (1.0 - attn_dropout_rate), 0.0)
+            if mode == "upscale_in_train":
+                probs = jnp.where(
+                    keep, probs / (1.0 - attn_dropout_rate), 0.0)
+            else:  # downscale_in_infer: unscaled mask at train time
+                probs = jnp.where(keep, probs, 0.0)
             ctx = jnp.einsum("bhlm,bmhd->blhd", probs, v)
         else:
             ctx = flash_attention_core(q, k, v, bias=mask_arr)
@@ -270,7 +273,16 @@ def masked_multihead_attention(x, cache_kv=None, bias=None,
         seq = as_jax(sequence_lengths)
         if seq.ndim:
             flat = seq.reshape(-1)
-            if not isinstance(flat, jax.core.Tracer):
+            if isinstance(flat, jax.core.Tracer):
+                if flat.shape[0] > 1:
+                    # cannot VERIFY equality under a trace; silently
+                    # using row 0's length would corrupt ragged batches
+                    raise InvalidArgumentError(
+                        "masked_multihead_attention: traced per-row "
+                        "sequence_lengths unsupported (equality can't "
+                        "be checked in-graph)",
+                        hint="pass a scalar current length under jit")
+            else:
                 import numpy as _np
                 vals = _np.asarray(flat)
                 if not (vals == vals[0]).all():
