@@ -16,6 +16,24 @@ from ..framework.core import Tensor, apply_jax, as_jax
 __all__ = ["recompute", "recompute_sequential", "RecomputeFunction"]
 
 
+def _remat_policy():
+    """Checkpoint policy knob (FLAGS_paddle_tpu_remat_policy /
+    PADDLE_TPU_REMAT_POLICY): "full" (save nothing — max HBM savings),
+    "dots" (save matmul outputs, recompute elementwise — the usual MFU
+    sweet spot when HBM allows), "nothing_saveable" alias of full."""
+    import os
+    from ..base_flags import get_flag, register_flag
+    register_flag("FLAGS_paddle_tpu_remat_policy", "full")
+    name = os.environ.get("PADDLE_TPU_REMAT_POLICY") or \
+        get_flag("FLAGS_paddle_tpu_remat_policy", "full")
+    cp = jax.checkpoint_policies
+    return {
+        "full": None, "nothing_saveable": None,
+        "dots": cp.dots_with_no_batch_dims_saveable,
+        "dots_saveable": cp.dots_saveable,
+    }.get(name, None)
+
+
 def recompute(function, *args, **kwargs):
     """``paddle.distributed.fleet.utils.recompute`` parity.
 
@@ -40,7 +58,9 @@ def recompute(function, *args, **kwargs):
         params = [p for p in function.parameters()
                   if not p.stop_gradient]
 
-    @jax.checkpoint
+    import functools as _ft
+
+    @_ft.partial(jax.checkpoint, policy=_remat_policy())
     def inner(*arrays):
         rebuilt = []
         for s in spec:
