@@ -17,6 +17,7 @@ __all__ = [
     "standard_normal", "randperm", "bernoulli", "multinomial", "poisson",
     "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
     "numel", "one_hot", "complex", "as_tensor", "Tensor",
+    "vander",
 ]
 
 
@@ -257,3 +258,12 @@ def complex(real, imag, name=None):
 
 def as_tensor(data, dtype=None, place=None):
     return to_tensor(data, dtype=dtype, place=place)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    """``paddle.vander`` — Vandermonde matrix."""
+    cols = as_jax(x).shape[0] if n is None else int(n)
+
+    def f(a):
+        return jnp.vander(a, N=cols, increasing=increasing)
+    return apply_jax("vander", f, x)

@@ -1,0 +1,67 @@
+"""Op-inventory generator (reference: the yaml op registry
+``paddle/phi/ops/yaml/ops.yaml`` fans out via codegen to four consumers
+— SURVEY §1 'key architectural fact').
+
+TPU-first: the single source of truth here is the live ``OPS`` registry
+(every public op behind the one ``apply_jax`` dispatch point). Its
+consumers are (1) the ``paddle.*`` namespace, (2) Tensor methods,
+(3) the static-graph recorder, and — produced by this module — (4) the
+generated inventory document ``docs/OPS.md``, which is the greppable
+parity ledger a yaml registry gives the reference.
+
+Run: ``python -m paddle_tpu.ops.gen_inventory``
+"""
+from __future__ import annotations
+
+import inspect
+import os
+
+
+def generate(out_path=None) -> str:
+    from . import OPS
+    from ..framework.core import Tensor
+
+    rows = []
+    for name in sorted(OPS):
+        fn = OPS[name]
+        mod = getattr(fn, "__module__", "") or ""
+        category = mod.rsplit(".", 1)[-1]
+        try:
+            sig = str(inspect.signature(fn))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        tensor_method = "yes" if name in Tensor.__dict__ or \
+            hasattr(Tensor, name) else ""
+        inplace = "yes" if hasattr(Tensor, name + "_") else ""
+        rows.append((name, category, sig, tensor_method, inplace))
+
+    lines = [
+        "# Op inventory (generated — do not edit)",
+        "",
+        "Regenerate with `python -m paddle_tpu.ops.gen_inventory`.",
+        "Single source of truth: the `OPS` registry behind `apply_jax`",
+        "(`framework/core.py`); consumers: `paddle.*` namespace, Tensor",
+        "methods, static-graph recording, and this ledger.",
+        "",
+        f"**{len(rows)} registered ops**",
+        "",
+        "| op | module | signature | Tensor method | in-place |",
+        "|---|---|---|---|---|",
+    ]
+    for name, cat, sig, tm, ip in rows:
+        sig = sig.replace("|", "\\|")
+        lines.append(f"| `{name}` | {cat} | `{sig}` | {tm} | {ip} |")
+    text = "\n".join(lines) + "\n"
+
+    if out_path is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        out_path = os.path.join(root, "docs", "OPS.md")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write(text)
+    return out_path
+
+
+if __name__ == "__main__":
+    print(generate())

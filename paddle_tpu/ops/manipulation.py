@@ -32,6 +32,7 @@ __all__ = [
     "unflatten", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
     "diagonal", "diagonal_scatter", "diag_embed", "fill_diagonal_",
     "shard_index", "tensordot", "rank", "shape",
+    "column_stack", "row_stack", "take", "block_diag", "combinations",
 ]
 
 
@@ -677,3 +678,69 @@ def cast(x, dtype):
 
 def tolist(x):
     return x.numpy().tolist()
+
+
+def column_stack(x, name=None):
+    """``paddle.column_stack``: stack 1-D as columns / concat 2-D."""
+    def f(*a):
+        return jnp.column_stack(a)
+    return apply_jax("column_stack", f, *x)
+
+
+def row_stack(x, name=None):
+    def f(*a):
+        return jnp.vstack(a)
+    return apply_jax("row_stack", f, *x)
+
+
+def take(x, index, mode="raise", name=None):
+    """``paddle.take``: flat-index gather with raise/clip/wrap modes.
+    mode='raise' bounds-checks on the host in eager mode (paddle
+    parity); under a trace it degrades to clip (jit cannot raise)."""
+    if mode == "raise":
+        import jax as _jax
+        idx_arr = as_jax(index)
+        if not isinstance(idx_arr, _jax.core.Tracer):
+            import numpy as _np
+            n = int(np.prod(as_jax(x).shape))
+            vals = _np.asarray(idx_arr).reshape(-1)
+            bad = vals[(vals < -n) | (vals >= n)]
+            if bad.size:
+                from ..framework.errors import OutOfRangeError
+                raise OutOfRangeError(
+                    f"take: index {int(bad[0])} out of range for "
+                    f"{n} elements")
+
+    def f(a, idx):
+        flat = a.reshape(-1)
+        i = idx.astype(jnp.int32)
+        n = flat.shape[0]
+        if mode == "wrap":
+            i = jnp.where(i < 0, i + n, i) % n
+        else:
+            i = jnp.clip(jnp.where(i < 0, i + n, i), 0, n - 1)
+        return flat[i]
+    return apply_jax("take", f, x, index)
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+
+    def f(*a):
+        return jsl.block_diag(*a)
+    return apply_jax("block_diag", f, *inputs)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """``paddle.combinations``: index pairs are static (host side)."""
+    import itertools as it
+    n = as_jax(x).shape[0]
+    pick = it.combinations_with_replacement if with_replacement \
+        else it.combinations
+    idx = np.asarray(list(pick(range(n), r)), np.int32)
+    if idx.size == 0:
+        idx = idx.reshape(0, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+    return apply_jax("combinations", f, x)

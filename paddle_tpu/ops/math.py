@@ -30,6 +30,7 @@ __all__ = [
     "angle", "conj", "real", "imag", "digamma", "lgamma", "multigammaln",
     "i0", "i0e", "i1", "i1e", "polygamma", "hypot", "ldexp", "copysign",
     "nextafter", "count_nonzero", "broadcast_shape", "log_normal",
+    "trapezoid", "cumulative_trapezoid", "renorm", "signbit", "sinc",
 ]
 
 
@@ -412,3 +413,71 @@ def broadcast_shape(x_shape, y_shape):
 def log_normal(mean=1.0, std=2.0, shape=None, name=None):
     from .creation import normal
     return exp(normal(mean, std, shape))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """``paddle.trapezoid`` — trapezoidal rule integration."""
+    if x is not None and dx is not None:
+        from ..framework.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "trapezoid: pass x or dx, not both (paddle raises too)")
+    if x is not None:
+        return apply_jax(
+            "trapezoid",
+            lambda ya, xa: jnp.trapezoid(ya, xa, axis=axis), y, x)
+    d = 1.0 if dx is None else float(dx)
+    return apply_jax(
+        "trapezoid", lambda ya: jnp.trapezoid(ya, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None and dx is not None:
+        from ..framework.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "cumulative_trapezoid: pass x or dx, not both")
+
+    def f(ya, *maybe_x):
+        xa = maybe_x[0] if maybe_x else None
+        sl1 = [slice(None)] * ya.ndim
+        sl2 = [slice(None)] * ya.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (ya[tuple(sl1)] + ya[tuple(sl2)]) / 2.0
+        if xa is not None:
+            if xa.ndim == 1:  # 1-D sample points broadcast along axis
+                d = jnp.diff(xa)
+                shape = [1] * ya.ndim
+                shape[axis] = d.shape[0]
+                d = d.reshape(shape)
+            else:
+                d = xa[tuple(sl1)] - xa[tuple(sl2)]
+        else:
+            d = 1.0 if dx is None else float(dx)
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is not None:
+        return apply_jax("cumulative_trapezoid", f, y, x)
+    return apply_jax("cumulative_trapezoid", f, y)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """``paddle.renorm``: scale each slice along ``axis`` whose p-norm
+    exceeds max_norm down to max_norm."""
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply_jax("renorm", f, x)
+
+
+def signbit(x, name=None):
+    from ._dispatch import nodiff
+    return nodiff(jnp.signbit, x)
+
+
+def sinc(x, name=None):
+    return apply_jax("sinc", jnp.sinc, x)
+

@@ -262,3 +262,84 @@ def test_pad():
     x = RNG.rand(2, 3).astype(np.float32)
     out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 1, 2, 2])
     assert out.shape == [2 + 2, 3 + 4]  # full-rank [d0_l,d0_r,d1_l,d1_r]
+
+
+def test_round2_op_additions():
+    """Oracle checks for trapezoid/renorm/take/vander/etc. (round-2
+    op-surface widening)."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 4).astype(np.float32)
+    v = np.array([1.0, 2.0, 3.0], np.float32)
+
+    np.testing.assert_allclose(
+        paddle.trapezoid(paddle.to_tensor(v)).numpy(),
+        np.trapezoid(v) if hasattr(np, "trapezoid") else np.trapz(v))
+    np.testing.assert_allclose(
+        paddle.vander(paddle.to_tensor(v)).numpy(), np.vander(v))
+    np.testing.assert_allclose(
+        paddle.take(paddle.to_tensor(x),
+                    paddle.to_tensor(np.array([0, 5, -1]))).numpy(),
+        np.take(x, [0, 5, -1]))
+    with pytest.raises(IndexError):
+        paddle.take(paddle.to_tensor(x),
+                    paddle.to_tensor(np.array([100])))
+    with pytest.raises(ValueError):
+        paddle.trapezoid(paddle.to_tensor(v),
+                         x=paddle.to_tensor(v), dx=0.5)
+    # 1-D x against n-D y (paddle supports; broadcast along axis)
+    y2 = rng.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(paddle.to_tensor(y2),
+                                    x=paddle.to_tensor(v)).numpy(),
+        np.stack([(y2[:, 1:] + y2[:, :-1]) / 2 * np.diff(v)],
+                 axis=0)[0].cumsum(axis=1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.column_stack([paddle.to_tensor(v),
+                             paddle.to_tensor(v)]).numpy(),
+        np.column_stack([v, v]))
+    np.testing.assert_allclose(
+        paddle.row_stack([paddle.to_tensor(v),
+                          paddle.to_tensor(v)]).numpy(),
+        np.vstack([v, v]))
+    np.testing.assert_allclose(
+        paddle.sinc(paddle.to_tensor(v)).numpy(), np.sinc(v),
+        rtol=1e-6)
+    np.testing.assert_array_equal(
+        paddle.signbit(paddle.to_tensor(np.array([-2., 3.]))).numpy(),
+        [True, False])
+
+    # renorm: rows of ones*10 scaled to norm 1
+    out = paddle.renorm(paddle.to_tensor(np.full((2, 4), 10.0,
+                                                 np.float32)),
+                        2.0, 0, 1.0).numpy()
+    np.testing.assert_allclose(np.linalg.norm(out, axis=1), [1.0, 1.0],
+                               rtol=1e-5)
+    # block_diag
+    a = np.eye(2, dtype=np.float32)
+    b = np.full((1, 3), 2.0, np.float32)
+    got = paddle.block_diag([paddle.to_tensor(a),
+                             paddle.to_tensor(b)]).numpy()
+    expect = np.zeros((3, 5), np.float32)
+    expect[:2, :2] = a
+    expect[2:, 2:] = b
+    np.testing.assert_allclose(got, expect)
+    # combinations
+    np.testing.assert_array_equal(
+        paddle.combinations(paddle.to_tensor(v)).numpy(),
+        [[1, 2], [1, 3], [2, 3]])
+    # cumulative_trapezoid vs manual
+    np.testing.assert_allclose(
+        paddle.cumulative_trapezoid(paddle.to_tensor(v)).numpy(),
+        [1.5, 4.0])
+
+
+def test_op_inventory_generates_and_is_current(tmp_path):
+    """The generated ledger tracks the live registry (codegen-fanout
+    consumer #4 — SURVEY §1)."""
+    import os
+    from paddle_tpu.ops.gen_inventory import generate
+    out = generate(str(tmp_path / "OPS.md"))
+    text = open(out).read()
+    assert "registered ops" in text
+    for op in ("matmul", "trapezoid", "take", "reshape"):
+        assert f"| `{op}` |" in text or f"`{op}`" in text, op
