@@ -7,7 +7,7 @@ from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,
                                Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
                                LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
                                ReLU, ReLU6, RReLU, Sigmoid, Silu, Softmax,
-                               Softplus, Softshrink, Softsign, Swish, Tanh,
+                               Softmax2D, Softplus, Softshrink, Softsign, Swish, Tanh,
                                Tanhshrink, ThresholdedReLU)
 from .layer.common import (AlphaDropout, Bilinear, ChannelShuffle,
                            CosineSimilarity, Dropout, Dropout2D, Dropout3D,
@@ -21,19 +21,21 @@ from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                          Conv3D, Conv3DTranspose)
 from .layer.layers import Layer
 from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
-                         CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss,
+                         CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
+                         HingeEmbeddingLoss, KLDivLoss,
                          L1Loss, MarginRankingLoss, MSELoss,
                          MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss,
                          SmoothL1Loss, SoftMarginLoss, TripletMarginLoss)
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
                          GroupNorm, InstanceNorm1D, InstanceNorm2D,
                          InstanceNorm3D, LayerNorm, LocalResponseNorm,
+                         SpectralNorm,
                          RMSNorm, SyncBatchNorm)
 from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                             AdaptiveAvgPool3D, AdaptiveMaxPool1D,
                             AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
-                            MaxPool3D)
+                            MaxPool3D, MaxUnPool2D)
 from .layer.rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,
                         RNNCellBase, SimpleRNN, SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,

@@ -20,6 +20,7 @@ __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
     "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "unfold", "fold",
+    "max_unpool2d",
 ]
 
 
@@ -246,30 +247,70 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  "avg_pool3d", ceil_mode, exclusive)
 
 
+def _norm2(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _max_pool2d_with_mask(x, kernel_size, stride, padding):
+    """Real argmax mask: flat H*W index of each window max (paddle's
+    return_mask contract, consumed by max_unpool2d)."""
+    kh, kw = _norm2(kernel_size)
+    sh, sw = _norm2(stride if stride is not None else kernel_size)
+    ph, pw = _norm2(padding)
+    B, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=-jnp.inf)
+    OH = (H + 2 * ph - kh) // sh + 1
+    OW = (W + 2 * pw - kw) // sw + 1
+    ri = (jnp.arange(OH) * sh)[:, None] + jnp.arange(kh)[None, :]
+    ci = (jnp.arange(OW) * sw)[:, None] + jnp.arange(kw)[None, :]
+    # [B, C, OH, kh, OW, kw] -> [B, C, OH, OW, kh*kw]
+    patches = xp[:, :, ri[:, :, None, None], ci[None, None, :, :]]
+    patches = patches.transpose(0, 1, 2, 4, 3, 5).reshape(
+        B, C, OH, OW, kh * kw)
+    am = jnp.argmax(patches, axis=-1)
+    vals = jnp.max(patches, axis=-1)
+    r = (jnp.arange(OH) * sh)[None, None, :, None] + am // kw - ph
+    c = (jnp.arange(OW) * sw)[None, None, None, :] + am % kw - pw
+    mask = (r * W + c).astype(jnp.int32)
+    return vals, mask
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
-    out = _pool(x, kernel_size, stride, padding, 1, "max", "NCL",
-                "max_pool1d", ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool1d return_mask not implemented (2d has it)")
+    return _pool(x, kernel_size, stride, padding, 1, "max", "NCL",
+                 "max_pool1d", ceil_mode)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 2, "max", data_format,
-                "max_pool2d", ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if not return_mask:
+        return _pool(x, kernel_size, stride, padding, 2, "max",
+                     data_format, "max_pool2d", ceil_mode)
+    if data_format != "NCHW" or ceil_mode:
+        raise NotImplementedError(
+            "max_pool2d return_mask supports NCHW, ceil_mode=False")
+
+    def f(a):
+        return _max_pool2d_with_mask(a, kernel_size, stride, padding)
+    vals, mask = apply_jax("max_pool2d_mask", f, x, n_outputs=2)
+    return vals, mask
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, "max", data_format,
                 "max_pool3d", ceil_mode)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "max_pool3d return_mask not implemented (2d has it)")
+    return out
 
 
-def _pool_mask(x, out):
-    from ...framework.core import _wrap_out
-    return _wrap_out(jnp.zeros(as_jax(out).shape, np.int32))
+
 
 
 def _adaptive_pool(x, output_size, nsp, op, op_name):
@@ -314,18 +355,27 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool(x, output_size, 1, "max", "adaptive_max_pool1d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool1d return_mask not implemented")
+    return _adaptive_pool(x, output_size, 1, "max",
+                          "adaptive_max_pool1d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool(x, output_size, 2, "max", "adaptive_max_pool2d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d return_mask not implemented")
+    return _adaptive_pool(x, output_size, 2, "max",
+                          "adaptive_max_pool2d")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    out = _adaptive_pool(x, output_size, 3, "max", "adaptive_max_pool3d")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool3d return_mask not implemented")
+    return _adaptive_pool(x, output_size, 3, "max",
+                          "adaptive_max_pool3d")
 
 
 # ---------------------------------------------------------------------------
@@ -377,3 +427,29 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
         return out[:, :, pd[0][0]:pd[0][0] + os[0],
                    pd[1][0]:pd[1][0] + os[1]]
     return apply_jax("fold", f, x)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """``paddle.nn.functional.max_unpool2d``: scatter pooled values back
+    to the positions recorded in the return_mask indices."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW only")
+    kh, kw = _norm2(kernel_size)
+    sh, sw = _norm2(stride if stride is not None else kernel_size)
+    ph, pw = _norm2(padding)
+
+    def f(a, idx):
+        B, C, OH, OW = a.shape
+        if output_size is not None:
+            H, W = output_size[-2], output_size[-1]
+        else:
+            H = (OH - 1) * sh - 2 * ph + kh
+            W = (OW - 1) * sw - 2 * pw + kw
+        flat = jnp.zeros((B, C, H * W), a.dtype)
+        out = flat.at[
+            jnp.arange(B)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(B, C, OH * OW)].set(a.reshape(B, C, OH * OW))
+        return out.reshape(B, C, H, W)
+    return apply_jax("max_unpool2d", f, x, indices)

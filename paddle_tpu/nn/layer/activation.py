@@ -82,3 +82,10 @@ class RReLU(Layer):
 
     def forward(self, x):
         return F.rrelu(x, self.lower, self.upper, self.training)
+
+
+class Softmax2D(Layer):
+    """``paddle.nn.Softmax2D``: softmax over the channel dim of NCHW."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)

@@ -1,7 +1,12 @@
 """Loss layers (``python/paddle/nn/layer/loss.py`` parity)."""
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+import numpy as np
+
 from .. import functional as F
+from ...framework.core import apply_jax
 from .layers import Layer
 
 
@@ -173,3 +178,107 @@ class PoissonNLLLoss(Layer):
 
     def forward(self, input, label):
         return F.poisson_nll_loss(input, label, *self.args)
+
+
+class GaussianNLLLoss(Layer):
+    """``paddle.nn.GaussianNLLLoss``: 0.5*(log(var) + (x-mu)^2/var)."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        def f(mu, x, var):
+            var = jnp.maximum(var, self.epsilon)
+            loss = 0.5 * (jnp.log(var) + (x - mu) ** 2 / var)
+            if self.full:
+                loss = loss + 0.5 * jnp.log(
+                    jnp.asarray(2.0 * np.pi, loss.dtype))
+            if self.reduction == "mean":
+                return jnp.mean(loss)
+            if self.reduction == "sum":
+                return jnp.sum(loss)
+            return loss
+        return apply_jax("gaussian_nll", f, input, label, variance)
+
+
+class CTCLoss(Layer):
+    """``paddle.nn.CTCLoss`` (reference wraps warpctc —
+    ``third_party/warpctc``). TPU-first: the standard log-domain
+    alpha recursion as a ``lax.scan`` over time — static shapes,
+    per-sample length masking, fully differentiable through XLA."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, logits, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        """logits: [T, B, C] (unnormalized); labels: [B, S];
+        lengths: [B]."""
+        blank = self.blank
+        reduction = self.reduction
+
+        def f(lg, lb, il, ll):
+            T, B, C = lg.shape
+            S = lb.shape[1]
+            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            # extended label row: [blank, l1, blank, l2, ..., blank]
+            ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+            ext = ext.at[:, 1::2].set(lb.astype(jnp.int32))
+            # skip transition s-2 -> s allowed when ext[s] is a label
+            # and differs from ext[s-2]
+            prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)),
+                            constant_values=-1)
+            can_skip = (ext != blank) & (ext != prev2)
+            NEG = jnp.float32(-1e30)
+
+            emit0 = jnp.take_along_axis(logp[0], ext, axis=1)
+            alpha0 = jnp.where(
+                jnp.arange(2 * S + 1)[None, :] < 2, emit0, NEG)
+            # sequences shorter than S: positions beyond 2*ll are dead
+            pos = jnp.arange(2 * S + 1)[None, :]
+            live = pos <= 2 * ll[:, None]
+            alpha0 = jnp.where(live, alpha0, NEG)
+
+            def shift(a, k):
+                return jnp.pad(a, ((0, 0), (k, 0)),
+                               constant_values=NEG)[:, :a.shape[1]]
+
+            def step(alpha, t):
+                stay = alpha
+                one = shift(alpha, 1)
+                two = jnp.where(can_skip, shift(alpha, 2), NEG)
+                merged = jnp.logaddexp(jnp.logaddexp(stay, one), two)
+                emit = jnp.take_along_axis(logp[t], ext, axis=1)
+                new = jnp.where(live, merged + emit, NEG)
+                # freeze once past this sample's input length
+                new = jnp.where((t < il[:, None]), new, alpha)
+                return new, None
+
+            alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+            # P(labels) = alpha[2*ll] + alpha[2*ll - 1]
+            last = jnp.take_along_axis(alpha, (2 * ll[:, None])
+                                       .astype(jnp.int32), axis=1)[:, 0]
+            last2 = jnp.take_along_axis(
+                alpha, jnp.maximum(2 * ll[:, None] - 1, 0)
+                .astype(jnp.int32), axis=1)[:, 0]
+            # empty target: only the all-blank path exists — no second
+            # terminal state (double-counting alpha[0] adds log 2)
+            last2 = jnp.where(ll > 0, last2, NEG)
+            nll = -jnp.logaddexp(last, last2)
+            if norm_by_times:
+                nll = nll / jnp.maximum(il.astype(jnp.float32), 1.0)
+            if reduction == "mean":
+                # paddle: mean over batch of loss / label_length
+                return jnp.mean(
+                    nll / jnp.maximum(ll.astype(jnp.float32), 1.0))
+            if reduction == "sum":
+                return jnp.sum(nll)
+            return nll
+        return apply_jax("ctc_loss", f, logits, labels, input_lengths,
+                         label_lengths)

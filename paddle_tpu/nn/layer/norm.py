@@ -1,9 +1,11 @@
 """Norm layers (``python/paddle/nn/layer/norm.py`` parity)."""
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
-from ...framework.core import Tensor
+from ...framework.core import Tensor, apply_jax
 from .. import functional as F
 from ..initializer import Constant
 from .layers import Layer
@@ -205,8 +207,59 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """``paddle.nn.SpectralNorm``: power-iteration estimate of the
+    largest singular value; forward returns weight / sigma."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
                  dtype="float32"):
         super().__init__()
-        raise NotImplementedError(
-            "SpectralNorm: use paddle_tpu.nn.utils.spectral_norm wrapper")
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        u = rng.randn(h).astype(np.float32)
+        v = rng.randn(w).astype(np.float32)
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=lambda s, d: u / max(
+                float(np.linalg.norm(u)), 1e-12))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=lambda s, d: v / max(
+                float(np.linalg.norm(v)), 1e-12))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        dim = self._dim
+        iters = self._power_iters
+        eps = self._eps
+
+        def f(w_a, u_a, v_a):
+            mat = jnp.moveaxis(w_a, dim, 0).reshape(w_a.shape[dim], -1)
+
+            def it(carry, _):
+                u, v = carry
+                v = mat.T @ u
+                v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+                u = mat @ v
+                u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+                return (u, v), None
+
+            (u, v), _ = jax.lax.scan(it, (u_a, v_a),
+                                     jnp.arange(max(iters, 1)))
+            sigma = u @ mat @ v
+            return w_a / jnp.maximum(sigma, eps), u, v
+
+        out, u_new, v_new = apply_jax(
+            "spectral_norm", f, weight, self.weight_u, self.weight_v,
+            n_outputs=3)
+        from ...framework.core import as_jax as _aj
+        import jax as _jax
+        u_arr = _aj(u_new)
+        if not isinstance(u_arr, _jax.core.Tracer):
+            # persist power-iteration state (paddle semantics: the
+            # estimate refines across calls, so power_iters=1 converges)
+            self.weight_u._data = u_arr
+            self.weight_v._data = _aj(v_new)
+        return out

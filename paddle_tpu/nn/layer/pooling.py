@@ -86,3 +86,22 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size, **self.kw)
+
+
+class MaxUnPool2D(Layer):
+    """``paddle.nn.MaxUnPool2D``: inverse of MaxPool2D(return_mask=True)
+    — scatters pooled values back to the recorded argmax positions."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, self.output_size,
+                              self.data_format)

@@ -277,3 +277,105 @@ def test_layernorm_bias_only():
     x = paddle.to_tensor(RNG.rand(2, 4).astype(np.float32))
     y = ln(x).numpy()
     np.testing.assert_allclose(y.mean(-1), 5.0, atol=1e-4)
+
+
+def test_max_pool2d_mask_and_unpool_roundtrip():
+    """return_mask gives real argmax indices; MaxUnPool2D inverts."""
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    out, mask = nn.functional.max_pool2d(x, 2, stride=2,
+                                         return_mask=True)
+    assert out.shape == [2, 3, 4, 4] and mask.shape == [2, 3, 4, 4]
+    xa = x.numpy()
+    # mask flat index must point at the max within each 2x2 window
+    for b, c in ((0, 0), (1, 2)):
+        flat = xa[b, c].reshape(-1)
+        np.testing.assert_allclose(flat[mask.numpy()[b, c]],
+                                   out.numpy()[b, c])
+    unpool = nn.MaxUnPool2D(2, stride=2)
+    restored = unpool(out, mask)
+    assert restored.shape == [2, 3, 8, 8]
+    # restored has the max values at their original positions, 0 else
+    nz = restored.numpy() != 0
+    assert nz.sum() == 2 * 3 * 16
+    np.testing.assert_allclose(restored.numpy().max(axis=(2, 3)),
+                               out.numpy().max(axis=(2, 3)))
+
+
+def test_ctc_loss_matches_torch_reference():
+    """CTC alpha recursion vs torch.nn.functional.ctc_loss (cpu)."""
+    import torch
+    rng = np.random.RandomState(1)
+    T, B, C, S = 12, 3, 6, 4
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, (B, S)).astype(np.int32)
+    in_lens = np.array([12, 10, 8], np.int64)
+    lb_lens = np.array([4, 3, 2], np.int64)
+
+    loss = nn.CTCLoss(blank=0, reduction="none")(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(in_lens), paddle.to_tensor(lb_lens))
+
+    t_logp = torch.nn.functional.log_softmax(
+        torch.tensor(logits), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        t_logp, torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_lens), torch.tensor(lb_lens), blank=0,
+        reduction="none")
+    np.testing.assert_allclose(loss.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_gaussian_nll_and_softmax2d():
+    rng = np.random.RandomState(2)
+    mu = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(4, 5).astype(np.float32))
+    var = paddle.to_tensor(np.abs(rng.randn(4, 5)).astype(np.float32)
+                           + 0.1)
+    loss = nn.GaussianNLLLoss()(mu, x, var)
+    expect = 0.5 * (np.log(var.numpy())
+                    + (x.numpy() - mu.numpy()) ** 2 / var.numpy())
+    np.testing.assert_allclose(float(loss.numpy()), expect.mean(),
+                               rtol=1e-5)
+    sm = nn.Softmax2D()(paddle.to_tensor(
+        rng.randn(2, 3, 4, 4).astype(np.float32)))
+    np.testing.assert_allclose(sm.numpy().sum(axis=1),
+                               np.ones((2, 4, 4)), rtol=1e-5)
+
+
+def test_spectral_norm_normalizes():
+    rng = np.random.RandomState(3)
+    w = paddle.to_tensor((rng.randn(6, 8) * 3).astype(np.float32))
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+    out = sn(w)
+    sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_ctc_empty_target_matches_torch():
+    import torch
+    rng = np.random.RandomState(5)
+    T, B, C = 4, 1, 5
+    logits = rng.randn(T, B, C).astype(np.float32)
+    labels = np.zeros((B, 2), np.int32)
+    loss = nn.CTCLoss(blank=0, reduction="none")(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(np.array([T], np.int64)),
+        paddle.to_tensor(np.array([0], np.int64)))
+    ref = torch.nn.functional.ctc_loss(
+        torch.nn.functional.log_softmax(torch.tensor(logits), dim=-1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor([T]), torch.tensor([0]), blank=0,
+        reduction="none")
+    np.testing.assert_allclose(loss.numpy(), ref.numpy(), rtol=1e-4)
+
+
+def test_spectral_norm_state_persists():
+    """power_iters=1 must converge ACROSS calls (u/v persist)."""
+    rng = np.random.RandomState(6)
+    w = paddle.to_tensor((rng.randn(6, 8) * 3).astype(np.float32))
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=1)
+    for _ in range(30):
+        out = sn(w)
+    sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
