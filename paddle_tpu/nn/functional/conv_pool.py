@@ -247,16 +247,16 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  "avg_pool3d", ceil_mode, exclusive)
 
 
-def _norm2(v):
-    return (v, v) if isinstance(v, int) else tuple(v)
+def _tuplify2(v):
+    return tuple(_tuplify(v, 2))
 
 
 def _max_pool2d_with_mask(x, kernel_size, stride, padding):
     """Real argmax mask: flat H*W index of each window max (paddle's
     return_mask contract, consumed by max_unpool2d)."""
-    kh, kw = _norm2(kernel_size)
-    sh, sw = _norm2(stride if stride is not None else kernel_size)
-    ph, pw = _norm2(padding)
+    kh, kw = _tuplify2(kernel_size)
+    sh, sw = _tuplify2(stride if stride is not None else kernel_size)
+    ph, pw = _tuplify2(padding)
     B, C, H, W = x.shape
     xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
                  constant_values=-jnp.inf)
@@ -435,9 +435,9 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
     to the positions recorded in the return_mask indices."""
     if data_format != "NCHW":
         raise NotImplementedError("max_unpool2d supports NCHW only")
-    kh, kw = _norm2(kernel_size)
-    sh, sw = _norm2(stride if stride is not None else kernel_size)
-    ph, pw = _norm2(padding)
+    kh, kw = _tuplify2(kernel_size)
+    sh, sw = _tuplify2(stride if stride is not None else kernel_size)
+    ph, pw = _tuplify2(padding)
 
     def f(a, idx):
         B, C, OH, OW = a.shape

@@ -248,6 +248,10 @@ class SpectralNorm(Layer):
 
             (u, v), _ = jax.lax.scan(it, (u_a, v_a),
                                      jnp.arange(max(iters, 1)))
+            # u/v are constants for the gradient (reference semantics:
+            # detached buffers) — only sigma = u^T W v differentiates
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
             sigma = u @ mat @ v
             return w_a / jnp.maximum(sigma, eps), u, v
 

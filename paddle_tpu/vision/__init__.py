@@ -1,3 +1,4 @@
 """``paddle.vision`` namespace."""
 from . import datasets, models, transforms
 from .models import LeNet, ResNet, resnet18, resnet34, resnet50
+from . import ops  # noqa: F401

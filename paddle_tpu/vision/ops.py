@@ -1,0 +1,296 @@
+"""``paddle.vision.ops`` (reference ``python/paddle/vision/ops.py`` —
+detection primitives backed by CUDA kernels there: roi_align, nms,
+box coders, deform_conv2d).
+
+TPU-first: static-shape formulations — NMS as the O(N^2) score-ordered
+suppression matrix (XLA-friendly, no data-dependent loops), roi_align
+as bilinear gather/average (MXU-irrelevant, but fully vectorized),
+distribute_fpn_proposals/box utilities as pure jnp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+
+__all__ = ["nms", "roi_align", "box_coder", "yolo_box",
+           "distribute_fpn_proposals", "deform_conv2d", "box_area",
+           "box_iou"]
+
+
+def box_area(boxes):
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply_jax("box_area", f, boxes)
+
+
+def _iou_matrix(b):
+    x1 = jnp.maximum(b[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(b[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(b[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(b[:, None, 3], b[None, :, 3])
+    inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def box_iou(boxes1, boxes2):
+    def f(a, b):
+        x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+        y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+        x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+        y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        a1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        a2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
+                                   1e-9)
+    return apply_jax("box_iou", f, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """``paddle.vision.ops.nms``: returns kept indices sorted by score.
+    Static-shape formulation: suppression decided from the upper-
+    triangular IoU matrix of the score-sorted boxes (a box survives iff
+    no higher-scored SURVIVING box overlaps it > threshold), computed
+    with a lax.scan over rows — O(N^2) like the reference kernel, no
+    dynamic shapes until the final (host-side) index extraction."""
+    b_arr = as_jax(boxes)
+    n = b_arr.shape[0]
+    s_arr = as_jax(scores) if scores is not None else \
+        jnp.arange(n, 0, -1).astype(jnp.float32)
+
+    def f(b, s):
+        order = jnp.argsort(-s)
+        bs = b[order]
+        iou = _iou_matrix(bs)
+        if category_idxs is not None:
+            cats = as_jax(category_idxs)[order]
+            same = cats[:, None] == cats[None, :]
+            iou = jnp.where(same, iou, 0.0)  # suppress within class only
+
+        def row(keep, i):
+            # i survives iff no kept j<i has iou > thr
+            over = (iou[i] > iou_threshold) & keep & \
+                (jnp.arange(n) < i)
+            k_i = jnp.logical_not(jnp.any(over))
+            return keep.at[i].set(k_i), None
+
+        keep0 = jnp.zeros(n, bool).at[0].set(True) if n else \
+            jnp.zeros(0, bool)
+        keep, _ = jax.lax.scan(row, keep0, jnp.arange(1, n)) \
+            if n > 1 else (keep0, None)
+        return keep, order
+
+    keep, order = f(b_arr, s_arr)
+    kept = np.asarray(order)[np.asarray(keep)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return _wrap_out(jnp.asarray(kept.astype(np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """``paddle.vision.ops.roi_align``: bilinear-sampled average pooling
+    of each RoI. x: [N, C, H, W]; boxes: [R, 4] (x1,y1,x2,y2);
+    boxes_num: [N] rois per image."""
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    nums = np.asarray(as_jax(boxes_num)).astype(np.int64)
+    img_of_roi = np.repeat(np.arange(len(nums)), nums)
+    if sampling_ratio > 0:
+        ratio = int(sampling_ratio)
+    else:
+        # paddle's adaptive rule is per-roi ceil(roi_size/output); a
+        # static shape needs one value — use the LARGEST roi's need so
+        # no roi is under-sampled (denser sampling only adds accuracy)
+        ba_np = np.asarray(as_jax(boxes))
+        if ba_np.size:
+            max_h = float((ba_np[:, 3] - ba_np[:, 1]).max()) \
+                * spatial_scale
+            max_w = float((ba_np[:, 2] - ba_np[:, 0]).max()) \
+                * spatial_scale
+            ratio = max(1, int(np.ceil(max(max_h / oh, max_w / ow))))
+            ratio = min(ratio, 8)  # bound the static cost
+        else:
+            ratio = 1
+
+    def f(xa, ba):
+        off = 0.5 if aligned else 0.0
+        b = ba * spatial_scale - off
+        w = jnp.maximum(b[:, 2] - b[:, 0], 1e-6)
+        h = jnp.maximum(b[:, 3] - b[:, 1], 1e-6)
+        # sample grid: oh*ratio x ow*ratio points per roi
+        gy = (jnp.arange(oh * ratio) + 0.5) / (oh * ratio)
+        gx = (jnp.arange(ow * ratio) + 0.5) / (ow * ratio)
+        ys = b[:, 1:2] + gy[None, :] * h[:, None]     # [R, ohr]
+        xs = b[:, 0:1] + gx[None, :] * w[:, None]     # [R, owr]
+        H, W = xa.shape[2], xa.shape[3]
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [ohr], xx [owr] -> [C, ohr, owr]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1).astype(jnp.int32)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1).astype(jnp.int32)
+            y1 = jnp.clip(y0 + 1, 0, H - 1)
+            x1 = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1]
+            v10 = img[:, y1][:, :, x0]
+            v11 = img[:, y1][:, :, x1]
+            return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None]
+                    + v01 * (1 - wy)[None, :, None] * wx[None, None]
+                    + v10 * wy[None, :, None] * (1 - wx)[None, None]
+                    + v11 * wy[None, :, None] * wx[None, None])
+
+        imgs = xa[jnp.asarray(img_of_roi)]  # [R, C, H, W]
+        sampled = jax.vmap(bilinear)(imgs, ys, xs)  # [R, C, ohr, owr]
+        R, C = sampled.shape[0], sampled.shape[1]
+        pooled = sampled.reshape(R, C, oh, ratio, ow, ratio)\
+            .mean(axis=(3, 5))
+        return pooled
+    return apply_jax("roi_align", f, x, boxes)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """encode/decode boxes against priors (SSD-style). prior_box_var
+    may be a [N, 4] tensor or a 4-element list (per-coord variance);
+    decode accepts [N, M, 4] targets, priors broadcasting along
+    ``axis`` (0: prior per row, 1: prior per column)."""
+    if isinstance(prior_box_var, (list, tuple)):
+        prior_box_var = Tensor(np.asarray(prior_box_var, np.float32)
+                               [None, :])
+
+    def f(pb, pv, tb):
+        pv = jnp.broadcast_to(pv, pb.shape)
+        add = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + add
+        ph = pb[:, 3] - pb[:, 1] + add
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + add
+            th = tb[:, 3] - tb[:, 1] + add
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            return jnp.stack([
+                (tx - px) / pw / pv[:, 0],
+                (ty - py) / ph / pv[:, 1],
+                jnp.log(tw / pw) / pv[:, 2],
+                jnp.log(th / ph) / pv[:, 3]], axis=1)
+        # decode: tb [N, 4] or [N, M, 4]; priors along `axis`
+        if tb.ndim == 3:
+            # expand priors to broadcast against [N, M, 4]
+            ex = (slice(None), None) if axis == 0 else (None, slice(None))
+            pw_, ph_ = pw[ex], ph[ex]
+            px_, py_ = px[ex], py[ex]
+            pv_ = pv[ex + (slice(None),)]
+        else:
+            pw_, ph_, px_, py_ = pw, ph, px, py
+            pv_ = pv
+        dx = tb[..., 0] * pv_[..., 0] * pw_ + px_
+        dy = tb[..., 1] * pv_[..., 1] * ph_ + py_
+        dw = jnp.exp(tb[..., 2] * pv_[..., 2]) * pw_
+        dh = jnp.exp(tb[..., 3] * pv_[..., 3]) * ph_
+        sub = 0 if box_normalized else 1
+        return jnp.stack([dx - dw * 0.5, dy - dh * 0.5,
+                          dx + dw * 0.5 - sub, dy + dh * 0.5 - sub],
+                         axis=-1)
+    return apply_jax("box_coder", f, prior_box, prior_box_var,
+                     target_box)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    raise NotImplementedError(
+        "yolo_box: YOLO-specific decode postprocessing is out of scope "
+        "for the core framework (compose from nms/box_coder)")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign each RoI to an FPN level by its scale."""
+    rois = as_jax(fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(jnp.clip(w * h, 1e-9))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl_np = np.asarray(lvl)
+    outs, idxs = [], []
+    for l in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl_np == l)[0]
+        outs.append(_wrap_out(rois[jnp.asarray(sel)]))
+        idxs.append(sel)
+    restore = np.argsort(np.concatenate(idxs)) if idxs else \
+        np.zeros(0, np.int64)
+    return outs, _wrap_out(jnp.asarray(restore.astype(np.int64)))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2: bilinear sampling at offset-shifted taps,
+    then a dense 1x1 contraction — the gather formulation XLA can fuse
+    (reference: ``deformable_conv`` CUDA kernel)."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError(
+            "deform_conv2d: groups/deformable_groups > 1")
+
+    def f(xa, off, w, *maybe):
+        m = maybe[0] if maybe else None
+        N, C, H, W = xa.shape
+        O, _, kh, kw = w.shape
+        OH = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        OW = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        off = off.reshape(N, kh * kw, 2, OH, OW)
+        oy = off[:, :, 0].reshape(N, kh, kw, OH, OW)
+        ox = off[:, :, 1].reshape(N, kh, kw, OH, OW)
+        # sample positions [N, kh, kw, OH, OW]
+        gy = (jnp.arange(OH) * s[0] - p[0])[None, None, None, :, None]
+        gx = (jnp.arange(OW) * s[1] - p[1])[None, None, None, None, :]
+        ky = (jnp.arange(kh) * d[0])[None, :, None, None, None]
+        kx = (jnp.arange(kw) * d[1])[None, None, :, None, None]
+        sy = gy + ky + oy                                # [N,kh,kw,OH,OW]
+        sx = gx + kx + ox
+
+        def bilin(img, yy, xx):
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            wy = yy - y0
+            wx = xx - x0
+            def at(yi, xi):
+                valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                yi = jnp.clip(yi, 0, H - 1)
+                xi = jnp.clip(xi, 0, W - 1)
+                v = img[:, yi, xi]                      # [C, ...]
+                return jnp.where(valid[None], v, 0.0)
+            return (at(y0, x0) * (1 - wy) * (1 - wx)
+                    + at(y0, x0 + 1) * (1 - wy) * wx
+                    + at(y0 + 1, x0) * wy * (1 - wx)
+                    + at(y0 + 1, x0 + 1) * wy * wx)
+
+        sampled = jax.vmap(bilin)(xa, sy, sx)  # [N, C, kh, kw, OH, OW]
+        if m is not None:
+            sampled = sampled * m.reshape(N, 1, kh, kw, OH, OW)
+        out = jnp.einsum("nckhij,ockh->noij", sampled, w)
+        if bias is not None:
+            out = out + as_jax(bias)[None, :, None, None]
+        return out
+    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+    return apply_jax("deform_conv2d", f, *args)

@@ -122,3 +122,52 @@ def test_dryrun_multichip_8():
     denv.set_mesh(None)
     from paddle_tpu.distributed.fleet.topology import set_hcg
     set_hcg(None)
+
+
+def test_vision_ops_detection_primitives():
+    """roi_align / nms / box utilities (reference vision/ops.py CUDA
+    kernels — SURVEY §2.5 Vision)."""
+    from paddle_tpu.vision import ops as vops
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    kept = vops.nms(paddle.to_tensor(boxes), 0.5,
+                    paddle.to_tensor(scores))
+    assert kept.numpy().tolist() == [0, 2]
+    # class-aware: different categories never suppress each other
+    cats = paddle.to_tensor(np.array([0, 1, 0], np.int64))
+    kept2 = vops.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores), category_idxs=cats,
+                     categories=[0, 1])
+    assert kept2.numpy().tolist() == [0, 1, 2]
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 3, 16, 16).astype(np.float32))
+    rois = paddle.to_tensor(
+        np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+    out = vops.roi_align(x, rois,
+                         paddle.to_tensor(np.array([1, 1], np.int64)), 4)
+    assert out.shape == [2, 3, 4, 4]
+    assert np.isfinite(out.numpy()).all()
+
+    area = vops.box_area(paddle.to_tensor(boxes))
+    np.testing.assert_allclose(area.numpy(), [100, 100, 100])
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    from paddle_tpu.vision import ops as vops
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    got = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w))
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), atol=1e-4)
+    # nonzero offsets change the result
+    off2 = np.full((1, 18, 4, 4), 0.5, np.float32)
+    got2 = vops.deform_conv2d(paddle.to_tensor(x),
+                              paddle.to_tensor(off2),
+                              paddle.to_tensor(w))
+    assert not np.allclose(got2.numpy(), ref.numpy())
