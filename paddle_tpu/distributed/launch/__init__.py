@@ -20,10 +20,16 @@ def parse_args(argv):
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--devices", "--gpus", "--xpus", default=None,
                    dest="devices")
-    p.add_argument("--nnodes", default="1")
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or 'min:max' for elastic range")
+    p.add_argument("--ips", default=None,
+                   help="comma-separated host list for multi-node; "
+                        "this node's position = --rank (or inferred "
+                        "from the local hostname/IP)")
     p.add_argument("--nproc_per_node", type=int, default=None)
     p.add_argument("--master", default=None)
-    p.add_argument("--rank", type=int, default=0)
+    p.add_argument("--rank", type=int, default=-1,
+                   help="node rank among --ips (-1: infer)")
     p.add_argument("--log_dir", default="log")
     p.add_argument("--job_id", default="default")
     p.add_argument("--max_restarts", type=int, default=0,
@@ -43,18 +49,51 @@ def parse_args(argv):
     return p.parse_args(argv)
 
 
+def _node_layout(args, nprocs):
+    """(hosts, node_rank, master): the multi-node topology. Single-node
+    default is localhost; with --ips the reference semantics apply —
+    node 0's address hosts the master, global trainer ids are
+    node_rank*nprocs + local_rank."""
+    import socket
+    if not args.ips:
+        return ["127.0.0.1"], 0, args.master or "127.0.0.1:6170"
+    hosts = [h.strip() for h in args.ips.split(",") if h.strip()]
+    node_rank = args.rank
+    if node_rank < 0:
+        me = {socket.gethostname(), "127.0.0.1", "localhost"}
+        try:
+            me.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        matches = [i for i, h in enumerate(hosts) if h in me]
+        if len(matches) != 1:
+            raise SystemExit(
+                f"launch: cannot infer this node's rank among "
+                f"--ips {hosts}; pass --rank explicitly")
+        node_rank = matches[0]
+    master = args.master or f"{hosts[0]}:6170"
+    return hosts, node_rank, master
+
+
 def _spawn_pod(args, nprocs, attempt, elastic_port=None):
-    """Start one process per rank; returns [(Popen, log_file)]."""
-    endpoints = ",".join(f"127.0.0.1:{6170 + i}" for i in range(nprocs))
+    """Start one process per LOCAL rank; returns [(Popen, log_file)].
+    Multi-node: global ids/endpoints span every host in --ips."""
+    hosts, node_rank, master = _node_layout(args, nprocs)
+    endpoints = ",".join(f"{h}:{6170 + i}" for h in hosts
+                         for i in range(nprocs))
+    world = len(hosts) * nprocs
     procs = []
     for rank in range(nprocs):
+        global_rank = node_rank * nprocs + rank
         env = dict(os.environ)
         env.update({
-            "PADDLE_TRAINER_ID": str(rank),
-            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_TRAINER_ENDPOINTS": endpoints,
-            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{6170 + rank}",
-            "PADDLE_MASTER": args.master or "127.0.0.1:6170",
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{hosts[node_rank]}:{6170 + rank}",
+            "PADDLE_MASTER": master,
+            "PADDLE_NODE_RANK": str(node_rank),
             "PADDLE_RESTART_ATTEMPT": str(attempt),
             "PADDLE_LOG_DIR": args.log_dir,
             "FLAGS_selected_gpus": str(rank),
@@ -135,12 +174,21 @@ def launch(argv=None):
     watcher = None
     elastic_port = None
     if args.elastic_level:
-        from ..fleet.elastic import ElasticManager
-        # controller hosts the liveness store; workers only connect
-        watcher = ElasticManager(port=0, world_size=nprocs,
-                                 is_master=True,
-                                 timeout=args.elastic_timeout)
-        elastic_port = watcher.port
+        if args.ips:
+            # per-node watchers would poll GLOBAL ranks that register
+            # on other nodes and kill healthy jobs; multi-node hang
+            # detection needs the (future) cross-node master —
+            # exit-code watching and --max_restarts still apply
+            print("[launch] --elastic_level heartbeat watch is "
+                  "single-node only; multi-node runs keep exit-code "
+                  "watching", file=sys.stderr)
+        else:
+            from ..fleet.elastic import ElasticManager
+            # controller hosts the liveness store; workers only connect
+            watcher = ElasticManager(port=0, world_size=nprocs,
+                                     is_master=True,
+                                     timeout=args.elastic_timeout)
+            elastic_port = watcher.port
     attempt = 0
     while True:
         procs = _spawn_pod(args, nprocs, attempt,

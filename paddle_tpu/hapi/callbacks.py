@@ -6,7 +6,7 @@ import time
 
 import numpy as np
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "VisualDL",
            "LRScheduler", "CallbackList"]
 
 
@@ -214,3 +214,59 @@ class LRScheduler(Callback):
             s = self._sched()
             if s is not None:
                 s.step()
+
+
+class VisualDL(Callback):
+    """``paddle.callbacks.VisualDL`` parity. The VisualDL service is a
+    CUDA-ecosystem web app not present here; the callback keeps the
+    same constructor/metric contract and writes scalar logs as JSONL
+    (one record per logged step) plus, when torch's TensorBoard writer
+    (``torch.utils.tensorboard``) is importable — torch is part of this
+    image — TensorBoard event files; both consumable by standard
+    dashboards."""
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        self._writer = None
+        self._jsonl = None
+        self._step = {"train": 0, "eval": 0}
+
+    def _ensure(self):
+        if self._jsonl is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(self.log_dir,
+                                            "scalars.jsonl"), "a")
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._writer = SummaryWriter(self.log_dir)
+            except Exception:
+                self._writer = None
+
+    def _log(self, mode, logs):
+        import json as _json
+        self._ensure()
+        step = self._step[mode]
+        record = {"mode": mode, "step": step}
+        for k, v in (logs or {}).items():
+            try:
+                record[k] = float(np.asarray(v).reshape(-1)[0])
+            except (TypeError, ValueError):
+                continue
+            if self._writer is not None:
+                self._writer.add_scalar(f"{mode}/{k}", record[k], step)
+        self._jsonl.write(_json.dumps(record) + "\n")
+        self._jsonl.flush()
+        self._step[mode] += 1
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._log("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None

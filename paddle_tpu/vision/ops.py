@@ -26,29 +26,22 @@ def box_area(boxes):
     return apply_jax("box_area", f, boxes)
 
 
-def _iou_matrix(b):
-    x1 = jnp.maximum(b[:, None, 0], b[None, :, 0])
-    y1 = jnp.maximum(b[:, None, 1], b[None, :, 1])
-    x2 = jnp.minimum(b[:, None, 2], b[None, :, 2])
-    y2 = jnp.minimum(b[:, None, 3], b[None, :, 3])
+def _iou_matrix(a, b=None):
+    """Pairwise IoU [len(a), len(b)]; b defaults to a."""
+    if b is None:
+        b = a
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
     inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
-    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    union = area[:, None] + area[None, :] - inter
-    return inter / jnp.maximum(union, 1e-9)
+    a1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    a2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-9)
 
 
 def box_iou(boxes1, boxes2):
-    def f(a, b):
-        x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
-        y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
-        x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
-        y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
-        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
-        a1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
-        a2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-        return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter,
-                                   1e-9)
-    return apply_jax("box_iou", f, boxes1, boxes2)
+    return apply_jax("box_iou", _iou_matrix, boxes1, boxes2)
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -88,6 +81,10 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     keep, order = f(b_arr, s_arr)
     kept = np.asarray(order)[np.asarray(keep)]
+    if category_idxs is not None and categories is not None:
+        cats_np = np.asarray(as_jax(category_idxs))
+        allowed = np.isin(cats_np[kept], np.asarray(categories))
+        kept = kept[allowed]
     if top_k is not None:
         kept = kept[:top_k]
     return _wrap_out(jnp.asarray(kept.astype(np.int64)))
@@ -235,7 +232,18 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level,
         idxs.append(sel)
     restore = np.argsort(np.concatenate(idxs)) if idxs else \
         np.zeros(0, np.int64)
-    return outs, _wrap_out(jnp.asarray(restore.astype(np.int64)))
+    restore_t = _wrap_out(jnp.asarray(restore.astype(np.int64)))
+    if rois_num is not None:
+        # per-level per-image counts (paddle's third output)
+        nums = np.asarray(as_jax(rois_num)).astype(np.int64)
+        img_of = np.repeat(np.arange(len(nums)), nums)
+        per_level = [
+            _wrap_out(jnp.asarray(np.bincount(
+                img_of[sel], minlength=len(nums)).astype(np.int32)))
+            for sel in idxs
+        ]
+        return outs, restore_t, per_level
+    return outs, restore_t
 
 
 def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
@@ -252,8 +260,11 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         raise NotImplementedError(
             "deform_conv2d: groups/deformable_groups > 1")
 
+    has_mask = mask is not None
+    has_bias = bias is not None
+
     def f(xa, off, w, *maybe):
-        m = maybe[0] if maybe else None
+        m = maybe[0] if has_mask else None
         N, C, H, W = xa.shape
         O, _, kh, kw = w.shape
         OH = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
@@ -289,8 +300,10 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         if m is not None:
             sampled = sampled * m.reshape(N, 1, kh, kw, OH, OW)
         out = jnp.einsum("nckhij,ockh->noij", sampled, w)
-        if bias is not None:
-            out = out + as_jax(bias)[None, :, None, None]
+        if has_bias:
+            out = out + maybe[-1][None, :, None, None]
         return out
-    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+
+    args = (x, offset, weight) + ((mask,) if has_mask else ()) \
+        + ((bias,) if has_bias else ())
     return apply_jax("deform_conv2d", f, *args)

@@ -58,3 +58,53 @@ def test_model_save_load(tmp_path):
     w1 = model.network.features[0].weight.numpy()
     w2 = model2.network.features[0].weight.numpy()
     np.testing.assert_allclose(w1, w2)
+
+
+def test_visualdl_callback_writes_scalars(tmp_path):
+    import json
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.hapi.callbacks import VisualDL
+    from paddle_tpu.io import Subset
+    from paddle_tpu.vision.datasets import MNIST
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(0)
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(
+        1e-3, parameters=model.parameters()),
+        nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    cb = VisualDL(str(tmp_path))
+    model.fit(Subset(MNIST(mode="train"), range(256)), batch_size=64,
+              epochs=1, verbose=0, callbacks=[cb])
+    lines = open(str(tmp_path) + "/scalars.jsonl").read().splitlines()
+    assert len(lines) >= 4
+    rec = json.loads(lines[-1])
+    assert rec["mode"] == "train" and "loss" in rec
+
+
+def test_launch_multinode_env_layout(tmp_path):
+    """--ips computes global ranks/endpoints (reference multi-node env
+    contract); single-node run of node 0 of 2."""
+    import subprocess, sys, os
+    script = tmp_path / "show.py"
+    script.write_text(
+        "import os\n"
+        "print('ID', os.environ['PADDLE_TRAINER_ID'],\n"
+        "      'N', os.environ['PADDLE_TRAINERS_NUM'],\n"
+        "      'EP', os.environ['PADDLE_TRAINER_ENDPOINTS'],\n"
+        "      'CUR', os.environ['PADDLE_CURRENT_ENDPOINT'],\n"
+        "      'NODE', os.environ['PADDLE_NODE_RANK'])\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--ips", "127.0.0.1,10.0.0.9",
+         "--rank", "0", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "ID 0 N 4" in r.stdout
+    assert "10.0.0.9:6171" in r.stdout  # endpoints span both nodes
+    assert "NODE 0" in r.stdout
