@@ -54,6 +54,7 @@ from . import fft
 from . import inference
 from . import distribution
 from .hapi import Model, summary
+from .hapi import callbacks
 from .framework.io import save, load
 from .nn.layer.layers import Layer
 from .parallel import DataParallel
@@ -64,6 +65,7 @@ import sys as _sys
 from .ops import linalg as linalg
 from . import ops as tensor
 _sys.modules[__name__ + ".linalg"] = linalg
+_sys.modules[__name__ + ".callbacks"] = callbacks
 
 disable_static = static.disable_static
 enable_static = static.enable_static
