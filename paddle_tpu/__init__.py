@@ -51,6 +51,7 @@ from . import sparse
 from . import audio
 from . import quantization
 from . import fft
+from . import signal
 from . import inference
 from . import distribution
 from .hapi import Model, summary

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..framework.core import Tensor, as_jax, _wrap_out
 
-__all__ = ["GenerationConfig", "GenerationMixin"]
+__all__ = ["GenerationConfig", "GenerationMixin", "LoadedGeneration", "load_generation"]
 
 
 @dataclass
@@ -68,48 +68,10 @@ class GenerationMixin:
     protocol: ``init_caches(batch, max_len)`` and
     ``forward(input_ids, caches=..., offset=...) -> (logits, caches)``."""
 
-    def generate(self, input_ids, generation_config: GenerationConfig = None,
-                 max_new_tokens=None, max_length=None,
-                 decode_strategy=None, temperature=None, top_k=None,
-                 top_p=None, eos_token_id=None, pad_token_id=None,
-                 seed=None, **kwargs):
-        if kwargs:
-            # silently dropping generation options produces output that
-            # looks valid but ignores the request — fail instead
-            raise TypeError(
-                f"generate() got unsupported options {sorted(kwargs)}; "
-                "supported: max_new_tokens/max_length, decode_strategy "
-                "(greedy_search|sampling), temperature, top_k, top_p, "
-                "eos_token_id, pad_token_id, seed")
-        """Returns ``(ids, scores)``: generated token ids
-        [B, max_new_tokens] (pad-filled after EOS) and the summed
-        log-probability of the chosen tokens per sequence."""
-        cfg = generation_config or GenerationConfig()
-        if max_length is not None and max_new_tokens is None:
-            max_new_tokens = max_length  # PaddleNLP: length of generation
-        max_new = int(max_new_tokens or cfg.max_new_tokens)
-        strategy = decode_strategy or cfg.decode_strategy
-        if strategy not in ("greedy_search", "sampling"):
-            raise NotImplementedError(
-                f"decode_strategy {strategy!r} (beam search not "
-                "implemented; use greedy_search or sampling)")
-        do_sample = strategy == "sampling"
-        temperature = cfg.temperature if temperature is None \
-            else float(temperature)
-        top_k = cfg.top_k if top_k is None else int(top_k)
-        top_p = cfg.top_p if top_p is None else float(top_p)
-        eos = eos_token_id if eos_token_id is not None else cfg.eos_token_id
-        pad = pad_token_id if pad_token_id is not None else cfg.pad_token_id
-        eos = -1 if eos is None else int(eos)   # -1 never matches
-        pad = (eos if eos >= 0 else 0) if pad is None else int(pad)
-        seed = cfg.seed if seed is None else seed
-        if seed is None:
-            seed = int(np.random.randint(0, 2 ** 31 - 1))
+    # -- shared decode machinery (generate() and export_generation use
+    # the SAME loop; any decode fix lands in both) -------------------
 
-        ids = as_jax(input_ids).astype(jnp.int32)
-        if ids.ndim == 1:
-            ids = ids[None]
-        b, prompt_len = ids.shape
+    def _check_lengths(self, prompt_len, max_new):
         max_pos = getattr(getattr(self, "config", None),
                           "max_position_embeddings", None)
         if max_pos is not None and prompt_len + max_new > max_pos:
@@ -120,10 +82,18 @@ class GenerationMixin:
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new}) "
                 f"exceeds max_position_embeddings ({max_pos})")
 
-        from ..jit import _LayerBinder
-        binder = _LayerBinder(self)
-        params = binder.param_arrays()
-        buffers = binder.buffer_arrays()
+    @staticmethod
+    def _resolve_strategy(strategy):
+        if strategy not in ("greedy_search", "sampling"):
+            raise NotImplementedError(
+                f"decode_strategy {strategy!r} (beam search not "
+                "implemented; use greedy_search or sampling)")
+        return strategy == "sampling"
+
+    def _build_run(self, binder, buffers, b, prompt_len, max_new,
+                   select, eos, pad, with_scores):
+        """run(params, ids, key) -> out ids [, scores]: prefill + one
+        lax.while_loop with in-loop EOS early exit."""
 
         def model_step(params_a, tok_ids, caches, off):
             t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
@@ -133,10 +103,6 @@ class GenerationMixin:
             logits, new_caches = out
             return as_jax(logits), [(as_jax(k), as_jax(v))
                                     for k, v in new_caches]
-
-        select = lambda lg, k: _select_token(
-            lg, k, do_sample=do_sample, temperature=temperature,
-            top_k=top_k, top_p=top_p)
 
         def run(params_a, ids_a, key):
             caches = self.init_caches(b, prompt_len + max_new)
@@ -150,8 +116,7 @@ class GenerationMixin:
             score = logp
 
             def cond(c):
-                i = c[0]
-                return (i < max_new) & jnp.logical_not(jnp.all(c[4]))
+                return (c[0] < max_new) & jnp.logical_not(jnp.all(c[4]))
 
             def body(c):
                 i, tok, caches, out, done, score, key = c
@@ -169,7 +134,62 @@ class GenerationMixin:
 
             state = (jnp.int32(1), tok, caches, out, done, score, key)
             state = jax.lax.while_loop(cond, body, state)
-            return state[3], state[5]
+            if with_scores:
+                return state[3], state[5]
+            return state[3]
+        return run
+
+
+    def generate(self, input_ids, generation_config: GenerationConfig = None,
+                 max_new_tokens=None, max_length=None,
+                 decode_strategy=None, temperature=None, top_k=None,
+                 top_p=None, eos_token_id=None, pad_token_id=None,
+                 seed=None, **kwargs):
+        """Returns ``(ids, scores)``: generated token ids
+        [B, max_new_tokens] (pad-filled after EOS) and the summed
+        log-probability of the chosen tokens per sequence."""
+        if kwargs:
+            # silently dropping generation options produces output that
+            # looks valid but ignores the request — fail instead
+            raise TypeError(
+                f"generate() got unsupported options {sorted(kwargs)}; "
+                "supported: max_new_tokens/max_length, decode_strategy "
+                "(greedy_search|sampling), temperature, top_k, top_p, "
+                "eos_token_id, pad_token_id, seed")
+        cfg = generation_config or GenerationConfig()
+        if max_length is not None and max_new_tokens is None:
+            max_new_tokens = max_length  # PaddleNLP: length of generation
+        max_new = int(max_new_tokens or cfg.max_new_tokens)
+        do_sample = self._resolve_strategy(
+            decode_strategy or cfg.decode_strategy)
+        temperature = cfg.temperature if temperature is None \
+            else float(temperature)
+        top_k = cfg.top_k if top_k is None else int(top_k)
+        top_p = cfg.top_p if top_p is None else float(top_p)
+        eos = eos_token_id if eos_token_id is not None else cfg.eos_token_id
+        pad = pad_token_id if pad_token_id is not None else cfg.pad_token_id
+        eos = -1 if eos is None else int(eos)   # -1 never matches
+        pad = (eos if eos >= 0 else 0) if pad is None else int(pad)
+        seed = cfg.seed if seed is None else seed
+        if seed is None:
+            seed = int(np.random.randint(0, 2 ** 31 - 1))
+
+        ids = as_jax(input_ids).astype(jnp.int32)
+        if ids.ndim == 1:
+            ids = ids[None]
+        b, prompt_len = ids.shape
+        self._check_lengths(prompt_len, max_new)
+
+        from ..jit import _LayerBinder
+        binder = _LayerBinder(self)
+        params = binder.param_arrays()
+        buffers = binder.buffer_arrays()
+
+        select = lambda lg, k: _select_token(
+            lg, k, do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p)
+        run = self._build_run(binder, buffers, b, prompt_len, max_new,
+                              select, eos, pad, with_scores=True)
 
         if not hasattr(self, "_generate_jit_cache"):
             self._generate_jit_cache = {}
@@ -182,3 +202,83 @@ class GenerationMixin:
         out, score = jitted(params, ids, jax.random.PRNGKey(seed))
         return (_wrap_out(out.astype(jnp.int64)),
                 _wrap_out(score))
+
+    def export_generation(self, path, batch_size, prompt_len,
+                          max_new_tokens, generation_config=None):
+        """AOT-export the ENTIRE decode loop (prefill + lax.while_loop)
+        as a serialized StableHLO module + params — the deployable LLM
+        artifact the reference serves via AnalysisPredictor. Load with
+        ``paddle_tpu.generation.load_generation(path)``; call with
+        (ids [B, L] int32, seed int) -> generated ids."""
+        import json
+        import os
+        cfg = generation_config or GenerationConfig()
+        do_sample = self._resolve_strategy(cfg.decode_strategy)
+        eos = -1 if cfg.eos_token_id is None else int(cfg.eos_token_id)
+        pad = (eos if eos >= 0 else 0) if cfg.pad_token_id is None \
+            else int(cfg.pad_token_id)
+        b, prompt, max_new = int(batch_size), int(prompt_len), \
+            int(max_new_tokens)
+        self._check_lengths(prompt, max_new)
+
+        from ..jit import _LayerBinder
+        binder = _LayerBinder(self)
+        params = binder.param_arrays()
+        buffers = binder.buffer_arrays()
+
+        select = lambda lg, k: _select_token(
+            lg, k, do_sample=do_sample, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p)
+        run = self._build_run(binder, buffers, b, prompt, max_new,
+                              select, eos, pad, with_scores=False)
+
+        def run_seeded(params_a, ids_a, seed):
+            return run(params_a, ids_a, jax.random.PRNGKey(seed))
+
+        seed_dtype = "int64" if jax.config.jax_enable_x64 else "int32"
+        from jax import export as jexport
+        exported = jexport.export(jax.jit(run_seeded))(
+            [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params],
+            jax.ShapeDtypeStruct((b, prompt), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.dtype(seed_dtype)))
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        np.savez(path + ".params.npz",
+                 **{f"p{i}": np.asarray(p)
+                    for i, p in enumerate(params)})
+        with open(path + ".json", "w") as f:
+            json.dump({"batch": b, "prompt_len": prompt,
+                       "max_new_tokens": max_new,
+                       "n_params": len(params),
+                       "seed_dtype": seed_dtype}, f)
+        return path
+
+
+class LoadedGeneration:
+    """AOT generation artifact: (ids [B, L], seed) -> generated ids."""
+
+    def __init__(self, path):
+        import json
+        from jax import export as jexport
+        with open(path + ".pdmodel", "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        data = np.load(path + ".params.npz")
+        with open(path + ".json") as f:
+            self.meta = json.load(f)
+        self._params = [jnp.asarray(data[f"p{i}"])
+                        for i in range(self.meta["n_params"])]
+
+    def __call__(self, input_ids, seed=0):
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        # the artifact records its baked seed dtype (the exporting
+        # process's x64 mode — may differ from this process's)
+        seed_dt = jnp.dtype(self.meta.get("seed_dtype", "int32"))
+        out = self._exported.call(self._params, ids,
+                                  jnp.asarray(seed, seed_dt))
+        return np.asarray(out)
+
+
+def load_generation(path) -> LoadedGeneration:
+    return LoadedGeneration(path)

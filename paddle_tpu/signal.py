@@ -1,0 +1,97 @@
+"""``paddle.signal`` (reference ``python/paddle/signal.py``): stft /
+istft over jnp FFT, framed like ``audio/features.py`` (one batched
+rfft/irfft — no per-frame loops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.core import Tensor, apply_jax, as_jax
+from .framework.errors import InvalidArgumentError
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(x, frame_length, hop_length):
+    n_frames = 1 + (x.shape[-1] - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]                      # [..., frames, frame_length]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """x: [..., T] real -> complex [..., n_fft//2+1 (or n_fft), frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if win_length > n_fft:
+        raise InvalidArgumentError(
+            f"win_length {win_length} > n_fft {n_fft}")
+    if window is not None:
+        w = as_jax(window)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def f(a):
+        if center:
+            pad = n_fft // 2
+            widths = [(0, 0)] * (a.ndim - 1) + [(pad, pad)]
+            a = jnp.pad(a, widths, mode=pad_mode)
+        frames = _frame(a, n_fft, hop_length) * w
+        if onesided:
+            spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, n=n_fft, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)   # [..., bins, frames]
+    return apply_jax("stft", f, x)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with overlap-add and window-envelope correction."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        w = as_jax(window)
+    else:
+        w = jnp.ones(win_length, jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def f(spec):
+        s = jnp.moveaxis(spec, -2, -1)      # [..., frames, bins]
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1).real
+        frames = frames * w
+        n_frames = frames.shape[-2]
+        T = n_fft + (n_frames - 1) * hop_length
+        lead = frames.shape[:-2]
+        out = jnp.zeros(lead + (T,), frames.dtype)
+        norm = jnp.zeros(T, jnp.float32)
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        out = out.at[..., idx].add(frames)
+        norm = norm.at[idx].add((w * w)[None, :].repeat(n_frames, 0))
+        out = out / jnp.maximum(norm, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: T - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:  # frames may not cover the tail
+                widths = [(0, 0)] * (out.ndim - 1) + \
+                    [(0, length - out.shape[-1])]
+                out = jnp.pad(out, widths)
+            out = out[..., :length]
+        return out
+    return apply_jax("istft", f, x)

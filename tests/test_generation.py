@@ -135,3 +135,32 @@ def test_cached_decode_rejects_attention_mask(llama_tiny):
     with pytest.raises(NotImplementedError, match="attention_mask"):
         llama_tiny(ids, attention_mask=mask, caches=caches,
                    offset=paddle.to_tensor(np.int32(0)))
+
+
+def test_export_generation_roundtrip(tmp_path, llama_tiny):
+    """The whole decode loop exports as one StableHLO artifact and
+    reproduces live greedy generate() after reload."""
+    from paddle_tpu.generation import GenerationConfig, load_generation
+    path = str(tmp_path / "gen")
+    llama_tiny.export_generation(path, batch_size=2, prompt_len=7,
+                                 max_new_tokens=5,
+                                 generation_config=GenerationConfig())
+    loaded = load_generation(path)
+    ids = np.random.RandomState(11).randint(0, 128, (2, 7))
+    got = loaded(ids, seed=0)
+    live, _ = llama_tiny.generate(paddle.to_tensor(ids.astype(np.int64)),
+                                  max_new_tokens=5)
+    np.testing.assert_array_equal(got, live.numpy())
+
+
+def test_export_generation_validates(tmp_path, llama_tiny):
+    from paddle_tpu.generation import GenerationConfig
+    max_pos = llama_tiny.config.max_position_embeddings
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        llama_tiny.export_generation(str(tmp_path / "x"), 1,
+                                     max_pos - 2, 8)
+    with pytest.raises(NotImplementedError):
+        llama_tiny.export_generation(
+            str(tmp_path / "y"), 1, 4, 4,
+            generation_config=GenerationConfig(
+                decode_strategy="beam_search"))

@@ -345,3 +345,18 @@ def test_mmha_rejects_ragged_lengths():
             x, cache_kv=cache,
             sequence_lengths=paddle.to_tensor(
                 np.array([2, 1], np.int32)))
+
+
+def test_signal_stft_istft_roundtrip():
+    """paddle.signal stft/istft overlap-add reconstruction."""
+    from paddle_tpu.audio.functional import get_window
+    sr = 4000
+    t = np.arange(sr, dtype=np.float32) / sr
+    x = np.sin(2 * np.pi * 220 * t)[None]
+    w = get_window("hann", 256)
+    spec = paddle.signal.stft(paddle.to_tensor(x), 256, 64, window=w)
+    assert spec.shape[1] == 129  # onesided bins
+    rec = paddle.signal.istft(spec, 256, 64, window=w, length=sr)
+    covered = sr - 256
+    np.testing.assert_allclose(rec.numpy()[:, :covered],
+                               x[:, :covered], atol=1e-4)
