@@ -55,6 +55,12 @@ class Model:
 
     # ------------------------------------------------------------------
     def train_batch(self, inputs, labels=None, update=True):
+        """Returns ``[loss]`` (scalar list). Divergence from the
+        reference: train-time metrics are NOT computed here — the whole
+        step (fwd+bwd+opt) is one donated XLA program whose only output
+        is the loss, and metric computation would force a second
+        forward in the fit() hot loop. Metrics accumulate in
+        ``eval_batch``/``evaluate`` instead."""
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         inputs = [t if isinstance(t, Tensor) else Tensor(t) for t in inputs]

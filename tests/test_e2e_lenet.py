@@ -108,3 +108,27 @@ def test_launch_multinode_env_layout(tmp_path):
     assert "ID 0 N 4" in r.stdout
     assert "10.0.0.9:6171" in r.stdout  # endpoints span both nodes
     assert "NODE 0" in r.stdout
+
+
+def test_model_batch_level_apis():
+    """train_batch/eval_batch/predict_batch (hapi parity paths that
+    fit() doesn't cover)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import LeNet
+    paddle.seed(1)
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.Adam(
+        1e-3, parameters=model.parameters()),
+        nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+    [loss1] = model.train_batch([x], [y])
+    [loss2] = model.train_batch([x], [y])
+    assert loss2 < loss1
+    eval_metrics = model.eval_batch([x], [y])
+    assert np.isfinite(np.asarray(eval_metrics)).all()
+    preds = model.predict_batch([x])
+    arr = preds[0] if isinstance(preds, (list, tuple)) else preds
+    assert np.asarray(arr).shape == (8, 10)
