@@ -138,6 +138,7 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled_opts = set()
+        self._stepped_opts = set()
 
     def scale(self, var):
         if not self._enable or self._scale == 1.0:
@@ -179,6 +180,12 @@ class GradScaler:
         if not self._enable:
             optimizer.step()
             return
+        if id(optimizer) in self._stepped_opts:
+            raise RuntimeError(
+                "step() has already been called since the last update(). "
+                "Call scaler.update() once per iteration after stepping "
+                "every optimizer.")
+        self._stepped_opts.add(id(optimizer))
         if self._scale != 1.0 and id(optimizer) not in \
                 self._unscaled_opts:
             self.unscale_(optimizer)
@@ -191,6 +198,7 @@ class GradScaler:
 
     def update(self):
         self._unscaled_opts.clear()
+        self._stepped_opts.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -115,13 +115,18 @@ class PipelineLayer(Layer):
         """(pre, body, post) when a homogeneous run of layers can ride the
         shard_map pipeline engine; None → sequential fallback. The
         heterogeneous first/last-stage work (embedding, head, loss prep)
-        stays outside the ring — the scan-pipeline equivalent of the
-        reference's first/last-stage special-casing."""
+        rides INSIDE the ring as stage-0/last-stage extra compute when the
+        pre/post items are plain layers (reference: first/last-stage
+        special-casing in ``pipeline_parallel.py``); the route decision is
+        logged — never silent."""
         if getattr(self, "_route_cache", "unset") != "unset":
             return self._route_cache
+        from ...framework.log import vlog
         self._route_cache = None
         k = self._num_stages
         if k <= 1 or mesh_axis_size("pp") < k:
+            vlog(1, "PipelineLayer: sequential route (pp mesh axis %d < "
+                 "num_stages %d)", mesh_axis_size("pp"), k)
             return None
         from ...jit import _LayerBinder
 
@@ -150,6 +155,12 @@ class PipelineLayer(Layer):
         length, start = best
         usable = (length // k) * k
         if usable < k or usable < 2:
+            from ...framework.log import logger
+            logger.warning(
+                "PipelineLayer: no homogeneous run of >= %d layers "
+                "(longest run %d) — pp=%d gets NO pipeline overlap; "
+                "running stages sequentially (params stay mesh-sharded)",
+                k, length, k)
             return None
         # align the run's tail with the segment boundary: keep the last
         # `usable` homogeneous layers in the body
@@ -158,6 +169,10 @@ class PipelineLayer(Layer):
                              [obj for _, obj, _ in
                               self._items[start:start + usable]],
                              self._items[start + usable:])
+        vlog(1, "PipelineLayer: engine route — %d pre item(s) -> stage-0 "
+             "work, %d-layer homogeneous body over pp=%d, %d post "
+             "item(s) -> last-stage work", start, usable, k,
+             n - start - usable)
         return self._route_cache
 
     def _run_items(self, items, x):
@@ -171,19 +186,50 @@ class PipelineLayer(Layer):
                 x = obj(x)
         return x
 
-    def _pipe_body(self, body, x):
+    @staticmethod
+    def _liftable(items):
+        """pre/post items that can ride inside the ring as first/last
+        stage work: plain buffer-less layers."""
+        from ...jit import _LayerBinder
+        return bool(items) and all(
+            kind == "layer" and not _LayerBinder(obj).buffer_items
+            for kind, obj, _ in items)
+
+    def _pipe_body(self, pre, body, post, x):
+        """Pipelined run: homogeneous body over the pp ring; lifted pre
+        items execute per-microbatch on stage 0 (first_fn) and post items
+        on the last stage (last_fn), so embedding/head work overlaps the
+        pipeline instead of running replicated outside it."""
         from ...jit import _LayerBinder
         from ..pipeline import pipeline_apply
         from ..shard_utils import current_mesh
+        from ...framework.log import vlog, logger
         mesh = current_mesh()
         pp = self._num_stages
         lps = len(body) // pp
         binder = _LayerBinder(body[0])
         n_p = len(binder.param_items)
-        param_tensors = [p for lay in body
-                         for _, p in _LayerBinder(lay).param_items]
+        body_tensors = [p for lay in body
+                        for _, p in _LayerBinder(lay).param_items]
+
+        pre_binders = [_LayerBinder(obj) for _, obj, _ in pre]
+        post_binders = [_LayerBinder(obj) for _, obj, _ in post]
+        pre_sizes = [len(b.param_items) for b in pre_binders]
+        post_sizes = [len(b.param_items) for b in post_binders]
+        pre_tensors = [p for b in pre_binders for _, p in b.param_items]
+        post_tensors = [p for b in post_binders for _, p in b.param_items]
+
         n_micro = getattr(self, "_num_micro", None) or pp
         recompute = self._recompute_interval and self.training
+
+        def chain(binders, sizes, flat, h):
+            i = 0
+            for b, s in zip(binders, sizes):
+                arrs = list(flat[i:i + s])
+                i += s
+                out, _ = b.call(arrs, [], (_wrap_out(h),), {})
+                h = as_jax(out)
+            return h
 
         def one_layer(params_local, h, i):
             arrs = [p[i] for p in params_local]
@@ -198,8 +244,12 @@ class PipelineLayer(Layer):
                 h = f(params_local, h, i)
             return h
 
-        def run_pipe(h_a, *flat):
-            per = [flat[kk * n_p:(kk + 1) * n_p]
+        def run_pipe(x_a, *flat):
+            nb = len(body) * n_p
+            body_flat = flat[:nb]
+            pre_flat = list(flat[nb:nb + len(pre_tensors)])
+            post_flat = list(flat[nb + len(pre_tensors):])
+            per = [body_flat[kk * n_p:(kk + 1) * n_p]
                    for kk in range(len(body))]
             stacked = [
                 jnp.stack([jnp.stack([per[s * lps + i][j]
@@ -207,24 +257,156 @@ class PipelineLayer(Layer):
                            for s in range(pp)])
                 for j in range(n_p)
             ]
-            b = h_a.shape[0]
-            nm = n_micro
+            b = x_a.shape[0]
+            nm = min(n_micro, b)
             while b % nm != 0:
                 nm -= 1
-            mbs = h_a.reshape((nm, b // nm) + h_a.shape[1:])
-            out = pipeline_apply(stage_fn, stacked, mbs, mesh=mesh)
-            return out.reshape(h_a.shape)
+            if nm != n_micro and \
+                    getattr(self, "_nm_logged", None) != (n_micro, nm):
+                logger.warning(
+                    "PipelineLayer: batch %d not divisible by %d "
+                    "microbatches — using %d microbatches instead",
+                    b, n_micro, nm)
+                self._nm_logged = (n_micro, nm)
+            mbs = x_a.reshape((nm, b // nm) + x_a.shape[1:])
+            first_fn = (lambda fp, feed, *e:
+                        chain(pre_binders, pre_sizes, fp, feed)) \
+                if pre else None
+            last_fn = (lambda lp, y, lf, *e:
+                       chain(post_binders, post_sizes, lp, y)) \
+                if post else None
+            out = pipeline_apply(
+                stage_fn, stacked, mbs, mesh=mesh,
+                first_fn=first_fn, first_params=pre_flat or None,
+                last_fn=last_fn, last_params=post_flat or None)
+            return out.reshape((b,) + out.shape[2:])
 
-        return apply_jax("pipeline_body", run_pipe, x, *param_tensors)
+        return apply_jax("pipeline_body", run_pipe, x, *body_tensors,
+                         *pre_tensors, *post_tensors)
 
     def forward(self, x):
         route = self._engine_route()
         if route is None:
             return self._run_items(self._items, x)
         pre, body, post = route
-        x = self._run_items(pre, x)
-        x = self._pipe_body(body, x)
-        return self._run_items(post, x)
+        lift_pre = self._liftable(pre)
+        lift_post = self._liftable(post)
+        if pre and not lift_pre:
+            x = self._run_items(pre, x)
+        x = self._pipe_body(pre if lift_pre else [], body,
+                            post if lift_post else [], x)
+        if post and not lift_post:
+            x = self._run_items(post, x)
+        return x
+
+    def train_batch_1f1b(self, x, labels, n_micro):
+        """One full 1F1B train pass (O(pp) activation memory): computes
+        the mean loss and ACCUMULATES parameter gradients directly
+        (``p.grad``), bypassing the tape — the schedule interleaves
+        forward and backward inside one scan, which autograd-through-
+        forward cannot express. Requires an engine route whose pre/post
+        items are liftable and a ``loss_fn``."""
+        from ...jit import _LayerBinder
+        from ..pipeline_1f1b import pipeline_1f1b_grads
+        from ..shard_utils import current_mesh
+        route = self._engine_route()
+        if route is None:
+            raise RuntimeError("1F1B needs the pipeline engine route "
+                               "(homogeneous stage body over a pp mesh)")
+        if self._loss_fn is None:
+            raise RuntimeError("1F1B training needs loss_fn")
+        pre, body, post = route
+        if (pre and not self._liftable(pre)) or \
+                (post and not self._liftable(post)):
+            raise RuntimeError("1F1B needs liftable (plain-layer) "
+                               "pre/post stage items")
+        mesh = current_mesh()
+        pp = self._num_stages
+        lps = len(body) // pp
+        binder = _LayerBinder(body[0])
+        n_p = len(binder.param_items)
+        pre_binders = [_LayerBinder(obj) for _, obj, _ in pre]
+        post_binders = [_LayerBinder(obj) for _, obj, _ in post]
+        pre_sizes = [len(b.param_items) for b in pre_binders]
+        post_sizes = [len(b.param_items) for b in post_binders]
+
+        def chain(binders, sizes, flat, h):
+            i = 0
+            for b, s in zip(binders, sizes):
+                arrs = list(flat[i:i + s])
+                i += s
+                out, _ = b.call(arrs, [], (_wrap_out(h),), {})
+                h = as_jax(out)
+            return h
+
+        def one_layer(params_local, h, i):
+            arrs = [p[i] for p in params_local]
+            out, _ = binder.call(arrs, [], (_wrap_out(h),), {})
+            return as_jax(out)
+
+        def stage_fn(params_local, h):
+            for i in range(lps):
+                h = one_layer(params_local, h, i)
+            return h
+
+        loss_fn = self._loss_fn
+
+        def last_fn(lp, y, lf):
+            out = chain(post_binders, post_sizes, lp, y)
+            return as_jax(loss_fn(_wrap_out(out), _wrap_out(lf)))
+
+        first_fn = (lambda fp, feed:
+                    chain(pre_binders, pre_sizes, fp, feed)) \
+            if pre else None
+
+        body_params = [[as_jax(p) for _, p in _LayerBinder(lay).param_items]
+                       for lay in body]
+        stacked = [
+            jnp.stack([jnp.stack([body_params[s * lps + i][j]
+                                  for i in range(lps)])
+                       for s in range(pp)])
+            for j in range(n_p)
+        ]
+        pre_arrs = [as_jax(p) for b in pre_binders
+                    for _, p in b.param_items]
+        post_arrs = [as_jax(p) for b in post_binders
+                     for _, p in b.param_items]
+
+        x_a = as_jax(x)
+        y_a = as_jax(labels)
+        b = x_a.shape[0]
+        nm = min(n_micro, b)
+        while b % nm != 0:
+            nm -= 1
+        if nm != n_micro:
+            from ...framework.log import logger
+            logger.warning(
+                "PipelineLayer(1F1B): batch %d not divisible by %d "
+                "microbatches — using %d", b, n_micro, nm)
+        feeds = x_a.reshape((nm, b // nm) + x_a.shape[1:])
+        lfeeds = y_a.reshape((nm, b // nm) + y_a.shape[1:])
+
+        loss, (g_stacked, g_first, g_last) = pipeline_1f1b_grads(
+            stage_fn, stacked, feeds, last_fn, first_fn=first_fn,
+            first_params=pre_arrs or [], last_params=post_arrs or [],
+            last_feeds=lfeeds, mesh=mesh)
+
+        def accum(p, g):
+            g = jnp.asarray(g)
+            p._grad = _wrap_out(g if p.grad is None
+                                else as_jax(p.grad) + g)
+
+        for li, lay in enumerate(body):
+            s, i = divmod(li, lps)
+            for j, (_, p) in enumerate(_LayerBinder(lay).param_items):
+                accum(p, g_stacked[j][s, i])
+        flat_pre = [p for bd in pre_binders for _, p in bd.param_items]
+        for p, g in zip(flat_pre, g_first):
+            accum(p, g)
+        flat_post = [p for bd in post_binders for _, p in bd.param_items]
+        for p, g in zip(flat_post, g_last):
+            accum(p, g)
+        return _wrap_out(loss)
 
 
 class PipelineParallel(Layer):
@@ -256,6 +438,30 @@ class PipelineParallel(Layer):
             labels = Tensor(labels)
         n_micro = self.accumulate_steps
         loss_fn = getattr(self._layers, "_loss_fn", None)
+        cfg = self._strategy.pipeline_configs if self._strategy else {}
+        if str(cfg.get("schedule", "")).upper() == "1F1B" and \
+                isinstance(self._layers, PipelineLayer) and \
+                self._layers._engine_route() is not None:
+            # true 1F1B: fwd/bwd interleaved in one scan, O(pp) live
+            # activations; grads are produced directly by the engine
+            if scaler is not None and getattr(scaler, "_scale", 1.0) != 1.0:
+                raise NotImplementedError(
+                    "1F1B engine with dynamic loss scaling; use bf16 "
+                    "(scale 1.0)")
+            loss = self._layers.train_batch_1f1b(inputs, labels, n_micro)
+            if scaler is not None:
+                # scale is 1.0 so unscale_ is a pure finite-check: a
+                # NaN/Inf microbatch must SKIP the step, same as the
+                # non-1F1B path
+                scaler.unscale_(optimizer)
+                scaler.step(optimizer)
+                scaler.update()
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         if isinstance(self._layers, PipelineLayer) and \
                 self._layers._engine_route() is not None:
             # engine path: all microbatches ride the scan pipeline in ONE

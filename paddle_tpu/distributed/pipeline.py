@@ -37,9 +37,26 @@ def stack_stage_params(per_stage_params: List[Any]):
         lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
 
 
+def _live_batch_axes(mesh, axis, batch_axes, mb_dim):
+    """Mesh axes that may shard the per-microbatch batch dim: keep an
+    axis only while the *product* of kept axes still divides it."""
+    live = []
+    prod = 1
+    for a in (batch_axes or ()):
+        sz = mesh.shape.get(a, 1)
+        if a != axis and sz > 1 and mb_dim % (prod * sz) == 0:
+            live.append(a)
+            prod *= sz
+    live = tuple(live)
+    return live if len(live) > 1 else (live[0] if live else None)
+
+
 def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
                    microbatches, mesh: Mesh = None, axis: str = "pp",
-                   extra_inputs=None, batch_axes=("dp", "sharding")):
+                   extra_inputs=None, batch_axes=("dp", "sharding"),
+                   first_fn=None, first_params=None,
+                   last_fn=None, last_params=None, last_feeds=None,
+                   remat=False):
     """Run the pipelined forward.
 
     stage_fn(params_local, x, *extra) -> y  — one stage's compute; must
@@ -49,7 +66,23 @@ def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
     batch_axes: mesh axes (those present with size>1) that shard the
         per-microbatch batch dim (dim 1) inside the pipe — data parallel
         composes with pp without leaving the shard_map.
-    Returns [n_micro, mb, ...] outputs (valid on every device — the last
+
+    Heterogeneous first/last stages (the reference's first/last-stage
+    special-casing in ``pipeline_parallel.py``):
+
+    first_fn(first_params, feed_mb, *extra) -> h  — runs ONLY on stage 0,
+        per tick, converting the raw feed microbatch (e.g. token ids)
+        into the ring's boundary activation. Its work overlaps the
+        pipeline instead of running replicated up front.
+    last_fn(last_params, y, last_feed_mb, *extra) -> out  — runs ONLY on
+        the last stage (head / loss prep). ``last_feeds`` is an optional
+        [n_micro, ...] per-micro side input (e.g. labels).
+    remat=True checkpoints stage_fn so the backward recomputes stage
+        interiors — per-device live activations are the per-tick BOUNDARY
+        tensors only (the GPipe+remat memory regime; see
+        ``pipeline_1f1b`` for the O(pp) schedule).
+
+    Returns [n_micro, ...] outputs (valid on every device — the last
     stage's results are broadcast over the pp axis).
     """
     mesh = mesh or _env.get_mesh()
@@ -57,58 +90,101 @@ def pipeline_apply(stage_fn: PipelineStageFn, stacked_params,
     n_micro = microbatches.shape[0]
     n_ticks = n_micro + pp - 1
     extra = extra_inputs if extra_inputs is not None else ()
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     in_spec_params = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
-    # keep a batch axis only while the *product* of kept axes still
-    # divides the per-microbatch batch dim (per-axis checks would admit
-    # e.g. 2x2 devices for a batch of 2)
-    live_batch = []
-    _prod = 1
-    for a in (batch_axes or ()):
-        sz = mesh.shape.get(a, 1)
-        if a != axis and sz > 1 and \
-                microbatches.shape[1] % (_prod * sz) == 0:
-            live_batch.append(a)
-            _prod *= sz
-    live_batch = tuple(live_batch)
-    mb_spec = P(None, live_batch if len(live_batch) > 1
-                else (live_batch[0] if live_batch else None),
-                *([None] * (microbatches.ndim - 2)))
+    batch_spec = _live_batch_axes(mesh, axis, batch_axes,
+                                  microbatches.shape[1])
+    mb_spec = P(None, batch_spec, *([None] * (microbatches.ndim - 2)))
+    _axes = (batch_spec,) if isinstance(batch_spec, str) \
+        else (batch_spec or ())
+    _prod = int(np.prod([mesh.shape[a] for a in _axes])) if _axes else 1
+    local_mb = microbatches.shape[1] // _prod
 
-    def per_device(params_block, mbs, *extra_args):
+    # boundary activation spec (ring dtype/shape) — PER-DEVICE view:
+    # the batch dim inside shard_map is the local shard
+    local_feed = jax.ShapeDtypeStruct(
+        (local_mb,) + microbatches.shape[2:], microbatches.dtype)
+    if first_fn is not None:
+        h_struct = jax.eval_shape(
+            lambda p, x, *e: first_fn(p, x, *e),
+            first_params, local_feed, *extra)
+    else:
+        h_struct = local_feed
+    if last_fn is not None:
+        lf_struct = None if last_feeds is None else jax.ShapeDtypeStruct(
+            last_feeds.shape[1:], last_feeds.dtype)
+        out_struct = jax.eval_shape(
+            lambda p, y, lf, *e: last_fn(p, y, lf, *e),
+            last_params, h_struct, lf_struct, *extra)
+    else:
+        out_struct = h_struct
+    out_spec = P(None) if out_struct.ndim == 0 else P(
+        None, batch_spec if out_struct.shape[0] == local_mb else None,
+        *([None] * (out_struct.ndim - 1)))
+
+    rep = lambda tree: jax.tree_util.tree_map(
+        lambda x: P(*([None] * jnp.ndim(x))), tree)
+
+    def per_device(params_block, mbs, fparams, lparams, lfeeds,
+                   *extra_args):
         # params_block leaves: [1, ...] (this stage's slice)
         params_local = jax.tree_util.tree_map(
             lambda x: x[0], params_block)
         stage_idx = jax.lax.axis_index(axis)
         perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
 
-        mb_shape = mbs.shape[1:]
-        y0 = jnp.zeros(mb_shape, mbs.dtype)
+        y0 = jnp.zeros(h_struct.shape, h_struct.dtype)
 
         def tick(carry, t):
             recv = carry
             feed = jnp.where(t < n_micro, t, 0)
-            x_in = jnp.where(stage_idx == 0, mbs[feed], recv)
+            if first_fn is not None:
+                x_first = jax.lax.cond(
+                    stage_idx == 0,
+                    lambda: first_fn(fparams, mbs[feed], *extra_args),
+                    lambda: jnp.zeros(h_struct.shape, h_struct.dtype))
+                x_in = jnp.where(stage_idx == 0, x_first, recv)
+            else:
+                x_in = jnp.where(stage_idx == 0, mbs[feed], recv)
             y = stage_fn(params_local, x_in, *extra_args)
             send = jax.lax.ppermute(y, axis, perm_fwd)
-            # output from the last stage this tick (microbatch t-pp+1)
-            out = jnp.where(stage_idx == pp - 1, y,
-                            jnp.zeros_like(y))
+            if last_fn is not None:
+                oidx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
+                lf = None if lfeeds is None else lfeeds[oidx]
+                out = jax.lax.cond(
+                    stage_idx == pp - 1,
+                    lambda: last_fn(lparams, y, lf, *extra_args),
+                    lambda: jnp.zeros(out_struct.shape, out_struct.dtype))
+            else:
+                # output from the last stage this tick
+                out = jnp.where(stage_idx == pp - 1, y,
+                                jnp.zeros_like(y))
             return send, out
 
         _, outs = jax.lax.scan(tick, y0, jnp.arange(n_ticks))
-        # outs: [n_ticks, mb...]; last stage's valid range is
+        # outs: [n_ticks, ...]; last stage's valid range is
         # ticks [pp-1, pp-1+n_micro). psum over pp broadcasts them
         # (all other stages contributed zeros).
         valid = jax.lax.dynamic_slice_in_dim(outs, pp - 1, n_micro, axis=0)
         return jax.lax.psum(valid, axis)
 
+    # per-micro labels must follow the same batch sharding as the
+    # microbatches, or dp shards would pair local activations with the
+    # GLOBAL label slice
+    lf_spec = None if last_feeds is None else P(
+        None, batch_spec if last_feeds.shape[1] == microbatches.shape[1]
+        else None, *([None] * (last_feeds.ndim - 2)))
+
     from .shard_utils import manual_region, shard_map_compat
     mapped = shard_map_compat(
         per_device, mesh,
-        (in_spec_params, mb_spec,
+        (in_spec_params, mb_spec, rep(first_params), rep(last_params),
+         lf_spec,
          *[P(*([None] * jnp.ndim(e))) for e in extra]),
-        mb_spec)
+        out_spec)
     with manual_region():
-        return mapped(stacked_params, microbatches, *extra)
+        return mapped(stacked_params, microbatches, first_params,
+                      last_params, last_feeds, *extra)

@@ -1,0 +1,283 @@
+"""1F1B pipeline schedule with O(pp) activation memory (reference:
+``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py``
+1F1B mode — warmup forwards, steady one-forward-one-backward, cooldown
+backwards).
+
+TPU-first formulation: the schedule is precomputed in python as static
+[pp, T] op/micro tables (SPMD programs cannot branch per rank, but they
+can index constant tables by ``axis_index``), and the whole timetable
+runs as ONE ``lax.scan`` inside a ``shard_map``. Each slot a device
+executes F, B, or idle via ``lax.switch``:
+
+- **F**: consume the ring-received boundary activation (stage 0: run
+  ``first_fn`` on the raw feed), save it in a size-``pp`` ring (THE 1F1B
+  memory property — at most ``pp`` in-flight microbatches per device),
+  run the stage, ``ppermute`` the result forward.
+- **B**: recompute the stage from the saved input (activation remat),
+  pull the upstream gradient back through ``jax.vjp``, accumulate local
+  parameter grads, ``ppermute`` the input-gradient backward. The last
+  stage seeds the chain from the per-micro loss; stage 0 additionally
+  backprops through ``first_fn``.
+
+Forward and backward interleave in one scan, so peak live boundary
+activations are ``pp`` per device — not ``n_micro`` as in fill-drain
+GPipe — which is exactly what 1F1B buys the reference on GPUs.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import env as _env
+from .pipeline import _live_batch_axes
+
+__all__ = ["make_1f1b_schedule", "pipeline_1f1b_grads"]
+
+_IDLE, _F, _B = 0, 1, 2
+
+
+def make_1f1b_schedule(pp: int, n_micro: int):
+    """Greedy slot assignment of the per-stage 1F1B op sequences under
+    the ring's data dependencies. Returns (op[pp, T], mi[pp, T]) numpy
+    tables: op in {0 idle, 1 F, 2 B}, mi the micro index."""
+    seqs = []
+    for s in range(pp):
+        warm = min(pp - 1 - s, n_micro)
+        seq = [("F", m) for m in range(warm)]
+        b = 0
+        for f in range(warm, n_micro):
+            seq.append(("F", f))
+            seq.append(("B", b))
+            b += 1
+        while b < n_micro:
+            seq.append(("B", b))
+            b += 1
+        seqs.append(seq)
+
+    slot_f, slot_b = {}, {}
+    ptr = [0] * pp
+    op_rows, mi_rows = [], []
+    t = 0
+    limit = 8 * (n_micro + pp) + 16
+    while any(ptr[s] < len(seqs[s]) for s in range(pp)):
+        col_op = [_IDLE] * pp
+        col_mi = [0] * pp
+        commit = []
+        for s in range(pp):
+            if ptr[s] >= len(seqs[s]):
+                continue
+            op, m = seqs[s][ptr[s]]
+            if op == "F":
+                ok = s == 0 or slot_f.get((s - 1, m), limit) < t
+            else:
+                ok = slot_f.get((s, m), limit) < t if s == pp - 1 \
+                    else slot_b.get((s + 1, m), limit) < t
+            if ok:
+                col_op[s] = _F if op == "F" else _B
+                col_mi[s] = m
+                commit.append((s, op, m))
+        for s, op, m in commit:
+            (slot_f if op == "F" else slot_b)[(s, m)] = t
+            ptr[s] += 1
+        op_rows.append(col_op)
+        mi_rows.append(col_mi)
+        t += 1
+        if t > limit:
+            raise RuntimeError("1F1B schedule did not converge "
+                               f"(pp={pp}, n_micro={n_micro})")
+    return (np.array(op_rows, np.int32).T,
+            np.array(mi_rows, np.int32).T)
+
+
+def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
+                        last_fn: Callable, *, first_fn=None,
+                        first_params=None, last_params=None,
+                        last_feeds=None, mesh: Optional[Mesh] = None,
+                        axis: str = "pp",
+                        batch_axes=("dp", "sharding")):
+    """Run one full 1F1B train pass; returns
+    ``(mean_loss, (g_stacked, g_first, g_last))``.
+
+    stage_fn(params_local, h) -> h           (homogeneous stage body)
+    first_fn(first_params, feed_mb) -> h     (stage-0 embed; optional)
+    last_fn(last_params, h, last_feed_mb) -> scalar per-micro loss
+    feeds: [n_micro, mb, ...] raw stage-0 inputs.
+    last_feeds: [n_micro, ...] per-micro labels for last_fn.
+    """
+    mesh = mesh or _env.get_mesh()
+    pp = mesh.shape[axis]
+    nm = feeds.shape[0]
+    op_tab, mi_tab = make_1f1b_schedule(pp, nm)
+    T = op_tab.shape[1]
+
+    batch_spec = _live_batch_axes(mesh, axis, batch_axes, feeds.shape[1])
+    _axes = (batch_spec,) if isinstance(batch_spec, str) \
+        else (batch_spec or ())
+    n_dp = int(np.prod([mesh.shape[a] for a in _axes])) if _axes else 1
+    local_mb = feeds.shape[1] // n_dp
+    feed_spec = P(None, batch_spec, *([None] * (feeds.ndim - 2)))
+    lf_spec = None if last_feeds is None else P(
+        None, batch_spec if last_feeds.shape[1] == feeds.shape[1]
+        else None, *([None] * (last_feeds.ndim - 2)))
+
+    local_feed = jax.ShapeDtypeStruct((local_mb,) + feeds.shape[2:],
+                                      feeds.dtype)
+    if first_fn is not None:
+        h_struct = jax.eval_shape(first_fn, first_params, local_feed)
+    else:
+        h_struct = local_feed
+    h_shape, h_dtype = h_struct.shape, h_struct.dtype
+
+    in_spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+    rep = lambda tree: jax.tree_util.tree_map(
+        lambda x: P(*([None] * jnp.ndim(x))), tree)
+    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
+
+    op_arr = jnp.asarray(op_tab)
+    mi_arr = jnp.asarray(mi_tab)
+
+    def per_device(params_block, mbs, fparams, lparams, lfeeds):
+        params_local = jax.tree_util.tree_map(lambda x: x[0],
+                                              params_block)
+        stage = jax.lax.axis_index(axis)
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        is_first = stage == 0
+        is_last = stage == pp - 1
+
+        zr = lambda: jnp.zeros((pp,) + h_shape, h_dtype)
+        g_mid0 = zeros_like_tree(params_local)
+        g_first0 = zeros_like_tree(fparams)
+        g_last0 = zeros_like_tree(lparams)
+
+        def lf_of(m):
+            return None if lfeeds is None else lfeeds[m]
+
+        # ---- slot bodies (uniform signature) --------------------------
+        def body_idle(oprnd):
+            in_ring, fbuf, gbuf, m = oprnd
+            zeros_h = jnp.zeros(h_shape, h_dtype)
+            return (in_ring, zeros_h, zeros_h, g_mid0, g_first0,
+                    g_last0, jnp.zeros((), jnp.float32))
+
+        def body_F(oprnd):
+            in_ring, fbuf, gbuf, m = oprnd
+            if first_fn is not None:
+                x0 = jax.lax.cond(
+                    is_first, lambda: first_fn(fparams, mbs[m]),
+                    lambda: jnp.zeros(h_shape, h_dtype))
+                x_in = jnp.where(is_first, x0, fbuf[m % pp])
+            else:
+                x_in = jnp.where(is_first, mbs[m].astype(h_dtype),
+                                 fbuf[m % pp])
+            in_ring = in_ring.at[m % pp].set(x_in)
+            # the last stage's F only banks its input: loss + grads are
+            # (re)computed at its B slot
+            y = jax.lax.cond(is_last,
+                             lambda: jnp.zeros(h_shape, h_dtype),
+                             lambda: stage_fn(params_local, x_in))
+            return (in_ring, y, jnp.zeros(h_shape, h_dtype), g_mid0,
+                    g_first0, g_last0, jnp.zeros((), jnp.float32))
+
+        def body_B(oprnd):
+            in_ring, fbuf, gbuf, m = oprnd
+            x_saved = in_ring[m % pp]
+            g_in = gbuf[m % pp]
+
+            def last_case():
+                def loss_of(p_mid, p_last, x):
+                    y = stage_fn(p_mid, x)
+                    return last_fn(p_last, y, lf_of(m)).astype(
+                        jnp.float32)
+                (loss, (gm, gl, gx)) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1, 2))(params_local, lparams,
+                                                x_saved)
+                return gm, g_first0, gl, gx, loss
+
+            def first_case():
+                if first_fn is None:
+                    return mid_case()
+
+                def fwd(p_first, p_mid, feed):
+                    return stage_fn(p_mid, first_fn(p_first, feed))
+                _, pull = jax.vjp(fwd, fparams, params_local, mbs[m])
+                gf, gm, _ = pull(g_in)
+                return gm, gf, g_last0, jnp.zeros(h_shape, h_dtype), \
+                    jnp.zeros((), jnp.float32)
+
+            def mid_case():
+                _, pull = jax.vjp(
+                    lambda p, x: stage_fn(p, x), params_local, x_saved)
+                gm, gx = pull(g_in)
+                return gm, g_first0, g_last0, gx, \
+                    jnp.zeros((), jnp.float32)
+
+            gm, gf, gl, gx, loss = jax.lax.cond(
+                is_last, last_case,
+                lambda: jax.lax.cond(is_first, first_case, mid_case))
+            return (in_ring, jnp.zeros(h_shape, h_dtype), gx, gm, gf,
+                    gl, loss)
+
+        def slot(carry, t):
+            in_ring, fbuf, gbuf, gm_acc, gf_acc, gl_acc, loss_acc = carry
+            op = op_arr[stage, t]
+            m = mi_arr[stage, t]
+            in_ring, send_f, send_g, gm, gf, gl, loss = jax.lax.switch(
+                op, [body_idle, body_F, body_B],
+                (in_ring, fbuf, gbuf, m))
+            # ---- ring communication (every slot, masked by schedule)
+            recv_f = jax.lax.ppermute(send_f, axis, perm_fwd)
+            recv_g = jax.lax.ppermute(send_g, axis, perm_bwd)
+            prev = (stage - 1) % pp
+            nxt = (stage + 1) % pp
+            take_f = (op_arr[prev, t] == _F) & (stage > 0)
+            take_g = (op_arr[nxt, t] == _B) & (stage < pp - 1)
+            fbuf = jnp.where(take_f,
+                             fbuf.at[mi_arr[prev, t] % pp].set(recv_f),
+                             fbuf)
+            gbuf = jnp.where(take_g,
+                             gbuf.at[mi_arr[nxt, t] % pp].set(recv_g),
+                             gbuf)
+            add = jax.tree_util.tree_map
+            return (in_ring, fbuf, gbuf,
+                    add(jnp.add, gm_acc, gm), add(jnp.add, gf_acc, gf),
+                    add(jnp.add, gl_acc, gl),
+                    loss_acc + loss), None
+
+        carry0 = (zr(), zr(), zr(), g_mid0, g_first0, g_last0,
+                  jnp.zeros((), jnp.float32))
+        (in_ring, fbuf, gbuf, gm_acc, gf_acc, gl_acc,
+         loss_acc), _ = jax.lax.scan(slot, carry0, jnp.arange(T))
+
+        # loss: only the last stage accumulated; grads for first/last
+        # params: only their owner stages. dp shards each saw 1/n_dp of
+        # the batch; the loss is the mean over shards.
+        dp_plus_pp = (axis,) + tuple(_axes)
+        loss = jax.lax.psum(loss_acc, dp_plus_pp) / (nm * n_dp)
+        scale = 1.0 / (nm * n_dp)
+        ps = lambda tree, axes: jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axes) * scale, tree)
+        gm_out = jax.tree_util.tree_map(
+            lambda g: (jax.lax.psum(g, tuple(_axes)) * scale
+                       if _axes else g * scale)[None], gm_acc)
+        gf_out = ps(gf_acc, dp_plus_pp)
+        gl_out = ps(gl_acc, dp_plus_pp)
+        return loss, gm_out, gf_out, gl_out
+
+    from .shard_utils import manual_region, shard_map_compat
+    mapped = shard_map_compat(
+        per_device, mesh,
+        (in_spec_params, feed_spec, rep(first_params), rep(last_params),
+         lf_spec),
+        (P(), jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+         rep(first_params), rep(last_params)))
+    with manual_region():
+        loss, g_stacked, g_first, g_last = mapped(
+            stacked_params, feeds, first_params, last_params, last_feeds)
+    return loss, (g_stacked, g_first, g_last)

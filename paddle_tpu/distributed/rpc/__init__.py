@@ -102,13 +102,28 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         "PADDLE_MASTER", "127.0.0.1:8813")
     host, port = master.rsplit(":", 1)
 
+    # Trust model: RPC executes pickled callables from peers, so the
+    # server must only be reachable from the training cluster. Bind to
+    # the interface that routes to the master (like TCPStore's
+    # host-limited bind) — never INADDR_ANY, which would expose an
+    # unauthenticated code-execution endpoint on every interface.
+    # gethostbyname(gethostname()) is wrong here: many distros map the
+    # hostname to 127.0.1.1, which peers cannot reach. A connected UDP
+    # socket towards the master yields the actual routed interface.
+    if host in ("127.0.0.1", "localhost"):
+        my_ip = "127.0.0.1"
+    else:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((host, int(port)))
+            my_ip = probe.getsockname()[0]
+        finally:
+            probe.close()
     server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    server.bind(("0.0.0.0", 0))
+    server.bind((my_ip, 0))
     server.listen(128)
     my_port = server.getsockname()[1]
-    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") \
-        else socket.gethostbyname(socket.gethostname())
 
     store = TCPStore(host=host, port=int(port), is_master=rank == 0,
                      world_size=world_size, timeout=60.0)

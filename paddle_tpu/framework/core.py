@@ -126,10 +126,10 @@ class GradNode:
     """
 
     __slots__ = ("op_name", "vjp_fn", "inputs", "out_refs", "out_shapes",
-                 "out_dtypes", "released")
+                 "out_dtypes", "released", "fwd_fn")
 
     def __init__(self, op_name: str, vjp_fn, inputs: List["Tensor"],
-                 outputs: List["Tensor"]):
+                 outputs: List["Tensor"], fwd_fn=None):
         self.op_name = op_name
         self.vjp_fn = vjp_fn
         self.inputs = inputs
@@ -137,10 +137,15 @@ class GradNode:
         self.out_shapes = [tuple(t._data.shape) for t in outputs]
         self.out_dtypes = [t._data.dtype for t in outputs]
         self.released = False
+        # pure fn over the diff-input arrays; kept so create_graph=True
+        # can re-linearize (jax.vjp) AS A RECORDED OP — the saved
+        # vjp_fn's residuals are constants and cannot express f''(x)
+        self.fwd_fn = fwd_fn
 
     def release(self):
         self.vjp_fn = None
         self.inputs = []
+        self.fwd_fn = None
         self.released = True
 
 
@@ -256,6 +261,16 @@ class Tensor:
 
     def __bool__(self):
         return bool(self.numpy())
+
+    def __index__(self):
+        # lets size-1 integer tensors drive range()/slicing in eager,
+        # matching the reference Tensor's __index__
+        v = self.numpy().item()
+        if not isinstance(v, (int, np.integer, bool, np.bool_)):
+            raise TypeError(
+                f"only integer tensors can be used as an index, got "
+                f"dtype {self.dtype}")
+        return int(v)
 
     def __len__(self):
         if self._data.ndim == 0:
@@ -604,7 +619,7 @@ def apply_jax(op_name: str, fn: Callable, *inputs, n_outputs: int = 1,
         _check_nan_inf(op_name, outs)
     out_tensors = [_wrap_out(o, stop_gradient=False) for o in outs]
     node = GradNode(op_name, vjp_fn, [inputs[i] for i in diff_idx],
-                    out_tensors)
+                    out_tensors, fwd_fn=g)
     for t in out_tensors:
         t.grad_node = node
     if n_outputs == 1 and len(out_tensors) == 1:
@@ -742,6 +757,115 @@ def _fire_hooks(t: "Tensor", g_arr):
     return gt._data
 
 
+def _run_backward_create_graph(tensors, grad_tensors=None, capture=None,
+                               write_leaf_grad=True):
+    """create_graph=True backward: the same queue walk, but every grad is
+    a RECORDED Tensor. Each node's pullback is re-expressed as
+    ``jax.vjp(node.fwd_fn, *inputs)`` applied through ``apply_jax`` — a
+    tape op differentiable in (inputs, upstream grads), which is what
+    grad-of-grad needs (reference: ``egr::RunBackward`` with
+    ``create_graph`` + generated double-grad nodes)."""
+    grad_tensors = grad_tensors or [None] * len(tensors)
+    grads: dict = {}        # id(tensor) -> grad Tensor
+    keepalive: dict = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() on a tensor with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward()")
+            g_t = _wrap_out(jnp.ones_like(t._data))
+        else:
+            g_t = g if isinstance(g, Tensor) else _wrap_out(as_jax(g))
+        prev = grads.get(id(t))
+        grads[id(t)] = g_t if prev is None else prev + g_t
+        keepalive[id(t)] = t
+        if t.grad_node is None:
+            if write_leaf_grad:
+                _accumulate_leaf_tensor(t, grads[id(t)])
+            if capture is not None and id(t) in capture:
+                capture[id(t)] = grads[id(t)]
+        elif t.grad_node.released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time, but "
+                "the saved intermediate results have been freed. Specify "
+                "retain_graph=True the first time.")
+        else:
+            roots.append(t.grad_node)
+
+    if not roots:
+        return
+
+    nodes, pending = _toposort_nodes(roots)
+    ready = [n for n in nodes if pending.get(id(n), 0) == 0]
+    processed = set()
+
+    while ready:
+        node = ready.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        out_grads: list = []
+        for ref, shape, dt in zip(node.out_refs, node.out_shapes,
+                                  node.out_dtypes):
+            t = ref()
+            g = grads.get(id(t)) if t is not None else None
+            if g is None:
+                g = _wrap_out(jnp.zeros(shape, dt))
+            elif t is not None and t._hooks:
+                g = _wrap_out(_fire_hooks(t, as_jax(g)))
+                grads[id(t)] = g
+            out_grads.append(g)
+
+        nx = len(node.inputs)
+        if node.fwd_fn is not None:
+            fwd = node.fwd_fn
+
+            def grad_fn(*args, _fwd=fwd, _nx=nx):
+                xs, gs = args[:_nx], args[_nx:]
+                _, vjp = jax.vjp(_fwd, *xs)
+                return vjp(tuple(gs))
+            res = apply_jax(node.op_name + "_grad", grad_fn,
+                            *node.inputs, *out_grads, n_outputs=nx)
+            in_grads = res if isinstance(res, tuple) else (res,)
+        else:
+            # custom node (PyLayer) without a re-linearizable forward:
+            # grads are correct but constant w.r.t. further differentiation
+            raw = node.vjp_fn(tuple(as_jax(g) for g in out_grads))
+            in_grads = tuple(None if g is None else _wrap_out(g)
+                             for g in raw)
+
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            prev = grads.get(id(t))
+            grads[id(t)] = g if prev is None else prev + g
+            keepalive[id(t)] = t
+            parent = t.grad_node
+            if parent is not None and not parent.released:
+                pending[id(parent)] -= 1
+                if pending[id(parent)] == 0:
+                    ready.append(parent)
+        # create_graph implies retain_graph: nodes are never released
+
+    for tid, t in keepalive.items():
+        if t.grad_node is None and t._hooks and tid in grads:
+            grads[tid] = _wrap_out(_fire_hooks(t, as_jax(grads[tid])))
+        if capture is not None and tid in capture:
+            capture[tid] = grads[tid]
+        if (write_leaf_grad and t.grad_node is None
+                and not t.stop_gradient):
+            _accumulate_leaf_tensor(t, grads[tid])
+
+
+def _accumulate_leaf_tensor(t: "Tensor", g: "Tensor"):
+    t._grad = g if t._grad is None else t._grad + g
+
+
 def _accumulate_leaf(t: Tensor, g_arr):
     if t._grad is None:
         t._grad = _wrap_out(g_arr)
@@ -751,22 +875,25 @@ def _accumulate_leaf(t: Tensor, g_arr):
 
 def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=None,
                    create_graph=False, allow_unused=False):
-    """``paddle.grad`` — like run_backward but returns grads, doesn't write
-    ``.grad``. create_graph (double backward) is supported by replay under
-    jax.vjp in a later milestone; currently raises."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use the functional jax.grad path "
-            "(paddle_tpu.jit / paddle_tpu.incubate.autograd) for higher-order")
+    """``paddle.grad`` — like run_backward but returns grads, doesn't
+    write ``.grad``. With ``create_graph=True`` the returned grads carry
+    their own tape (each pullback re-linearized through ``apply_jax``),
+    so grad-of-grad / gradient penalties work (reference:
+    ``python/paddle/autograd/``)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
 
     capture = {id(t): None for t in inputs}
-    retain = True if retain_graph is None else retain_graph
-    run_backward(outputs, grad_tensors=grad_outputs, retain_graph=retain,
-                 capture=capture, write_leaf_grad=False)
+    if create_graph:
+        _run_backward_create_graph(outputs, grad_tensors=grad_outputs,
+                                   capture=capture, write_leaf_grad=False)
+    else:
+        retain = True if retain_graph is None else retain_graph
+        run_backward(outputs, grad_tensors=grad_outputs,
+                     retain_graph=retain, capture=capture,
+                     write_leaf_grad=False)
     results = []
     for t in inputs:
         g = capture[id(t)]
@@ -777,7 +904,7 @@ def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "allow_unused=True to return None for it")
             results.append(None)
         else:
-            results.append(_wrap_out(g))
+            results.append(g if isinstance(g, Tensor) else _wrap_out(g))
     return results
 
 

@@ -23,7 +23,7 @@ from ..framework.core import (Tensor, as_jax, _wrap_out, functional_mode,
 from ..static import InputSpec
 
 __all__ = ["to_static", "not_to_static", "enable_to_static", "save", "load",
-           "TrainStep", "ignore_module", "TranslatedLayer"]
+           "TrainStep", "ignore_module", "TranslatedLayer", "dy2static"]
 
 _to_static_enabled = True
 
@@ -92,61 +92,134 @@ class StaticFunction:
         self._jitted = None
         functools.update_wrapper(self, fn)
 
-    def _build(self):
+    def _traced_fn(self):
+        """Control-flow-converted callable (dy2static AST transform) or
+        the original when conversion is impossible."""
+        if not hasattr(self, "_conv_fn"):
+            try:
+                from .dy2static import convert_to_static
+                self._conv_fn = convert_to_static(self._fn)
+            except Exception:
+                self._conv_fn = None
+        return self._conv_fn or self._fn
+
+    def _build(self, treedef, dyn_idx, statics):
+        """jit specialized on the (treedef, static-leaf) signature —
+        python scalars/strings/None stay python values during the trace
+        (the reference specializes the same way), only tensors are
+        traced."""
         binder = self._binder
+        traced = self._traced_fn()
+
+        def rebuild(dyn_arrays):
+            flat = list(statics)
+            for pos, arr in zip(dyn_idx, dyn_arrays):
+                flat[pos] = _wrap_out(arr)
+            return jax.tree_util.tree_unflatten(treedef, flat)
 
         if binder is not None:
-            def pure(param_arrays, buffer_arrays, args, kwargs):
+            def pure(param_arrays, buffer_arrays, dyn_arrays):
+                args, kwargs = rebuild(dyn_arrays)
                 out, new_buffers = binder.call(param_arrays, buffer_arrays,
-                                               args, kwargs, fn=self._fn)
+                                               args, kwargs, fn=traced)
                 return _tree_to_arrays(out), new_buffers
         else:
-            def pure(param_arrays, buffer_arrays, args, kwargs):
-                # hand the user fn Tensors (not raw tracers) so the
-                # paddle API surface — including failure modes like
-                # .numpy() mid-trace — behaves as in eager
+            def pure(param_arrays, buffer_arrays, dyn_arrays):
+                args, kwargs = rebuild(dyn_arrays)
                 with functional_mode(), no_grad():
-                    out = self._fn(*_tree_to_tensors(args),
-                                   **_tree_to_tensors(kwargs))
+                    out = traced(*args, **kwargs)
                 return _tree_to_arrays(out), []
         return jax.jit(pure)
+
+    @staticmethod
+    def _partition(args, kwargs):
+        """Flatten (args, kwargs) stopping at Tensors; split leaves into
+        traced arrays (tensors) and static python values."""
+        flat, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        dyn_idx, dyn_arrays, statics = [], [], []
+        for i, leaf in enumerate(flat):
+            if isinstance(leaf, (Tensor, jax.Array, np.ndarray)):
+                dyn_idx.append(i)
+                dyn_arrays.append(as_jax(leaf))
+                statics.append(None)        # placeholder
+            else:
+                statics.append(leaf)
+        return treedef, tuple(dyn_idx), statics, dyn_arrays
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled or getattr(self, "_fallback", False):
             return self._fn(*args, **kwargs)
+        treedef, dyn_idx, statics, dyn_arrays = self._partition(args,
+                                                                kwargs)
+        try:
+            key = (treedef, dyn_idx,
+                   tuple((i, s) for i, s in enumerate(statics)
+                         if i not in dyn_idx))
+            hash(key)
+        except TypeError:
+            # an unhashable non-tensor arg cannot key the compile cache;
+            # re-jitting every call would silently pay full compilation
+            # per invocation — run eagerly instead (with a warning)
+            import warnings
+            if not getattr(self, "_unhashable_warned", False):
+                warnings.warn(
+                    f"to_static: {getattr(self._fn, '__name__', '?')} "
+                    "received an unhashable non-tensor argument; running "
+                    "eagerly (cannot cache a compiled program for it)")
+                self._unhashable_warned = True
+            return self._fn(*args, **kwargs)
         if self._jitted is None:
-            self._jitted = self._build()
-        args_arrays = _tree_to_arrays(args)
-        kwargs_arrays = _tree_to_arrays(kwargs)
+            self._jitted = {}
+        jitted = self._jitted.get(key)
+        if jitted is None:
+            jitted = self._build(treedef, dyn_idx, statics)
+            self._jitted[key] = jitted
         if self._binder is not None:
             p = self._binder.param_arrays()
             b = self._binder.buffer_arrays()
         else:
             p, b = [], []
         try:
-            out, new_buffers = self._jitted(p, b, args_arrays,
-                                            kwargs_arrays)
+            out, new_buffers = jitted(p, b, dyn_arrays)
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError,
                 jax.errors.ConcretizationTypeError) as exc:
-            # SOT-style graph break (reference: jit/sot graph-break
-            # fallback): the function does something untraceable (Python
-            # control flow on tensor values, .numpy() mid-graph, ...) —
-            # run it eagerly from now on instead of crashing. Use
-            # paddle.static.nn.cond/while_loop to make it compilable.
-            import warnings
-            warnings.warn(
-                f"to_static: {getattr(self._fn, '__name__', self._fn)} "
-                f"is not traceable ({type(exc).__name__}); falling back "
-                "to eager execution. Use paddle.static.nn.cond/"
-                "while_loop for data-dependent control flow.")
-            self._fallback = True
-            return self._fn(*args, **kwargs)
+            return self._graph_break(exc, type(exc).__name__, args, kwargs)
+        except Exception as exc:
+            from .dy2static import Dy2StUnsupported
+            if isinstance(exc, Dy2StUnsupported) or isinstance(
+                    getattr(exc, "__cause__", None), Dy2StUnsupported):
+                reason = exc if isinstance(exc, Dy2StUnsupported) \
+                    else exc.__cause__
+                return self._graph_break(reason, "Dy2StUnsupported",
+                                         args, kwargs)
+            raise
         if self._binder is not None:
             for (_, buf), arr in zip(self._binder.buffer_items, new_buffers):
                 buf._data = arr
         return _tree_to_tensors(out)
+
+    def _graph_break(self, exc, kind, args, kwargs):
+        # graph break (reference: jit/sot graph-break fallback): part of
+        # the function is genuinely untraceable even after the dy2static
+        # conversion — record a per-break report entry and run eagerly
+        # from now on instead of crashing.
+        import warnings
+        from . import dy2static as _d2s
+        name = getattr(self._fn, "__name__", str(self._fn))
+        _d2s.record_break(name, 0, f"{kind}: {exc}")
+        breaks = [b for b in _d2s.graph_break_report()
+                  if b["function"].split(".")[-1] == name.split(".")[-1]]
+        detail = "; ".join(f"line {b['lineno']}: {b['reason']}"
+                           for b in breaks[-3:])
+        warnings.warn(
+            f"to_static: {name} is not fully traceable; falling back "
+            f"to eager execution. Graph breaks: {detail or kind}. "
+            "See paddle.jit.dy2static.graph_break_report() for details.")
+        self._fallback = True
+        return self._fn(*args, **kwargs)
 
     # paddle API surface
     @property
@@ -193,6 +266,27 @@ class TrainStep:
             donate = bool(get_flag("FLAGS_paddle_tpu_donate_buffers"))
         self._donate = donate
 
+    def _layer_caller(self):
+        """Callable for the traced forward: the layer through its hooks,
+        with a dy2static-converted forward when one is available (so
+        data-dependent python control flow compiles inside the whole-step
+        jit instead of erroring)."""
+        layer = self.layer
+        fwd = layer.__dict__.get("forward", None)
+        base = getattr(fwd, "_fn", fwd)       # unwrap StaticFunction
+        if base is None:
+            base = type(layer).forward.__get__(layer, type(layer))
+        conv = None
+        try:
+            from .dy2static import convert_to_static
+            conv = convert_to_static(base)
+        except Exception:
+            conv = None
+        if conv is None and fwd is None:
+            return None                       # plain path: call the layer
+        from .dy2static.convert_operators import _patched_layer_call
+        return _patched_layer_call(layer, conv or base)
+
     # -- optimizer state as a pytree -----------------------------------
     def _init_opt_state(self):
         states = []
@@ -213,6 +307,7 @@ class TrainStep:
         binder = self.binder
         loss_fn = self.loss_fn
         opt = self.optimizer
+        fwd_fn = self._layer_caller()
         trainable = [not p.stop_gradient for _, p in binder.param_items]
 
         def step(param_arrays, opt_states, buffer_arrays, lr, rng_key,
@@ -234,7 +329,7 @@ class TrainStep:
                 labels = kwargs.pop("_labels", ())
                 try:
                     out, new_buffers = binder.call(full, buffer_arrays,
-                                                   args, kwargs)
+                                                   args, kwargs, fn=fwd_fn)
                     loss = loss_fn(out, args, {"_labels": labels, **kwargs})
                 finally:
                     set_functional_key(None)
@@ -459,3 +554,6 @@ def load(path, **configs):
         with open(model_path, "rb") as f:
             exported = jexport.deserialize(f.read())
     return TranslatedLayer(state, meta, exported)
+
+
+from . import dy2static  # noqa: E402  (graph-break report API)

@@ -1,0 +1,489 @@
+"""Runtime converters for dy2static-transformed code (reference:
+``python/paddle/jit/dy2static/convert_operators.py`` — ``convert_ifelse``,
+``convert_while_loop``, ``convert_logical_and`` ...).
+
+Each converter dispatches at call time:
+
+- **concrete** (python bools / concrete arrays): execute plain Python —
+  the transformed function behaves exactly like the original in eager
+  mode and for non-data-dependent predicates under trace;
+- **traced** (the predicate is a jax tracer): lower to the XLA-native
+  structure — ``jnp.where``-merged branches for ``if``,
+  ``lax.while_loop`` for ``while``/dynamic ``for`` — so data-dependent
+  control flow COMPILES instead of graph-breaking.
+
+A construct the tracer genuinely cannot express (loop-carried shape
+changes, non-tensor values diverging across tensor branches) raises
+:class:`Dy2StUnsupported`; ``StaticFunction`` catches it, records a
+graph-break report entry, and falls back to eager for that function.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["IfElse", "While", "ForRange", "And", "Or", "Not", "NotAny",
+           "PyBool", "Undefined", "Dy2StUnsupported"]
+
+
+class Dy2StUnsupported(Exception):
+    """The construct cannot be compiled; caller should graph-break."""
+
+
+class _UndefinedVar:
+    """Sentinel for 'this local may be unbound here' (reference:
+    ``dy2static/utils.py`` UndefinedVar). Any real use raises so bugs
+    surface as graph breaks, not silent garbage."""
+
+    _allowed = {"__class__", "__repr__", "__bool__", "__init__",
+                "__new__", "__eq__", "__ne__", "__hash__", "__str__"}
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def __bool__(self):
+        raise Dy2StUnsupported(
+            "a local variable may be unbound on this path (python would "
+            "raise NameError/UnboundLocalError here)")
+
+    def __getattr__(self, name):
+        raise Dy2StUnsupported(
+            "use of a possibly-unbound local variable (python would "
+            "raise NameError/UnboundLocalError here)")
+
+
+Undefined = _UndefinedVar()
+
+
+def _is_arrayish(v) -> bool:
+    return isinstance(v, (Tensor, jax.Array, np.ndarray)) or \
+        isinstance(v, jax.core.Tracer)
+
+
+def _concrete_bool(v) -> Optional[bool]:
+    """bool(v) if it can be decided now, None if it is traced."""
+    if isinstance(v, _UndefinedVar):
+        raise Dy2StUnsupported("condition reads a possibly-unbound local")
+    if isinstance(v, Tensor):
+        v = as_jax(v)
+    if isinstance(v, jax.core.Tracer):
+        return None
+    if isinstance(v, (jax.Array, np.ndarray)):
+        return bool(np.asarray(v))   # size-1 rule == python semantics
+    return bool(v)
+
+
+def _bool_arr(v):
+    """Coerce a value to a scalar boolean jax array."""
+    if isinstance(v, Tensor):
+        v = as_jax(v)
+    arr = jnp.asarray(v)
+    if arr.dtype != jnp.bool_:
+        arr = arr != 0
+    if arr.size != 1:
+        raise Dy2StUnsupported(
+            f"truth value of a size-{arr.size} tensor is ambiguous in a "
+            "compiled condition (same rule as python bool(tensor))")
+    return jnp.reshape(arr, ())
+
+
+# ---------------------------------------------------------------------------
+# boolean operators (short-circuit preserved for concrete operands)
+# ---------------------------------------------------------------------------
+
+def And(*fns: Callable[[], Any]):
+    acc = None
+    last: Any = True
+    for f in fns:
+        v = f()
+        if acc is not None:
+            acc = jnp.logical_and(acc, _bool_arr(v))
+            continue
+        c = _concrete_bool(v)
+        if c is None:
+            acc = _bool_arr(v)
+        elif not c:
+            return v           # python: `a and b` returns a when falsy
+        else:
+            last = v
+    return last if acc is None else _wrap_out(acc)
+
+
+def Or(*fns: Callable[[], Any]):
+    acc = None
+    last: Any = False
+    for f in fns:
+        v = f()
+        if acc is not None:
+            acc = jnp.logical_or(acc, _bool_arr(v))
+            continue
+        c = _concrete_bool(v)
+        if c is None:
+            acc = _bool_arr(v)
+        elif c:
+            return v           # python: `a or b` returns a when truthy
+        else:
+            last = v
+    return last if acc is None else _wrap_out(acc)
+
+
+def Not(v):
+    c = _concrete_bool(v)
+    if c is None:
+        return _wrap_out(jnp.logical_not(_bool_arr(v)))
+    return not c
+
+
+def NotAny(*flags):
+    """``not (f1 or f2 or ...)`` — guard predicate for early-exit flags."""
+    traced = [f for f in flags if _concrete_bool(f) is None]
+    if not traced:
+        return not any(bool(f) for f in flags)
+    acc = _bool_arr(flags[0])
+    for f in flags[1:]:
+        acc = jnp.logical_or(acc, _bool_arr(f))
+    return _wrap_out(jnp.logical_not(acc))
+
+
+def PyBool(v) -> bool:
+    """True only when v is concretely truthy (False for traced values) —
+    used for real python ``break`` in unrolled for loops."""
+    c = _concrete_bool(v)
+    return bool(c) if c is not None else False
+
+
+def PyAny(*flags) -> bool:
+    return any(PyBool(f) for f in flags)
+
+
+def FinalRet(val, flag, always_returns: bool):
+    """Terminal dispatch for the return-flag machinery: decide what the
+    function actually returns."""
+    c = _concrete_bool(flag)
+    if c is not None:
+        return val if c else None      # fell off the end -> python None
+    if always_returns and not isinstance(val, _UndefinedVar):
+        return val                     # every path returns -> flag moot
+    raise Dy2StUnsupported(
+        "the function returns on some paths of a tensor condition but "
+        "falls off the end on others — XLA needs one return structure")
+
+
+# ---------------------------------------------------------------------------
+# if / else
+# ---------------------------------------------------------------------------
+
+def _merge_one(pred_arr, a, b, name: str):
+    if a is b:
+        return a
+    at, bt = _is_arrayish(a), _is_arrayish(b)
+    if at and bt:
+        aa, bb = as_jax(a), as_jax(b)
+        if aa.shape != bb.shape:
+            # silently broadcasting would change the variable's shape
+            # on the untaken path — a correctness bug, so graph-break
+            raise Dy2StUnsupported(
+                f"variable '{name}' has different shapes {aa.shape} vs "
+                f"{bb.shape} across the branches of a tensor condition "
+                "(XLA needs one static shape)")
+        dt = jnp.result_type(aa, bb)
+        return _wrap_out(jnp.where(pred_arr, aa.astype(dt), bb.astype(dt)))
+    if isinstance(a, _UndefinedVar):
+        return b    # sound: guards ensure the undefined side is never read
+    if isinstance(b, _UndefinedVar):
+        return a
+    if not at and not bt:
+        try:
+            same = bool(a == b)
+        except Exception:
+            same = False
+        if same:
+            return a
+        if isinstance(a, (bool, int, float, complex)) and \
+                isinstance(b, (bool, int, float, complex)):
+            aa, bb = jnp.asarray(a), jnp.asarray(b)
+            dt = jnp.result_type(aa, bb)
+            return _wrap_out(jnp.where(pred_arr, aa.astype(dt),
+                                       bb.astype(dt)))
+        raise Dy2StUnsupported(
+            f"non-tensor variable '{name}' takes different values "
+            f"({a!r} vs {b!r}) across the branches of a tensor condition")
+    # one side tensor, other a python scalar -> promote the scalar
+    scalar = a if not at else b
+    if isinstance(scalar, (bool, int, float, complex)):
+        aa = as_jax(a) if at else jnp.asarray(a)
+        bb = as_jax(b) if bt else jnp.asarray(b)
+        if aa.shape != bb.shape:
+            raise Dy2StUnsupported(
+                f"variable '{name}' is a scalar in one branch but has "
+                f"shape {(aa if at else bb).shape} in the other under a "
+                "tensor condition")
+        dt = jnp.result_type(aa, bb)
+        return _wrap_out(jnp.where(pred_arr, aa.astype(dt), bb.astype(dt)))
+    raise Dy2StUnsupported(
+        f"variable '{name}' is a tensor in one branch but "
+        f"{type(scalar).__name__} in the other under a tensor condition")
+
+
+def IfElse(pred, true_fn, false_fn, init: Tuple, names: Tuple[str, ...]):
+    """``convert_ifelse`` parity. Concrete predicate: run one branch.
+    Traced predicate: run BOTH branches (pure trace) and merge every
+    modified local with ``jnp.where`` — data-dependent dispatch without
+    a graph break."""
+    c = _concrete_bool(pred)
+    if c is not None:
+        out = (true_fn if c else false_fn)(*init)
+        return tuple(out)
+    pred_arr = _bool_arr(pred)
+    try:
+        t_out = tuple(true_fn(*init))
+        f_out = tuple(false_fn(*init))
+    except Dy2StUnsupported:
+        raise
+    except Exception as exc:
+        # a speculatively-executed branch raised (data-dependent raise,
+        # assert, host read) — XLA cannot express it; graph-break
+        raise Dy2StUnsupported(
+            f"a branch of a tensor condition raised "
+            f"{type(exc).__name__}: {exc}") from exc
+    return tuple(_merge_one(pred_arr, a, b, n)
+                 for n, a, b in zip(names, t_out, f_out))
+
+
+# ---------------------------------------------------------------------------
+# while
+# ---------------------------------------------------------------------------
+
+def _carry_plan(vals: Tuple, new_vals: Tuple, names: Tuple[str, ...]):
+    """Decide which loop vars ride the ``lax.while_loop`` carry. A slot
+    is carried iff it is array-like before or after one body step; a
+    non-array slot that changes is promoted to an array when numeric,
+    else it is a graph break."""
+    carry_idx: List[int] = []
+    specs: List[Tuple] = []      # (dtype, shape)
+    for i, (old, new) in enumerate(zip(vals, new_vals)):
+        if isinstance(new, _UndefinedVar):
+            if isinstance(old, _UndefinedVar):
+                continue         # never actually bound: leave static
+            raise Dy2StUnsupported(
+                f"loop variable '{names[i]}' becomes unbound inside a "
+                "tensor-condition loop body")
+        if isinstance(old, _UndefinedVar):
+            # body-local temp: always (re)written before any read — the
+            # discovery run from an Undefined entry proved it. Carry it
+            # with a placeholder init that the first iteration overwrites.
+            if _is_arrayish(new):
+                na = as_jax(new) if isinstance(new, Tensor) \
+                    else jnp.asarray(new)
+                carry_idx.append(i)
+                specs.append((na.dtype, na.shape))
+            # non-array temp recomputed per iteration: leave static
+            continue
+        ot, nt = _is_arrayish(old), _is_arrayish(new)
+        if not ot and not nt:
+            if old is new:
+                continue
+            try:
+                if bool(old == new):
+                    continue
+            except Exception:
+                pass
+            if isinstance(old, (bool, int, float, complex)) and \
+                    isinstance(new, (bool, int, float, complex)):
+                ot = nt = True   # promote python numbers that mutate
+            else:
+                raise Dy2StUnsupported(
+                    f"loop variable '{names[i]}' is a non-tensor "
+                    f"({type(old).__name__}) that changes inside a "
+                    "tensor-condition loop")
+        oa = as_jax(old) if isinstance(old, Tensor) else jnp.asarray(old)
+        na = as_jax(new) if isinstance(new, Tensor) else jnp.asarray(new)
+        if oa.shape != na.shape:
+            raise Dy2StUnsupported(
+                f"loop variable '{names[i]}' changes shape "
+                f"{oa.shape} -> {na.shape} across an iteration; XLA "
+                "loop carries need a static shape (pre-allocate and "
+                "update in place instead of growing)")
+        dt = jnp.result_type(oa, na)
+        carry_idx.append(i)
+        specs.append((dt, oa.shape))
+    return carry_idx, specs
+
+
+def While(cond_fn, body_fn, init: Tuple, names: Tuple[str, ...]):
+    """``convert_while_loop`` parity: python loop while the condition is
+    concrete; ``lax.while_loop`` once it is traced."""
+    vals = tuple(init)
+    while True:
+        c = _concrete_bool(cond_fn(*vals))
+        if c is None:
+            break
+        if not c:
+            return vals
+        vals = tuple(body_fn(*vals))
+        if len(vals) != len(init):
+            raise Dy2StUnsupported("loop body changed variable count")
+
+    # ---- traced condition: discovery pass (one eager body run whose ops
+    # are dead code under the outer jit) classifies carry vs static slots
+    try:
+        new_vals = tuple(body_fn(*vals))
+    except Dy2StUnsupported:
+        raise
+    except Exception as exc:
+        raise Dy2StUnsupported(
+            f"the body of a tensor-condition loop raised "
+            f"{type(exc).__name__}: {exc}") from exc
+    carry_idx, specs = _carry_plan(vals, new_vals, names)
+
+    def pack(full):
+        return tuple(
+            jnp.asarray(as_jax(full[i]) if isinstance(full[i], Tensor)
+                        else full[i]).astype(dt).reshape(shp)
+            for i, (dt, shp) in zip(carry_idx, specs))
+
+    def init_pack():
+        out = []
+        for i, (dt, shp) in zip(carry_idx, specs):
+            v = vals[i]
+            if isinstance(v, _UndefinedVar):
+                out.append(jnp.zeros(shp, dt))   # overwritten before read
+            else:
+                a = as_jax(v) if isinstance(v, Tensor) else jnp.asarray(v)
+                out.append(a.astype(dt).reshape(shp))
+        return tuple(out)
+
+    def unpack(carry):
+        full = list(vals)
+        for i, arr in zip(carry_idx, carry):
+            full[i] = _wrap_out(arr)
+        return tuple(full)
+
+    def cond_w(carry):
+        return _bool_arr(cond_fn(*unpack(carry)))
+
+    def body_w(carry):
+        out = tuple(body_fn(*unpack(carry)))
+        return pack(out)
+
+    try:
+        final = jax.lax.while_loop(cond_w, body_w, init_pack())
+    except (TypeError, ValueError) as exc:
+        raise Dy2StUnsupported(
+            f"loop not expressible as lax.while_loop: {exc}") from exc
+    return unpack(final)
+
+
+# ---------------------------------------------------------------------------
+# recursive call conversion (reference: dy2static convert_call)
+# ---------------------------------------------------------------------------
+
+# modules whose code is already trace-safe (or must not be rebuilt)
+_NOCONVERT_PREFIXES = (
+    "paddle_tpu", "jax", "jaxlib", "numpy", "scipy", "torch", "flax",
+    "optax", "orbax", "chex", "einops", "builtins", "math", "functools",
+    "itertools", "collections", "typing", "os", "sys", "re", "abc",
+    "contextlib", "threading", "logging", "pickle", "copy", "warnings",
+    "random", "dataclasses", "enum", "inspect", "ast", "textwrap",
+)
+
+
+def Call(fn):
+    """Wrap user call sites: attempt control-flow conversion of the
+    callee (cached per code object), fall through to the original when
+    conversion is impossible. Framework/library callees pass through
+    untouched."""
+    import types as _types
+    try:
+        from ...nn.layer.layers import Layer as _Layer
+        if isinstance(fn, _Layer):
+            fwd = fn.__dict__.get("forward", None)
+            base = getattr(fwd, "_fn", fwd) or \
+                type(fn).forward.__get__(fn, type(fn))
+            from . import convert_to_static
+            conv = convert_to_static(base)
+            if conv is None:
+                return fn
+            return _patched_layer_call(fn, conv)
+        if isinstance(fn, (_types.FunctionType, _types.MethodType)):
+            mod = getattr(fn, "__module__", "") or ""
+            # top-level module match only: "mathutils" must not match
+            # "math", so compare the first dotted component exactly
+            if mod.split(".")[0] in _NOCONVERT_PREFIXES:
+                return fn
+            from . import convert_to_static
+            return convert_to_static(fn) or fn
+    except Dy2StUnsupported:
+        raise
+    except Exception:
+        pass
+    return fn
+
+
+def _patched_layer_call(layer, conv_forward):
+    """Call a Layer through its hooks with a converted forward."""
+    _MISSING = object()
+
+    def call(*args, **kwargs):
+        prev = layer.__dict__.get("forward", _MISSING)
+        layer.__dict__["forward"] = conv_forward
+        try:
+            return layer(*args, **kwargs)
+        finally:
+            if prev is _MISSING:
+                layer.__dict__.pop("forward", None)
+            else:
+                layer.__dict__["forward"] = prev
+    return call
+
+
+def ForRange(bounds: Tuple, body_fn, init: Tuple, names: Tuple[str, ...]):
+    """``for i in range(...)`` dispatch: concrete bounds unroll as plain
+    python (keeps reverse-mode AD); traced bounds lower to a counting
+    ``lax.while_loop``."""
+    if len(bounds) == 1:
+        start, stop, step = 0, bounds[0], 1
+    elif len(bounds) == 2:
+        start, stop, step = bounds[0], bounds[1], 1
+    else:
+        start, stop, step = bounds
+    def _traced(v):
+        a = as_jax(v) if isinstance(v, Tensor) else v
+        return isinstance(a, jax.core.Tracer)
+
+    if not any(_traced(b) for b in (start, stop, step)):
+        def _as_int(v):
+            return int(np.asarray(as_jax(v))) if isinstance(v, Tensor) \
+                else int(np.asarray(v)) if _is_arrayish(v) else int(v)
+        vals = tuple(init)
+        for i in range(_as_int(start), _as_int(stop), _as_int(step)):
+            vals = tuple(body_fn(i, *vals))
+        return vals
+
+    # dynamic trip count: counting while_loop over (i, *carry)
+    def to_arr(v):
+        return as_jax(v) if isinstance(v, Tensor) else jnp.asarray(v)
+
+    i0 = _wrap_out(to_arr(start).astype(jnp.int64)
+                   if jax.config.jax_enable_x64
+                   else to_arr(start).astype(jnp.int32))
+    stop_t = _wrap_out(to_arr(stop))
+    step_t = _wrap_out(to_arr(step))
+
+    def cond_fn(i, *vals):
+        return _wrap_out(jnp.where(
+            as_jax(step_t) > 0,
+            as_jax(i) < as_jax(stop_t),
+            as_jax(i) > as_jax(stop_t)))
+
+    def body(i, *vals):
+        out = tuple(body_fn(i, *vals))
+        return (_wrap_out(as_jax(i) + as_jax(step_t)),) + out
+
+    out = While(cond_fn, body, (i0,) + tuple(init), ("__i",) + tuple(names))
+    return out[1:]

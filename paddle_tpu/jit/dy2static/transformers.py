@@ -1,0 +1,564 @@
+"""AST transformers for dy2static (reference:
+``python/paddle/jit/dy2static/transformers/`` — ifelse/loop/logical/
+return transformers over the user function's AST).
+
+Pipeline (applied to one function body, never descending into nested
+``def``/``lambda``/``class``):
+
+1. :class:`EarlyExitPass` — rewrites ``return``/``break``/``continue``
+   that sit inside control flow into flag variables + guards, so the
+   remaining tree is straight-line + ``if``/``while``/``for`` only.
+2. undefined-local pre-initialisation — any name stored inside a branch
+   or loop body is bound to ``Undefined`` at function entry, making the
+   generated get/set tuples legal exactly where python itself would have
+   an unbound local.
+3. :class:`ControlFlowPass` (post-order) — replaces ``if``/``while``/
+   ``for range(...)`` with calls into
+   :mod:`.convert_operators` (``__dy2st.IfElse/While/ForRange``) whose
+   branch/body closures take the modified locals as parameters and
+   return them, keeping every rebinding visible to the AST; also lowers
+   ``and``/``or``/``not`` to their lazy converter forms.
+
+The output is ordinary python that behaves identically in eager mode
+(concrete predicates take the plain-python paths in the converters) and
+compiles data-dependent control flow under trace.
+"""
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import List, Optional, Sequence, Set, Tuple
+
+_JST = "__dy2st"
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef, ast.ListComp, ast.SetComp, ast.DictComp,
+                   ast.GeneratorExp)
+
+
+# ---------------------------------------------------------------------------
+# small AST builders
+# ---------------------------------------------------------------------------
+
+def _jst(name: str) -> ast.Attribute:
+    return ast.Attribute(value=ast.Name(id=_JST, ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _call(name: str, args: Sequence[ast.expr]) -> ast.Call:
+    return ast.Call(func=_jst(name), args=list(args), keywords=[])
+
+
+def _name_load(n: str) -> ast.Name:
+    return ast.Name(id=n, ctx=ast.Load())
+
+
+def _name_store(n: str) -> ast.Name:
+    return ast.Name(id=n, ctx=ast.Store())
+
+
+def _tuple_load(names: Sequence[str]) -> ast.Tuple:
+    return ast.Tuple(elts=[_name_load(n) for n in names], ctx=ast.Load())
+
+
+def _str_tuple(names: Sequence[str]) -> ast.Tuple:
+    return ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                     ctx=ast.Load())
+
+
+def _assign(name: str, value: ast.expr) -> ast.Assign:
+    return ast.Assign(targets=[_name_store(name)], value=value)
+
+
+def _lambda0(body: ast.expr) -> ast.Lambda:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=body)
+
+
+def _make_func(name: str, params: Sequence[str],
+               body: List[ast.stmt]) -> ast.FunctionDef:
+    return ast.FunctionDef(
+        name=name,
+        args=ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=p) for p in params],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[]),
+        body=body, decorator_list=[], returns=None, type_params=[])
+
+
+# ---------------------------------------------------------------------------
+# name analysis
+# ---------------------------------------------------------------------------
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names bound (Store/Del/import/for-target/with-as) in a statement
+    list, not descending into nested scopes."""
+
+    def __init__(self):
+        self.names: Set[str] = set()
+
+    def visit(self, node):
+        if isinstance(node, _SCOPE_BARRIERS):
+            # the nested scope's stores are its own; but a nested def's
+            # NAME binds in this scope
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self.names.add(node.name)
+            return
+        super().visit(node)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self.names.add((a.asname or a.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+    def visit_ExceptHandler(self, node):
+        # `except E as e`: e is scoped to the handler; skip the name but
+        # walk the body
+        for s in node.body:
+            self.visit(s)
+
+
+def stores_in(stmts: Sequence[ast.stmt]) -> Set[str]:
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _ExitScanner(ast.NodeVisitor):
+    """Find Return/Break/Continue relevant to one nesting level."""
+
+    def __init__(self):
+        self.has_return = False
+        self.has_break = False
+        self.has_continue = False
+        self._loop_depth = 0
+
+    def visit(self, node):
+        if isinstance(node, _SCOPE_BARRIERS):
+            return
+        super().visit(node)
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.has_break = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.has_continue = True
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = _loop
+    visit_For = _loop
+
+
+def scan_exits(stmts: Sequence[ast.stmt]) -> "_ExitScanner":
+    s = _ExitScanner()
+    for st in stmts:
+        s.visit(st)
+    return s
+
+
+def _has_nested_return(stmts: Sequence[ast.stmt]) -> bool:
+    """True if a Return sits inside a compound statement (depth >= 1)."""
+    for s in stmts:
+        if isinstance(s, (ast.If, ast.While, ast.For, ast.With, ast.Try)):
+            if scan_exits([s]).has_return:
+                return True
+    return False
+
+
+def _always_returns(stmts: Sequence[ast.stmt]) -> bool:
+    """Conservative: True if every path through the block ends in
+    return/raise (used to decide whether a traced return-flag is moot)."""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(s, ast.If) and s.orelse \
+                and _always_returns(s.body) and _always_returns(s.orelse):
+            return True
+        if isinstance(s, ast.With) and _always_returns(s.body):
+            return True
+    return False
+
+
+# generated branch/body closures are re-defined where they are used and
+# must never be threaded as data through converter calls
+_GEN_FUNC_PREFIXES = ("__dy2st_true_", "__dy2st_false_", "__dy2st_cond_",
+                      "__dy2st_body_", "__dy2st_forbody_")
+
+
+def _thread_names(*stmt_lists: Sequence[ast.stmt]) -> List[str]:
+    names: Set[str] = set()
+    for stmts in stmt_lists:
+        names |= stores_in(stmts)
+    return sorted(n for n in names
+                  if not n.startswith(_GEN_FUNC_PREFIXES))
+
+
+# ---------------------------------------------------------------------------
+# pass 1: early exits -> flags + guards
+# ---------------------------------------------------------------------------
+
+class UnsupportedConstruct(Exception):
+    """Transform-time graph break (records reason + line)."""
+
+    def __init__(self, reason: str, lineno: int = 0):
+        super().__init__(reason)
+        self.reason = reason
+        self.lineno = lineno
+
+
+class EarlyExitPass:
+    RET_VAL = "__dy2st_ret"
+    RET_FLAG = "__dy2st_ret_set"
+
+    def __init__(self):
+        self._count = itertools.count()
+        self.ret_active = False
+
+    def run(self, func: ast.FunctionDef) -> None:
+        self.ret_active = _has_nested_return(func.body)
+        always = _always_returns(func.body)
+        ctx_loops: List[Tuple[Optional[str], Optional[str]]] = []
+        body, _ = self._block(func.body, ctx_loops)
+        if self.ret_active:
+            body = [_assign(self.RET_VAL, _jst("Undefined")),
+                    _assign(self.RET_FLAG, ast.Constant(value=False))] + \
+                body + [ast.Return(value=_call("FinalRet", [
+                    _name_load(self.RET_VAL), _name_load(self.RET_FLAG),
+                    ast.Constant(value=always)]))]
+        func.body = body
+
+    # -- statement-list transform with guard insertion ------------------
+    def _block(self, stmts, loops) -> Tuple[List[ast.stmt], Set[str]]:
+        out: List[ast.stmt] = []
+        flags_all: Set[str] = set()
+        for idx, s in enumerate(stmts):
+            new_s, flags = self._stmt(s, loops)
+            out.extend(new_s)
+            flags_all |= flags
+            if flags and idx < len(stmts) - 1:
+                rest, rest_flags = self._block(stmts[idx + 1:], loops)
+                flags_all |= rest_flags
+                out.append(ast.If(
+                    test=_call("NotAny",
+                               [_name_load(f) for f in sorted(flags)]),
+                    body=rest, orelse=[]))
+                break
+        return out, flags_all
+
+    def _stmt(self, s, loops) -> Tuple[List[ast.stmt], Set[str]]:
+        if isinstance(s, ast.Return):
+            if not self.ret_active:
+                return [s], set()
+            val = s.value if s.value is not None else ast.Constant(value=None)
+            return ([_assign(self.RET_VAL, val),
+                     _assign(self.RET_FLAG, ast.Constant(value=True))],
+                    {self.RET_FLAG})
+        if isinstance(s, ast.Break):
+            if not loops or loops[-1][0] is None:
+                return [s], set()
+            return [_assign(loops[-1][0], ast.Constant(value=True))], \
+                {loops[-1][0]}
+        if isinstance(s, ast.Continue):
+            if not loops or loops[-1][1] is None:
+                return [s], set()
+            return [_assign(loops[-1][1], ast.Constant(value=True))], \
+                {loops[-1][1]}
+        if isinstance(s, ast.If):
+            s.body, f1 = self._block(s.body, loops)
+            s.orelse, f2 = self._block(s.orelse, loops)
+            return [s], f1 | f2
+        if isinstance(s, (ast.While, ast.For)):
+            return self._loop(s, loops)
+        if isinstance(s, ast.With):
+            s.body, f = self._block(s.body, loops)
+            return [s], f
+        if isinstance(s, ast.Try):
+            s.body, f1 = self._block(s.body, loops)
+            s.orelse, f2 = self._block(s.orelse, loops)
+            s.finalbody, f3 = self._block(s.finalbody, loops)
+            fh: Set[str] = set()
+            for h in s.handlers:
+                h.body, f = self._block(h.body, loops)
+                fh |= f
+            return [s], f1 | f2 | f3 | fh
+        return [s], set()
+
+    def _loop(self, s, loops) -> Tuple[List[ast.stmt], Set[str]]:
+        scan = scan_exits(s.body)
+        n = next(self._count)
+        brk = f"__dy2st_brk_{n}" if scan.has_break else None
+        cont = f"__dy2st_cont_{n}" if scan.has_continue else None
+        ret = self.RET_FLAG if (self.ret_active and scan.has_return) \
+            else None
+
+        body, _ = self._block(s.body, loops + [(brk, cont)])
+        if cont:
+            body = [_assign(cont, ast.Constant(value=False))] + body
+
+        pre: List[ast.stmt] = []
+        if brk:
+            pre.append(_assign(brk, ast.Constant(value=False)))
+
+        exit_flags = [f for f in (brk, ret) if f]
+        post: List[ast.stmt] = []
+        if isinstance(s, ast.While):
+            if exit_flags:
+                s.test = _call("And", [
+                    _lambda0(_call("NotAny",
+                                   [_name_load(f) for f in exit_flags])),
+                    _lambda0(s.test)])
+            s.body = body
+        else:  # For: guard the whole body, real break when concrete
+            if exit_flags:
+                body = [ast.If(
+                    test=_call("NotAny",
+                               [_name_load(f) for f in exit_flags]),
+                    body=body, orelse=[])]
+                body.append(ast.If(
+                    test=_call("PyAny",
+                               [_name_load(f) for f in exit_flags]),
+                    body=[ast.Break()], orelse=[]))
+            s.body = body
+
+        orelse = s.orelse
+        s.orelse = []
+        out = pre + [s]
+        if orelse:
+            orelse2, f_else = self._block(orelse, loops)
+            if brk:
+                out.append(ast.If(test=_call("NotAny", [_name_load(brk)]),
+                                  body=orelse2, orelse=[]))
+            else:
+                out.extend(orelse2)
+        else:
+            f_else = set()
+        # ret flag escapes the loop; brk/cont stay local
+        esc = ({ret} if ret else set()) | f_else
+        return out, esc
+
+
+# ---------------------------------------------------------------------------
+# pass 2: undefined-local pre-init
+# ---------------------------------------------------------------------------
+
+def insert_undefined_inits(func: ast.FunctionDef) -> None:
+    candidates: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit(self, node):
+            if isinstance(node, _SCOPE_BARRIERS):
+                return
+            super().visit(node)
+
+        def visit_If(self, node):
+            candidates.update(stores_in(node.body))
+            candidates.update(stores_in(node.orelse))
+            self.generic_visit(node)
+
+        def visit_While(self, node):
+            candidates.update(stores_in(node.body))
+            self.generic_visit(node)
+
+        visit_For = visit_While
+
+    for s in func.body:
+        V().visit(s)
+
+    params = {a.arg for a in (func.args.posonlyargs + func.args.args
+                              + func.args.kwonlyargs)}
+    if func.args.vararg:
+        params.add(func.args.vararg.arg)
+    if func.args.kwarg:
+        params.add(func.args.kwarg.arg)
+    inits = [_assign(n, _jst("Undefined"))
+             for n in sorted(candidates - params)]
+    func.body = inits + func.body
+
+
+# ---------------------------------------------------------------------------
+# pass 3: control flow -> converter calls (post-order)
+# ---------------------------------------------------------------------------
+
+class ControlFlowPass(ast.NodeTransformer):
+    def __init__(self):
+        self._count = itertools.count()
+
+    # nested scopes keep their original python semantics
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_ListComp = visit_FunctionDef
+    visit_SetComp = visit_FunctionDef
+    visit_DictComp = visit_FunctionDef
+    visit_GeneratorExp = visit_FunctionDef
+
+    # -- recursive call conversion (dy2static convert_call parity) ------
+    _NOWRAP_NAMES = {
+        "range", "len", "enumerate", "zip", "isinstance", "issubclass",
+        "print", "super", "type", "int", "float", "bool", "str", "list",
+        "tuple", "dict", "set", "frozenset", "min", "max", "abs", "sum",
+        "getattr", "setattr", "hasattr", "repr", "id", "iter", "next",
+        "sorted", "reversed", "map", "filter", "all", "any", "round",
+        "divmod", "format", "vars", "locals", "globals", "callable",
+    }
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in self._NOWRAP_NAMES:
+            return node
+        node.func = _call("Call", [node.func])
+        return node
+
+    # -- boolean operators ---------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "And" if isinstance(node.op, ast.And) else "Or"
+        return _call(op, [_lambda0(v) for v in node.values])
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return _call("Not", [node.operand])
+        return node
+
+    # -- if -------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        n = next(self._count)
+        names = _thread_names(node.body, node.orelse)
+        tname, fname = f"__dy2st_true_{n}", f"__dy2st_false_{n}"
+        ret = ast.Return(value=_tuple_load(names))
+        tdef = _make_func(tname, names, list(node.body) + [ret])
+        fdef = _make_func(fname, names,
+                          (list(node.orelse) or [ast.Pass()])
+                          + [ast.Return(value=_tuple_load(names))])
+        call = _call("IfElse", [node.test, _name_load(tname),
+                                _name_load(fname), _tuple_load(names),
+                                _str_tuple(names)])
+        if names:
+            stmt = ast.Assign(
+                targets=[ast.Tuple(elts=[_name_store(x) for x in names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            stmt = ast.Expr(value=call)
+        return [tdef, fdef, stmt]
+
+    # -- while ----------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        n = next(self._count)
+        names = _thread_names(node.body)
+        cname, bname = f"__dy2st_cond_{n}", f"__dy2st_body_{n}"
+        cdef = _make_func(cname, names, [ast.Return(value=node.test)])
+        bdef = _make_func(bname, names,
+                          list(node.body)
+                          + [ast.Return(value=_tuple_load(names))])
+        call = _call("While", [_name_load(cname), _name_load(bname),
+                               _tuple_load(names), _str_tuple(names)])
+        if names:
+            stmt = ast.Assign(
+                targets=[ast.Tuple(elts=[_name_store(x) for x in names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            stmt = ast.Expr(value=call)
+        return [cdef, bdef, stmt] + list(node.orelse)
+
+    # -- for range(...) --------------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and isinstance(node.target, ast.Name)):
+            return node   # plain python for (unrolls under trace)
+        n = next(self._count)
+        names = [x for x in _thread_names(node.body)
+                 if x != node.target.id]
+        bname = f"__dy2st_forbody_{n}"
+        bdef = _make_func(bname, [node.target.id] + names,
+                          list(node.body)
+                          + [ast.Return(value=_tuple_load(names))])
+        call = _call("ForRange", [
+            ast.Tuple(elts=list(it.args), ctx=ast.Load()),
+            _name_load(bname), _tuple_load(names), _str_tuple(names)])
+        if names:
+            stmt = ast.Assign(
+                targets=[ast.Tuple(elts=[_name_store(x) for x in names],
+                                   ctx=ast.Store())],
+                value=call)
+        else:
+            stmt = ast.Expr(value=call)
+        return [bdef, stmt] + list(node.orelse)
+
+
+# ---------------------------------------------------------------------------
+# orchestration
+# ---------------------------------------------------------------------------
+
+class _SyntaxGate(ast.NodeVisitor):
+    """Constructs the transform cannot honor -> UnsupportedConstruct."""
+
+    def visit(self, node):
+        if isinstance(node, _SCOPE_BARRIERS):
+            return
+        super().visit(node)
+
+    def visit_Global(self, node):
+        raise UnsupportedConstruct(
+            "`global` declarations cannot thread through branch "
+            "closures", node.lineno)
+
+    def visit_Nonlocal(self, node):
+        raise UnsupportedConstruct(
+            "`nonlocal` declarations cannot thread through branch "
+            "closures", node.lineno)
+
+    def visit_Yield(self, node):
+        raise UnsupportedConstruct("generator functions are not "
+                                   "convertible", node.lineno)
+
+    visit_YieldFrom = visit_Yield
+
+    def visit_Await(self, node):
+        raise UnsupportedConstruct("async code is not convertible",
+                                   node.lineno)
+
+
+def transform_function(func: ast.FunctionDef) -> ast.FunctionDef:
+    """Apply the full pipeline to one FunctionDef in place."""
+    for s in func.body:
+        _SyntaxGate().visit(s)
+    EarlyExitPass().run(func)
+    insert_undefined_inits(func)
+    cf = ControlFlowPass()
+    func.body = [n for s in func.body
+                 for n in (lambda r: r if isinstance(r, list) else [r])(
+                     cf.visit(s))]
+    func.decorator_list = []
+    return func

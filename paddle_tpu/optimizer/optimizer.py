@@ -138,9 +138,65 @@ class Optimizer:
 
     def minimize_impl(self, loss, startup_program=None, parameters=None,
                       no_grad_set=None):
+        from ..static.program import SymbolicTensor
+        if isinstance(loss, SymbolicTensor):
+            return self._minimize_static(loss, parameters, no_grad_set)
         loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None, no_grad_set=None):
+        """Static-graph minimize: append backward + parameter-update
+        entries to the Program (reference: ``Optimizer.minimize`` adding
+        grad and optimizer OpDescs; here the update rule records as a
+        symbolic node and ``Executor.run`` writes results back)."""
+        from ..framework.core import _wrap_out
+        from ..static.program import (append_backward, record_static_op,
+                                      default_main_program)
+        params = parameters if parameters is not None \
+            else self._parameter_list
+        params_grads = append_backward(loss, parameter_list=params,
+                                       no_grad_set=no_grad_set)
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        prog = default_main_program()
+        if not hasattr(self, "_static_state"):
+            self._static_state = {}
+        # LR enters the update node as a RUNTIME input re-read from the
+        # optimizer on every Executor.run — a python-float get_lr()
+        # inside the traced update would bake the initial LR and
+        # silently ignore schedulers
+        lr_tensor = _LiveLR(self)
+        for p, g_sym in params_grads:
+            state = self._state_for(p)
+            keys = sorted(state)
+            wraps = self._static_state.setdefault(
+                id(p), {k: _wrap_out(jnp.asarray(state[k]))
+                        for k in keys})
+            state_tensors = [wraps[k] for k in keys]
+
+            def upd_fn(p_arr, g_arr, lr_arr, *state_arrs,
+                       _keys=tuple(keys), _p=p):
+                self._current_param = _p
+                g_arr = self._apply_decay(_wrap_out(p_arr), g_arr)
+                st = dict(zip(_keys, state_arrs))
+                p_new, s_new = self._update_rule(p_arr, g_arr, st,
+                                                 lr_arr)
+                return (p_new,) + tuple(s_new.get(k, st[k])
+                                        for k in _keys)
+
+            outs = record_static_op(
+                f"{type(self).__name__.lower()}_update", upd_fn,
+                [p, g_sym, lr_tensor] + state_tensors, 1 + len(keys))
+            outs = outs if isinstance(outs, tuple) else (outs,)
+
+            def finalize(vals, _p=p, _keys=tuple(keys)):
+                self._write_state_dict(
+                    _p, dict(zip(_keys, vals[1:])))
+
+            prog._updates.append(
+                ([p] + state_tensors, list(outs), finalize))
+        return None, params_grads
 
     def clear_grad(self, set_to_zero=True):
         for p in self._parameter_list:
@@ -179,6 +235,32 @@ class Optimizer:
 
 
 Optimizer.minimize = Optimizer.minimize_impl
+
+
+class _LiveLR(Tensor):
+    """Scalar learning-rate input for static update nodes: ``_data`` is
+    a property re-reading ``optimizer.get_lr()``, so the Executor (which
+    fetches concrete inputs' arrays at every run) feeds the CURRENT
+    scheduler value into the compiled program as a runtime argument."""
+
+    def __init__(self, opt):
+        self._opt = opt
+        self.stop_gradient = True
+        self.grad_node = None
+        self._grad = None
+        self.name = "learning_rate@LIVE"
+        self.persistable = False
+        self._hooks = None
+        self.is_leaf_override = None
+
+    @property
+    def _data(self):
+        import jax.numpy as _jnp
+        return _jnp.asarray(float(self._opt.get_lr()), _jnp.float32)
+
+    @_data.setter
+    def _data(self, value):
+        pass                      # inputs are never written back
 
 
 class SGD(Optimizer):

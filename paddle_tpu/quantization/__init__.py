@@ -109,27 +109,38 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     def __init__(self, moving_rate=0.9, quant_bits=8, **kw):
         super().__init__(quant_bits)
         self.moving_rate = moving_rate
-        self._state = jnp.zeros((), jnp.float32)
-        self._initialized = False
+        # buffers (not host attrs) so a traced training step threads the
+        # moving-average state functionally, exactly like BN running stats
+        self.register_buffer("_state", _wrap_out(jnp.zeros((), jnp.float32)))
+        self.register_buffer("_inited", _wrap_out(jnp.zeros((), jnp.float32)))
 
     def scales(self):
         return _wrap_out(jnp.maximum(
-            jnp.asarray(self._state, jnp.float32), 1e-9))
+            as_jax(self._state).astype(jnp.float32), 1e-9))
 
     def forward(self, x):
         arr = as_jax(x)
-        # device-side moving average — no per-step host sync; the
-        # scale consumed by QDQ stays one step stale, matching the
-        # reference's moving-average semantics
-        if not isinstance(arr, jax.core.Tracer) and self.training:
+        state = as_jax(self._state).astype(jnp.float32)
+        if self.training:
+            from ..framework.core import in_functional_mode
             cur = jnp.max(jnp.abs(arr)).astype(jnp.float32)
-            if not self._initialized:
-                self._state = cur
-                self._initialized = True
-            else:
-                r = jnp.float32(self.moving_rate)
-                self._state = r * self._state + (1 - r) * cur
-        return super().forward(x)
+            inited = as_jax(self._inited).astype(jnp.float32)
+            r = jnp.float32(self.moving_rate)
+            new_state = jnp.where(inited > 0,
+                                  r * state + (1 - r) * cur, cur)
+            if in_functional_mode() or not isinstance(cur, jax.core.Tracer):
+                self._state._data = new_state
+                self._inited._data = jnp.ones((), jnp.float32)
+            # QDQ with the freshly-blended scale: a whole-step-jitted QAT
+            # model never quantizes against an uninitialized (zero) scale
+            scale = jnp.maximum(new_state, 1e-9)
+        else:
+            scale = jnp.maximum(state, 1e-9)
+
+        def f(a, s):
+            return fake_quant_dequant(a, s.astype(jnp.float32),
+                                      jnp.float32(self.qmax))
+        return apply_jax("fake_quant", f, x, _wrap_out(scale))
 
 
 def quanterize(cls=FakeQuanterWithAbsMaxObserver, **kwargs):

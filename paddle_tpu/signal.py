@@ -66,12 +66,19 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None,
         lpad = (n_fft - win_length) // 2
         w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
 
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (a onesided "
+            "spectrum reconstructs a real signal by construction)")
+
     def f(spec):
         s = jnp.moveaxis(spec, -2, -1)      # [..., frames, bins]
         if normalized:
             s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
         if onesided:
             frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        elif return_complex:
+            frames = jnp.fft.ifft(s, axis=-1)
         else:
             frames = jnp.fft.ifft(s, axis=-1).real
         frames = frames * w

@@ -50,8 +50,8 @@ class InputSpec:
                 f"name={self.name})")
 
 
-from .program import (Executor, Program, SymbolicTensor, data,
-                      default_main_program, default_startup_program,
+from .program import (Executor, Program, SymbolicTensor, append_backward,
+                      data, default_main_program, default_startup_program,
                       global_scope, program_guard, scope_guard)
 
 

@@ -13,10 +13,12 @@ fetches inside ONE ``jax.jit`` program per feed signature — the whole
 Program compiles to a single fused XLA executable, which is the
 InterpreterCore+CINN role collapsed into the compiler.
 
-Scope: inference/forward graphs (feed → ops → fetch). Static-mode
-*training* (append_backward, optimizer ops inside Programs) is not
-supported — use ``paddle.jit.to_static`` / ``TrainStep``, the supported
-compile path for training (SURVEY.md §7.2).
+Training: ``append_backward(loss)`` appends ONE grad super-node that
+re-evaluates the loss sub-DAG under ``jax.grad`` (XLA differentiates
+and fuses it), and ``Optimizer.minimize`` records parameter-update
+nodes in ``Program._updates``; ``Executor.run`` executes them in the
+same jitted program — parameters and optimizer state enter as runtime
+arguments and the updated values are written back each run.
 """
 from __future__ import annotations
 
@@ -30,7 +32,7 @@ from ..framework.core import Tensor, as_jax
 
 __all__ = ["Program", "Executor", "program_guard", "data",
            "default_main_program", "default_startup_program",
-           "SymbolicTensor"]
+           "SymbolicTensor", "append_backward"]
 
 
 class SymbolicTensor(Tensor):
@@ -109,11 +111,17 @@ def record_static_op(op_name, fn, inputs, n_outputs):
 
 class Program:
     """``paddle.static.Program`` parity (a recording namespace; the ops
-    live in the SymbolicTensor DAG)."""
+    live in the SymbolicTensor DAG). ``_updates`` holds optimizer
+    parameter-update entries appended by ``Optimizer.minimize`` —
+    Executor.run executes them (inside the same jitted program) and
+    writes the new values back, which is static-mode training."""
 
     def __init__(self):
         self._feed_vars: Dict[str, SymbolicTensor] = {}
         self._counter = 0
+        # entries: (targets: List[Tensor], out_syms: List[SymbolicTensor],
+        #           finalize: Optional[Callable[[List[jax.Array]], None]])
+        self._updates: List = []
 
     def _next_id(self):
         self._counter += 1
@@ -220,8 +228,17 @@ def _evaluate(t, env, memo):
                 if isinstance(x, SymbolicTensor) and id(x) not in memo:
                     stack.append((x, False))
             continue
-        args = [memo[id(x)] if isinstance(x, SymbolicTensor)
-                else leaf_val(x) for x in inputs]
+        args = []
+        for x in inputs:
+            if isinstance(x, SymbolicTensor):
+                args.append(memo[id(x)])
+            elif isinstance(x, Tensor) and id(x) in memo:
+                # runtime substitution: Executor passes parameters /
+                # optimizer state as jit arguments, not baked constants,
+                # so repeated run() calls see updated values
+                args.append(memo[id(x)])
+            else:
+                args.append(leaf_val(x))
         out = fn(*args)
         # memoize per op NODE (shared by multi-output siblings), so an
         # n-output op traces once, not once per consumed output
@@ -230,9 +247,93 @@ def _evaluate(t, env, memo):
     return memo[id(t)]
 
 
+def _collect_deps(roots):
+    """Walk the DAG from ``roots``: returns (feed placeholders by name,
+    concrete Tensor inputs in deterministic order)."""
+    feeds: Dict[str, SymbolicTensor] = {}
+    concretes: Dict[int, Tensor] = {}
+    seen = set()
+    stack = list(roots)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, SymbolicTensor):
+            if id(t) in seen:
+                continue
+            seen.add(id(t))
+            if t._feed_name is not None:
+                feeds[t._feed_name] = t
+                continue
+            node, _ = t._node
+            _fn, inputs, _n = node
+            stack.extend(x for x in inputs if isinstance(x, Tensor))
+        elif isinstance(t, Tensor):
+            concretes.setdefault(id(t), t)
+    return feeds, list(concretes.values())
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """``paddle.static.append_backward`` parity: append gradient
+    computation for ``loss`` to the Program and return
+    ``[(param, grad_var), ...]``.
+
+    TPU-first: instead of emitting per-op grad OpDescs (reference:
+    ``python/paddle/base/backward.py``), ONE grad super-node re-evaluates
+    the loss sub-DAG as a pure function of (feeds, params) under
+    ``jax.grad`` — XLA differentiates and fuses the whole thing."""
+    from ..framework.core import Parameter
+    feeds, concretes = _collect_deps([loss])
+    if parameter_list is not None:
+        params = [p for p in parameter_list if not p.stop_gradient]
+    else:
+        params = [t for t in concretes
+                  if isinstance(t, Parameter) and not t.stop_gradient]
+    if no_grad_set:
+        drop = {id(t) for t in no_grad_set}
+        params = [p for p in params if id(p) not in drop]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters "
+                         "reachable from the loss")
+    feed_list = list(feeds.values())
+    nf = len(feed_list)
+    np_count = len(params)
+    # every OTHER concrete tensor in the loss DAG (buffers, frozen
+    # params) must also be a runtime input of the grad node — baking
+    # them at trace time while the forward substitutes fresh values
+    # would compute gradients against stale state
+    pids = {id(p) for p in params}
+    others = [t for t in concretes if id(t) not in pids]
+
+    def grad_fn(*args):
+        env = {f._feed_name: a for f, a in zip(feed_list, args[:nf])}
+        param_arrays = list(args[nf:nf + np_count])
+        other_arrays = args[nf + np_count:]
+
+        def loss_of(pa):
+            memo = {id(p): a for p, a in zip(params, pa)}
+            memo.update({id(o): a for o, a in zip(others, other_arrays)})
+            return jnp.reshape(_evaluate(loss, env, memo), ())
+        return tuple(jax.grad(loss_of)(param_arrays))
+
+    prog = default_main_program()
+    node = (grad_fn, feed_list + list(params) + others, len(params))
+    out = []
+    for i, p in enumerate(params):
+        sds = jax.ShapeDtypeStruct(tuple(p.shape), as_jax(p).dtype)
+        g = SymbolicTensor(sds, node=(node, i),
+                           name=f"{p.name or 'param'}@GRAD"
+                                f"_{prog._next_id()}")
+        out.append((p, g))
+    return out
+
+
 class Executor:
-    """``paddle.static.Executor`` parity: compiles the fetch DAG into
-    one jitted XLA program per feed signature."""
+    """``paddle.static.Executor`` parity: compiles the fetch DAG (plus
+    any optimizer update entries in the Program) into one jitted XLA
+    program per feed signature; parameters and optimizer state enter as
+    runtime arguments and updated values are written back — static-mode
+    training (reference: ``StandaloneExecutor`` running a Program with
+    backward + optimizer ops)."""
 
     def __init__(self, place=None):
         self.place = place
@@ -240,27 +341,46 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
+        prog = program or default_main_program()
         feed = feed or {}
         fetch_list = fetch_list or []
         if not isinstance(fetch_list, (list, tuple)):
             fetch_list = [fetch_list]
         names = sorted(feed)
         arrays = [jnp.asarray(np.asarray(feed[n])) for n in names]
-        sig = (id(program), tuple(map(id, fetch_list)), tuple(names),
+        updates = list(getattr(prog, "_updates", ()))
+        sig = (id(prog), tuple(map(id, fetch_list)), len(updates),
+               tuple(names),
                tuple((a.shape, str(a.dtype)) for a in arrays))
 
-        jitted = self._compiled.get(sig)
-        if jitted is None:
+        entry = self._compiled.get(sig)
+        if entry is None:
             fetches = list(fetch_list)
+            upd_syms = [s for _, syms, _ in updates for s in syms]
+            _, concretes = _collect_deps(fetches + upd_syms)
 
-            def f(*feed_arrays):
+            def f(feed_arrays, concrete_arrays):
                 env = dict(zip(names, feed_arrays))
-                memo = {}
-                return [_evaluate(t, env, memo) for t in fetches]
+                memo = {id(t): a for t, a in zip(concretes,
+                                                 concrete_arrays)}
+                outs = [_evaluate(t, env, memo) for t in fetches]
+                upds = [_evaluate(s, env, memo) for s in upd_syms]
+                return outs, upds
 
-            jitted = jax.jit(f)
-            self._compiled[sig] = jitted
-        outs = jitted(*arrays)
+            entry = (jax.jit(f), concretes)
+            self._compiled[sig] = entry
+        jitted, concretes = entry
+        outs, upd_arrays = jitted(arrays, [as_jax(t) for t in concretes])
+
+        # write updated params / optimizer state back
+        i = 0
+        for targets, syms, finalize in updates:
+            vals = upd_arrays[i:i + len(syms)]
+            i += len(syms)
+            for t, v in zip(targets, vals):
+                t._data = v
+            if finalize is not None:
+                finalize(vals)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]

@@ -169,3 +169,99 @@ def test_hook_fires_once_on_accumulated_grad():
     assert len(calls) == 1
     np.testing.assert_allclose(calls[0], [2.0])
     np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+# ----------------------------------------------------- double backward
+
+def test_create_graph_grad_of_grad_scalar():
+    # d/dx (x^3) = 3x^2 ; d2/dx2 = 6x
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert not gx.stop_gradient
+    (ggx,) = paddle.grad(gx.sum(), x)
+    np.testing.assert_allclose(ggx.numpy(), [12.0])  # 6x = 12
+
+
+def test_create_graph_matches_numeric_second_derivative():
+    rng = np.random.RandomState(3)
+    xv = rng.randn(4).astype(np.float32)
+
+    def f(t):
+        return (paddle.sin(t) * t + paddle.exp(t * 0.3)).sum()
+
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    (g,) = paddle.grad(f(x), x, create_graph=True)
+    (gg,) = paddle.grad(g.sum(), x)
+
+    eps = 1e-3
+    num = np.zeros_like(xv)
+    for i in range(len(xv)):
+        for s, w in ((eps, 1.0), (-eps, -1.0)):
+            xp = xv.copy()
+            xp[i] += s
+            t = paddle.to_tensor(xp, stop_gradient=False)
+            (gi,) = paddle.grad(f(t), t)
+            num[i] += w * float(gi.numpy().sum())
+    num /= 2 * eps
+    np.testing.assert_allclose(gg.numpy(), num, rtol=1e-2, atol=1e-3)
+
+
+def test_create_graph_mixed_partials_through_matmul():
+    rng = np.random.RandomState(5)
+    a = paddle.to_tensor(rng.randn(3, 3).astype(np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(rng.randn(3).astype(np.float32),
+                         stop_gradient=False)
+    y = (paddle.matmul(a, x) ** 2).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (ga,) = paddle.grad(gx.sum(), a)
+    av, xv = a.numpy(), x.numpy()
+    # verify the mixed partial d/dA sum(dy/dx) numerically
+    eps = 1e-3
+    num = np.zeros_like(av)
+    for i in range(3):
+        for j in range(3):
+            for s, w in ((eps, 1.0), (-eps, -1.0)):
+                ap = av.copy()
+                ap[i, j] += s
+                at = paddle.to_tensor(ap, stop_gradient=False)
+                xt = paddle.to_tensor(xv, stop_gradient=False)
+                yy = (paddle.matmul(at, xt) ** 2).sum()
+                (gxi,) = paddle.grad(yy, xt)
+                num[i, j] += w * float(gxi.numpy().sum())
+    num /= 2 * eps
+    np.testing.assert_allclose(ga.numpy(), num, rtol=1e-2, atol=1e-3)
+
+
+def test_gradient_penalty_training():
+    """WGAN-GP style: the penalty term ((||dD/dx|| - 1)^2) must train —
+    the canonical create_graph consumer."""
+    import paddle_tpu.nn as nn
+    paddle.seed(11)
+    disc = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = paddle.optimizer.Adam(1e-2, parameters=disc.parameters())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(8):
+        x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32),
+                             stop_gradient=False)
+        out = disc(x).sum()
+        (gx,) = paddle.grad(out, x, create_graph=True)
+        gnorm = paddle.sqrt((gx ** 2).sum(axis=1) + 1e-12)
+        penalty = ((gnorm - 1.0) ** 2).mean()
+        penalty.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(penalty.numpy()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_backward_on_create_graph_grads_accumulates_into_leaves():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 2).sum()
+    (gx,) = paddle.grad(y, x, create_graph=True)
+    (gx ** 2).sum().backward()       # d/dx sum((2x)^2) = 8x
+    np.testing.assert_allclose(x.grad.numpy(), [8.0, 16.0])

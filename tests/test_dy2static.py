@@ -1,0 +1,316 @@
+"""dy2static: python control flow compiles under to_static (reference:
+``python/paddle/jit/dy2static/`` AST transforms + ``test/dygraph_to_static``
+eager-vs-static parity pattern). The round-2 verdict's top item: no
+fallback warning may fire for convertible code, and the per-break report
+must name genuine breaks."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _no_fallback(fn, *args, **kwargs):
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = fn(*args, **kwargs)
+        bad = [str(m.message) for m in w
+               if "falling back" in str(m.message)]
+        assert not bad, bad
+    return out
+
+
+# ------------------------------------------------------------------ if
+
+def test_tensor_if_compiles_and_matches_eager():
+    @paddle.jit.to_static
+    def f(x):
+        if x.mean() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    xp = np.array([1.0, 2.0], np.float32)
+    xn = np.array([-1.0, -2.0], np.float32)
+    for arr in (xp, xn):
+        static_out = _no_fallback(f, paddle.to_tensor(arr)).numpy()
+        eager_out = f._fn(paddle.to_tensor(arr)).numpy()
+        np.testing.assert_allclose(static_out, eager_out)
+
+
+def test_elif_chain_and_bool_ops():
+    @paddle.jit.to_static
+    def f(x, flag):
+        if x.sum() > 10 and flag.sum() > 0:
+            out = x * 10
+        elif x.sum() > 2 or flag.sum() > 5:
+            out = x + 1
+        else:
+            out = -x
+        return out
+
+    cases = [(np.array([20.0], np.float32), np.array([1.0], np.float32)),
+             (np.array([3.0], np.float32), np.array([-1.0], np.float32)),
+             (np.array([1.0], np.float32), np.array([9.0], np.float32)),
+             (np.array([1.0], np.float32), np.array([0.0], np.float32))]
+    for xv, fv in cases:
+        got = _no_fallback(f, paddle.to_tensor(xv),
+                           paddle.to_tensor(fv)).numpy()
+        want = f._fn(paddle.to_tensor(xv), paddle.to_tensor(fv)).numpy()
+        np.testing.assert_allclose(got, want)
+
+
+def test_early_return_under_tensor_cond():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 100:
+            return paddle.zeros([2])
+        if x.sum() < -100:
+            return paddle.ones([2])
+        return x * 3
+
+    for arr in ([200.0, 0.0], [-200.0, 0.0], [1.0, 2.0]):
+        a = np.array(arr, np.float32)
+        got = _no_fallback(f, paddle.to_tensor(a)).numpy()
+        want = f._fn(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(got, want)
+
+
+# --------------------------------------------------------------- while
+
+def test_tensor_while_compiles():
+    @paddle.jit.to_static
+    def f(n, x):
+        i = paddle.to_tensor(np.array(0, np.int64))
+        acc = x
+        while i < n:
+            acc = acc * 2.0
+            i = i + 1
+        return acc
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    out = _no_fallback(f, paddle.to_tensor(np.array(3, np.int64)), x)
+    np.testing.assert_allclose(out.numpy(), [8.0])
+    # same compiled fn, different trip count (data-dependent!)
+    out = _no_fallback(f, paddle.to_tensor(np.array(5, np.int64)), x)
+    np.testing.assert_allclose(out.numpy(), [32.0])
+
+
+def test_while_with_python_int_promotion():
+    @paddle.jit.to_static
+    def f(n):
+        i = 0                      # python int -> promoted to carry
+        s = paddle.zeros([1])
+        while i < n:               # n is a tensor
+            s = s + 2.0
+            i = i + 1
+        return s
+
+    out = _no_fallback(f, paddle.to_tensor(np.array(4, np.int64)))
+    np.testing.assert_allclose(out.numpy(), [8.0])
+
+
+def test_decode_loop_with_break():
+    """A python greedy-decode loop — tensor while + tensor-cond break +
+    in-loop buffer update — must compile with zero graph breaks."""
+    @paddle.jit.to_static
+    def decode(start, eos):
+        tokens = paddle.zeros([8], dtype="int64")
+        i = paddle.to_tensor(np.array(0, np.int64))
+        cur = start
+        while i < 8:
+            if cur == eos:
+                break
+            onehot = (paddle.arange(8) == i).astype("int64")
+            tokens = tokens + cur * onehot
+            cur = (cur * 2 + 1) % 10
+            i = i + 1
+        return tokens, i
+
+    toks, n = _no_fallback(decode,
+                           paddle.to_tensor(np.array(1, np.int64)),
+                           paddle.to_tensor(np.array(7, np.int64)))
+    np.testing.assert_array_equal(toks.numpy(),
+                                  [1, 3, 0, 0, 0, 0, 0, 0])
+    assert int(n.numpy()) == 2
+    # different data -> different dynamic trip count, same compiled fn
+    toks2, n2 = _no_fallback(decode,
+                             paddle.to_tensor(np.array(2, np.int64)),
+                             paddle.to_tensor(np.array(3, np.int64)))
+    np.testing.assert_array_equal(toks2.numpy(),
+                                  [2, 5, 1, 0, 0, 0, 0, 0])
+    assert int(n2.numpy()) == 3
+
+
+def test_continue_in_loop():
+    @paddle.jit.to_static
+    def f(n):
+        i = paddle.to_tensor(np.array(0, np.int64))
+        s = paddle.zeros([1])
+        while i < n:
+            i = i + 1
+            if (i % 2) == 0:
+                continue
+            s = s + i.astype("float32")
+        return s
+
+    out = _no_fallback(f, paddle.to_tensor(np.array(6, np.int64)))
+    np.testing.assert_allclose(out.numpy(), [9.0])   # 1+3+5
+
+
+# ------------------------------------------------------- for range(...)
+
+def test_dynamic_for_range():
+    @paddle.jit.to_static
+    def f(n, x):
+        total = paddle.zeros_like(x)
+        for _ in range(n):
+            total = total + x
+        return total
+
+    x = paddle.to_tensor(np.array([2.0], np.float32))
+    out = _no_fallback(f, paddle.to_tensor(np.array(3, np.int64)), x)
+    np.testing.assert_allclose(out.numpy(), [6.0])
+
+
+def test_static_for_range_still_unrolls():
+    """Concrete bounds keep plain python semantics (and reverse-mode AD)."""
+    @paddle.jit.to_static
+    def f(x):
+        out = x
+        for i in range(3):
+            out = out * 2
+        return out
+
+    out = _no_fallback(f, paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(out.numpy(), [8.0])
+
+
+# ----------------------------------------------- recursive call convert
+
+def test_nested_helper_function_converted():
+    def helper(v):
+        if v.sum() > 0:
+            return v * 2
+        return v - 1
+
+    @paddle.jit.to_static
+    def f(x):
+        return helper(x) + helper(-x)
+
+    a = np.array([3.0], np.float32)
+    got = _no_fallback(f, paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(got, [2 * 3.0 + (-3.0 - 1)])
+
+
+def test_branchy_sublayer_under_to_static():
+    class Gate(nn.Layer):
+        def forward(self, x):
+            if x.mean() > 0:
+                return x * 2
+            return x * 0.5
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.gate = Gate()
+
+        def forward(self, x):
+            return self.gate(self.fc(x))
+
+    net = paddle.jit.to_static(Net())
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 4).astype(np.float32))
+    got = _no_fallback(net, x).numpy()
+    assert np.isfinite(got).all()
+
+
+# -------------------------------------------------- TrainStep + grads
+
+def test_trainstep_with_branchy_forward_matches_eager():
+    """Whole-step jit over a model with a data-dependent branch: loss
+    trajectory must match eager training (same init, SGD)."""
+    def build():
+        paddle.seed(7)
+        class Branchy(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                h = self.fc1(x)
+                if h.mean() > 0:
+                    h = paddle.nn.functional.relu(h) * 2
+                else:
+                    h = paddle.nn.functional.relu(h) - 0.1
+                return self.fc2(h)
+        return Branchy()
+
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(8, 4).astype(np.float32) for _ in range(3)]
+
+    # eager reference
+    net_e = build()
+    opt_e = paddle.optimizer.SGD(0.1, parameters=net_e.parameters())
+    eager_losses = []
+    for xv in xs:
+        loss = (net_e(paddle.to_tensor(xv)) ** 2).mean()
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        eager_losses.append(float(loss.numpy()))
+
+    # compiled whole-step
+    from paddle_tpu.jit import TrainStep
+    net_s = build()
+    opt_s = paddle.optimizer.SGD(0.1, parameters=net_s.parameters())
+    step = TrainStep(net_s, lambda out, a, k: (out ** 2).mean(), opt_s)
+    static_losses = [float(step(paddle.to_tensor(xv)).numpy())
+                     for xv in xs]
+
+    np.testing.assert_allclose(static_losses, eager_losses,
+                               rtol=1e-5, atol=1e-6)
+    for (_, pe), (_, ps) in zip(net_e.named_parameters(),
+                                net_s.named_parameters()):
+        np.testing.assert_allclose(pe.numpy(), ps.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------- break report
+
+def test_graph_break_report_names_reason():
+    from paddle_tpu.jit import dy2static
+
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.sum().numpy()) > 0:     # genuine host read
+            return x * 2
+        return -x
+
+    before = len(dy2static.graph_break_report())
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert any("falling back" in str(m.message) for m in w)
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    report = dy2static.graph_break_report()
+    assert len(report) > before
+    assert any("f" in b["function"] for b in report[before:])
+
+
+def test_value_semantics_of_and_or_preserved_eagerly():
+    @paddle.jit.to_static
+    def f(x, d):
+        hop = d or 4                # python value semantics of `or`
+        flag = (d and 7) == 7
+        return x * hop, flag
+
+    out, flag = _no_fallback(
+        f, paddle.to_tensor(np.array([1.0], np.float32)), 0)
+    np.testing.assert_allclose(out.numpy(), [4.0])
+    assert bool(flag) is False

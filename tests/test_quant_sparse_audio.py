@@ -61,6 +61,67 @@ def test_qat_output_close_and_trains():
     assert not np.allclose(before, model[0].weight.numpy())
 
 
+def test_qat_under_trainstep_trace_keeps_scale_live():
+    """Advisor r2: a QAT model whose FIRST forward runs under a trace
+    (whole-step jit) must not QDQ against an uninitialized (zero) scale,
+    and the moving-average state must thread through as a buffer."""
+    from paddle_tpu.quantization import (QAT, QuantConfig,
+                                         FakeQuanterWithAbsMaxObserver,
+                                         quanterize)
+    from paddle_tpu.jit import TrainStep
+    rng = np.random.RandomState(3)
+    model = _mlp()
+    ref = model(paddle.to_tensor(
+        rng.randn(8, 8).astype(np.float32))).numpy()
+
+    q = quanterize(FakeQuanterWithAbsMaxObserver)
+    QAT(QuantConfig(activation=q, weight=q)).quantize(model)
+    model.train()
+    opt = paddle.optimizer.SGD(0.0, parameters=model.parameters())
+    step = TrainStep(model, lambda out, a, k: (out ** 2).mean(), opt)
+    x = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    loss = step(x)
+    # lr=0: weights unchanged, so output magnitude reflects QDQ only.
+    # With an uninitialized scale the traced path collapsed to ~1e-9.
+    assert float(loss.numpy()) > 1e-6
+    out = model(x).numpy()
+    assert np.abs(out).max() > 1e-3
+    # the moving-average buffer was updated through the traced step
+    quanter = model[0].activation_quanter
+    assert float(quanter.scales().numpy()) > 1e-3
+
+
+def test_grad_scaler_step_twice_raises():
+    """Advisor r2: second step() without update() must raise, not
+    silently train on scaled gradients."""
+    net = _mlp()
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    scaler.scale(net(x).sum()).backward()
+    scaler.step(opt)
+    with pytest.raises(RuntimeError, match="update"):
+        scaler.step(opt)
+    scaler.update()
+    scaler.scale(net(x).sum()).backward()
+    scaler.step(opt)  # fine again after update()
+
+
+def test_istft_return_complex():
+    """Advisor r2: return_complex must keep the imaginary part."""
+    rng = np.random.RandomState(4)
+    sig = (rng.randn(1, 256) + 1j * rng.randn(1, 256)).astype(np.complex64)
+    x = paddle.to_tensor(sig)
+    spec = paddle.signal.stft(x, n_fft=64, onesided=False)
+    back = paddle.signal.istft(spec, n_fft=64, onesided=False,
+                               return_complex=True, length=256)
+    assert "complex" in str(back.dtype)
+    np.testing.assert_allclose(back.numpy(), sig, atol=1e-4)
+    with pytest.raises(ValueError):
+        paddle.signal.istft(spec, n_fft=64, onesided=True,
+                            return_complex=True)
+
+
 def test_ptq_observe_then_convert():
     from paddle_tpu.quantization import (PTQ, QuantConfig,
                                          AbsmaxObserver, quanterize)
