@@ -34,21 +34,24 @@ def _peak_flops_per_chip() -> float:
 
 def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
                   seq, batch, steps, multi_precision=True,
-                  remat="none"):
+                  remat="none", remat_interval=1):
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     # remat: "none" wins when the config fits HBM (measured: 0.69 vs
     # 0.59 MFU at the 8B-shaped config); "dots"/"full" trade MFU for
-    # memory via FLAGS_paddle_tpu_remat_policy
+    # memory via FLAGS_paddle_tpu_remat_policy. remat_interval=k remats
+    # every k-th layer — k=2 with "full" measured best in the remat
+    # regime (0.642 vs 0.637 dots / 0.574 full-all, same session)
     if remat != "none":
         paddle.set_flags({"FLAGS_paddle_tpu_remat_policy": remat})
     cfg = LlamaConfig(
         vocab_size=vocab, hidden_size=hidden, intermediate_size=ffn,
         num_hidden_layers=layers, num_attention_heads=heads,
         num_key_value_heads=kv_heads, max_position_embeddings=seq,
-        recompute=remat != "none", dtype="bfloat16")
+        recompute=remat != "none", recompute_interval=remat_interval,
+        dtype="bfloat16")
 
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
@@ -60,7 +63,7 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, vocab, (batch, seq)).astype(np.int64)
-    labels = rng.randint(0, vocab, (batch, seq)).astype(np.int64)
+    labels = np.roll(ids, -1, axis=1)   # dataset-shifts convention
     x = paddle.to_tensor(ids)
     y = paddle.to_tensor(labels)
 
@@ -80,6 +83,11 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     # training flops/token: 6N (fwd+bwd matmuls) + 12*L*s*h attention
     flops_per_token = 6 * n_params + 12 * layers * seq * hidden
     mfu = tok_per_sec * flops_per_token / _peak_flops_per_chip()
+    # free this config's params/optimizer state before the next one
+    # builds (three ~1B configs would otherwise exhaust HBM)
+    import gc
+    del step, opt, model, loss, x, y
+    gc.collect()
     return {
         "name": name,
         "mfu": round(mfu, 4),
@@ -114,11 +122,14 @@ def _decode_bench():
                                            (batch, prompt))
     x = paddle.to_tensor(ids.astype(np.int64))
     model.generate(x, max_new_tokens=new)        # compile
-    t0 = time.perf_counter()
-    out, _ = model.generate(x, max_new_tokens=new)
-    _ = out.numpy()
-    dt = time.perf_counter() - t0
-    return {"decode_tokens_per_sec": round(batch * new / dt, 1),
+    vals = []
+    for _ in range(3):                           # tunnel-noise robust
+        t0 = time.perf_counter()
+        out, _ = model.generate(x, max_new_tokens=new)
+        _ = out.numpy()
+        vals.append(batch * new / (time.perf_counter() - t0))
+    return {"decode_tokens_per_sec": round(sorted(vals)[1], 1),
+            "decode_trials": [round(v, 1) for v in vals],
             "batch": batch, "prompt_len": prompt, "new_tokens": new}
 
 
@@ -148,6 +159,19 @@ def main():
         batch=int(os.environ.get("BENCH_L_BATCH", 2)),
         steps=max(steps // 2, 3),
         remat=os.environ.get("BENCH_L_REMAT", "none"))
+    remat_regime = _train_config(
+        "llama8b_shaped_remat",
+        hidden=int(os.environ.get("BENCH_L_HIDDEN", 4096)),
+        layers=int(os.environ.get("BENCH_L_LAYERS", 4)),
+        heads=int(os.environ.get("BENCH_L_HEADS", 32)),
+        kv_heads=int(os.environ.get("BENCH_L_KV_HEADS", 8)),
+        ffn=int(os.environ.get("BENCH_L_FFN", 14336)),
+        vocab=int(os.environ.get("BENCH_L_VOCAB", 32000)),
+        seq=int(os.environ.get("BENCH_L_SEQ", 4096)),
+        batch=int(os.environ.get("BENCH_L_BATCH", 2)),
+        steps=max(steps // 2, 3),
+        remat=os.environ.get("BENCH_R_REMAT", "full"),
+        remat_interval=int(os.environ.get("BENCH_R_INTERVAL", 2)))
     try:
         decode = _decode_bench()
     except Exception as exc:  # decode bench must not sink the metric
@@ -158,7 +182,8 @@ def main():
         "value": large["mfu"],
         "unit": "fraction_of_peak",
         "vs_baseline": round(large["mfu"] / 0.40, 4),
-        "detail": {"large": large, "base": base, "decode": decode},
+        "detail": {"large": large, "base": base,
+                   "remat_regime": remat_regime, "decode": decode},
     }
     print(json.dumps(result))
 

@@ -200,6 +200,9 @@ class GradScaler:
         self._unscaled_opts.clear()
         self._stepped_opts.clear()
         if not (self._enable and self._dynamic):
+            # non-dynamic scalers still must not let one bad step veto
+            # every future step
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1

@@ -195,32 +195,29 @@ class PipelineLayer(Layer):
             kind == "layer" and not _LayerBinder(obj).buffer_items
             for kind, obj, _ in items)
 
-    def _pipe_body(self, pre, body, post, x):
-        """Pipelined run: homogeneous body over the pp ring; lifted pre
-        items execute per-microbatch on stage 0 (first_fn) and post items
-        on the last stage (last_fn), so embedding/head work overlaps the
-        pipeline instead of running replicated outside it."""
+    def _stage_machinery(self, pre, body, post, recompute=False):
+        """Shared stage plumbing for BOTH pipeline engines (GPipe scan
+        and 1F1B): binders, param tensors, the per-stage chain closures,
+        and the stage-major [pp, lps, ...] stacking."""
         from ...jit import _LayerBinder
-        from ..pipeline import pipeline_apply
-        from ..shard_utils import current_mesh
-        from ...framework.log import vlog, logger
-        mesh = current_mesh()
         pp = self._num_stages
         lps = len(body) // pp
         binder = _LayerBinder(body[0])
         n_p = len(binder.param_items)
-        body_tensors = [p for lay in body
-                        for _, p in _LayerBinder(lay).param_items]
 
-        pre_binders = [_LayerBinder(obj) for _, obj, _ in pre]
-        post_binders = [_LayerBinder(obj) for _, obj, _ in post]
-        pre_sizes = [len(b.param_items) for b in pre_binders]
-        post_sizes = [len(b.param_items) for b in post_binders]
-        pre_tensors = [p for b in pre_binders for _, p in b.param_items]
-        post_tensors = [p for b in post_binders for _, p in b.param_items]
-
-        n_micro = getattr(self, "_num_micro", None) or pp
-        recompute = self._recompute_interval and self.training
+        m = {
+            "pp": pp, "lps": lps, "n_p": n_p,
+            "body_tensors": [p for lay in body
+                             for _, p in _LayerBinder(lay).param_items],
+            "pre_binders": [_LayerBinder(obj) for _, obj, _ in pre],
+            "post_binders": [_LayerBinder(obj) for _, obj, _ in post],
+        }
+        m["pre_sizes"] = [len(b.param_items) for b in m["pre_binders"]]
+        m["post_sizes"] = [len(b.param_items) for b in m["post_binders"]]
+        m["pre_tensors"] = [p for b in m["pre_binders"]
+                            for _, p in b.param_items]
+        m["post_tensors"] = [p for b in m["post_binders"]
+                             for _, p in b.param_items]
 
         def chain(binders, sizes, flat, h):
             i = 0
@@ -244,45 +241,74 @@ class PipelineLayer(Layer):
                 h = f(params_local, h, i)
             return h
 
-        def run_pipe(x_a, *flat):
-            nb = len(body) * n_p
-            body_flat = flat[:nb]
-            pre_flat = list(flat[nb:nb + len(pre_tensors)])
-            post_flat = list(flat[nb + len(pre_tensors):])
+        def stack_body(body_flat):
             per = [body_flat[kk * n_p:(kk + 1) * n_p]
                    for kk in range(len(body))]
-            stacked = [
+            return [
                 jnp.stack([jnp.stack([per[s * lps + i][j]
                                       for i in range(lps)])
                            for s in range(pp)])
                 for j in range(n_p)
             ]
+
+        m["chain"] = chain
+        m["stage_fn"] = stage_fn
+        m["stack_body"] = stack_body
+        m["first_fn"] = (lambda fp, feed, *e:
+                         chain(m["pre_binders"], m["pre_sizes"], fp,
+                               feed)) if pre else None
+        m["post_chain"] = (lambda lp, y:
+                           chain(m["post_binders"], m["post_sizes"],
+                                 lp, y)) if post else None
+        return m
+
+    def _adjust_nm(self, b, n_micro):
+        nm = min(n_micro, b)
+        while b % nm != 0:
+            nm -= 1
+        if nm != n_micro and \
+                getattr(self, "_nm_logged", None) != (n_micro, nm):
+            from ...framework.log import logger
+            logger.warning(
+                "PipelineLayer: batch %d not divisible by %d "
+                "microbatches — using %d microbatches instead",
+                b, n_micro, nm)
+            self._nm_logged = (n_micro, nm)
+        return nm
+
+    def _pipe_body(self, pre, body, post, x):
+        """Pipelined run: homogeneous body over the pp ring; lifted pre
+        items execute per-microbatch on stage 0 (first_fn) and post items
+        on the last stage (last_fn), so embedding/head work overlaps the
+        pipeline instead of running replicated outside it."""
+        from ..pipeline import pipeline_apply
+        from ..shard_utils import current_mesh
+        mesh = current_mesh()
+        mach = self._stage_machinery(
+            pre, body, post,
+            recompute=bool(self._recompute_interval and self.training))
+        n_micro = getattr(self, "_num_micro", None) or mach["pp"]
+        n_body = len(mach["body_tensors"])
+        n_pre = len(mach["pre_tensors"])
+
+        def run_pipe(x_a, *flat):
+            pre_flat = list(flat[n_body:n_body + n_pre])
+            post_flat = list(flat[n_body + n_pre:])
+            stacked = mach["stack_body"](flat[:n_body])
             b = x_a.shape[0]
-            nm = min(n_micro, b)
-            while b % nm != 0:
-                nm -= 1
-            if nm != n_micro and \
-                    getattr(self, "_nm_logged", None) != (n_micro, nm):
-                logger.warning(
-                    "PipelineLayer: batch %d not divisible by %d "
-                    "microbatches — using %d microbatches instead",
-                    b, n_micro, nm)
-                self._nm_logged = (n_micro, nm)
+            nm = self._adjust_nm(b, n_micro)
             mbs = x_a.reshape((nm, b // nm) + x_a.shape[1:])
-            first_fn = (lambda fp, feed, *e:
-                        chain(pre_binders, pre_sizes, fp, feed)) \
-                if pre else None
-            last_fn = (lambda lp, y, lf, *e:
-                       chain(post_binders, post_sizes, lp, y)) \
+            last_fn = (lambda lp, y, lf, *e: mach["post_chain"](lp, y)) \
                 if post else None
             out = pipeline_apply(
-                stage_fn, stacked, mbs, mesh=mesh,
-                first_fn=first_fn, first_params=pre_flat or None,
-                last_fn=last_fn, last_params=post_flat or None)
+                mach["stage_fn"], stacked, mbs, mesh=mesh,
+                first_fn=mach["first_fn"], first_params=pre_flat,
+                last_fn=last_fn, last_params=post_flat)
             return out.reshape((b,) + out.shape[2:])
 
-        return apply_jax("pipeline_body", run_pipe, x, *body_tensors,
-                         *pre_tensors, *post_tensors)
+        return apply_jax("pipeline_body", run_pipe, x,
+                         *mach["body_tensors"], *mach["pre_tensors"],
+                         *mach["post_tensors"])
 
     def forward(self, x):
         route = self._engine_route()
@@ -321,75 +347,46 @@ class PipelineLayer(Layer):
             raise RuntimeError("1F1B needs liftable (plain-layer) "
                                "pre/post stage items")
         mesh = current_mesh()
-        pp = self._num_stages
-        lps = len(body) // pp
-        binder = _LayerBinder(body[0])
-        n_p = len(binder.param_items)
-        pre_binders = [_LayerBinder(obj) for _, obj, _ in pre]
-        post_binders = [_LayerBinder(obj) for _, obj, _ in post]
-        pre_sizes = [len(b.param_items) for b in pre_binders]
-        post_sizes = [len(b.param_items) for b in post_binders]
-
-        def chain(binders, sizes, flat, h):
-            i = 0
-            for b, s in zip(binders, sizes):
-                arrs = list(flat[i:i + s])
-                i += s
-                out, _ = b.call(arrs, [], (_wrap_out(h),), {})
-                h = as_jax(out)
-            return h
-
-        def one_layer(params_local, h, i):
-            arrs = [p[i] for p in params_local]
-            out, _ = binder.call(arrs, [], (_wrap_out(h),), {})
-            return as_jax(out)
-
-        def stage_fn(params_local, h):
-            for i in range(lps):
-                h = one_layer(params_local, h, i)
-            return h
-
+        # 1F1B recomputes stage interiors on every B slot by design
+        # (activation remat is built into the schedule), so the
+        # recompute_interval knob is moot here
+        mach = self._stage_machinery(pre, body, post, recompute=False)
+        lps = mach["lps"]
         loss_fn = self._loss_fn
 
         def last_fn(lp, y, lf):
-            out = chain(post_binders, post_sizes, lp, y)
+            out = mach["post_chain"](lp, y) if post else y
             return as_jax(loss_fn(_wrap_out(out), _wrap_out(lf)))
 
-        first_fn = (lambda fp, feed:
-                    chain(pre_binders, pre_sizes, fp, feed)) \
-            if pre else None
-
-        body_params = [[as_jax(p) for _, p in _LayerBinder(lay).param_items]
-                       for lay in body]
-        stacked = [
-            jnp.stack([jnp.stack([body_params[s * lps + i][j]
-                                  for i in range(lps)])
-                       for s in range(pp)])
-            for j in range(n_p)
-        ]
-        pre_arrs = [as_jax(p) for b in pre_binders
-                    for _, p in b.param_items]
-        post_arrs = [as_jax(p) for b in post_binders
-                     for _, p in b.param_items]
+        pre_arrs = [as_jax(p) for p in mach["pre_tensors"]]
+        post_arrs = [as_jax(p) for p in mach["post_tensors"]]
+        body_arrs = [as_jax(p) for p in mach["body_tensors"]]
 
         x_a = as_jax(x)
         y_a = as_jax(labels)
         b = x_a.shape[0]
-        nm = min(n_micro, b)
-        while b % nm != 0:
-            nm -= 1
-        if nm != n_micro:
-            from ...framework.log import logger
-            logger.warning(
-                "PipelineLayer(1F1B): batch %d not divisible by %d "
-                "microbatches — using %d", b, n_micro, nm)
+        nm = self._adjust_nm(b, n_micro)
         feeds = x_a.reshape((nm, b // nm) + x_a.shape[1:])
         lfeeds = y_a.reshape((nm, b // nm) + y_a.shape[1:])
 
-        loss, (g_stacked, g_first, g_last) = pipeline_1f1b_grads(
-            stage_fn, stacked, feeds, last_fn, first_fn=first_fn,
-            first_params=pre_arrs or [], last_params=post_arrs or [],
-            last_feeds=lfeeds, mesh=mesh)
+        # one jitted program per (shapes, nm): the whole 1F1B timetable
+        # — stacking, scan, grads — compiles once and is re-dispatched
+        # per step (re-tracing the scan per step would dominate)
+        key = (feeds.shape, str(feeds.dtype), lfeeds.shape,
+               str(lfeeds.dtype), nm)
+        cache = self.__dict__.setdefault("_1f1b_jit_cache", {})
+        runner = cache.get(key)
+        if runner is None:
+            def runner_fn(body_a, pre_a, post_a, feeds_a, lfeeds_a):
+                stacked = mach["stack_body"](body_a)
+                return pipeline_1f1b_grads(
+                    mach["stage_fn"], stacked, feeds_a, last_fn,
+                    first_fn=mach["first_fn"], first_params=pre_a,
+                    last_params=post_a, last_feeds=lfeeds_a, mesh=mesh)
+            runner = jax.jit(runner_fn)
+            cache[key] = runner
+        loss, (g_stacked, g_first, g_last) = runner(
+            body_arrs, pre_arrs, post_arrs, feeds, lfeeds)
 
         def accum(p, g):
             g = jnp.asarray(g)
@@ -400,11 +397,9 @@ class PipelineLayer(Layer):
             s, i = divmod(li, lps)
             for j, (_, p) in enumerate(_LayerBinder(lay).param_items):
                 accum(p, g_stacked[j][s, i])
-        flat_pre = [p for bd in pre_binders for _, p in bd.param_items]
-        for p, g in zip(flat_pre, g_first):
+        for p, g in zip(mach["pre_tensors"], g_first):
             accum(p, g)
-        flat_post = [p for bd in post_binders for _, p in bd.param_items]
-        for p, g in zip(flat_post, g_last):
+        for p, g in zip(mach["post_tensors"], g_last):
             accum(p, g)
         return _wrap_out(loss)
 

@@ -20,7 +20,12 @@ def _remat_policy():
     """Checkpoint policy knob (FLAGS_paddle_tpu_remat_policy /
     PADDLE_TPU_REMAT_POLICY): "full" (save nothing — max HBM savings),
     "dots" (save matmul outputs, recompute elementwise — the usual MFU
-    sweet spot when HBM allows), "nothing_saveable" alias of full."""
+    sweet spot when HBM allows), "save_attn" (save ONLY the per-layer
+    attention outputs tagged with ``checkpoint_name`` — the selective
+    policy for deep stacks: cheaper than "dots" in memory, cheaper than
+    "full" in recompute FLOPs because the attention block — the most
+    expensive thing to rematerialize at long seq — is never replayed),
+    "nothing_saveable" alias of full."""
     import os
     from ..base_flags import get_flag, register_flag
     register_flag("FLAGS_paddle_tpu_remat_policy", "full")
@@ -31,6 +36,13 @@ def _remat_policy():
         "full": None, "nothing_saveable": None,
         "dots": cp.dots_with_no_batch_dims_saveable,
         "dots_saveable": cp.dots_saveable,
+        "save_attn": cp.save_only_these_names("attn_out"),
+        # dots + tagged attention outputs: backward never replays the
+        # flash-attention forward (a pallas custom call the dots policy
+        # does not cover) — the deep-stack sweet spot
+        "dots_attn": cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable,
+            cp.save_only_these_names("attn_out")),
     }.get(name, None)
 
 

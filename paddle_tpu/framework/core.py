@@ -49,6 +49,10 @@ class _GradState(threading.local):
         # functional (traced) execution: mutation of module buffers is
         # allowed to carry tracers; paddle_tpu.jit collects them as outputs
         self.functional = False
+        # when set (a list), functional buffer writes are journaled so a
+        # trace context that does NOT thread buffers (binderless
+        # to_static) can roll them back instead of leaking tracers
+        self.buffer_capture = None
 
 
 _grad_state = _GradState()
@@ -66,6 +70,32 @@ def functional_mode():
         yield
     finally:
         _grad_state.functional = prev
+
+
+def functional_buffer_write(t: "Tensor", new_arr) -> None:
+    """Single entry point for module-buffer updates (BN running stats,
+    QAT moving averages): journals the write when a rollback capture is
+    active, so traces that cannot collect buffer outputs restore the
+    pre-trace values instead of persisting tracers."""
+    cap = _grad_state.buffer_capture
+    if cap is not None and _grad_state.functional:
+        cap.append((t, t._data))
+    t._data = new_arr
+
+
+@contextlib.contextmanager
+def capture_buffer_writes():
+    """Roll back functional buffer writes on exit (binderless
+    ``to_static``: there is no binder to thread the new values, so
+    keeping them would leak trace-time tracers into persistent state)."""
+    prev = _grad_state.buffer_capture
+    _grad_state.buffer_capture = []
+    try:
+        yield
+    finally:
+        for t, old in reversed(_grad_state.buffer_capture):
+            t._data = old
+        _grad_state.buffer_capture = prev
 
 
 def is_grad_enabled() -> bool:
@@ -659,9 +689,71 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
     key are stored there (used by ``paddle.grad`` for non-leaf inputs) and
     ``.grad`` is still written for leaves.
     """
+    _backward_walk(tensors, grad_tensors, retain_graph=retain_graph,
+                   capture=capture, write_leaf_grad=write_leaf_grad,
+                   create_graph=False)
+
+
+def _run_backward_create_graph(tensors, grad_tensors=None, capture=None,
+                               write_leaf_grad=True):
+    """create_graph=True backward: the same queue walk, but every grad is
+    a RECORDED Tensor. Each node's pullback is re-expressed as
+    ``jax.vjp(node.fwd_fn, *inputs)`` applied through ``apply_jax`` — a
+    tape op differentiable in (inputs, upstream grads), which is what
+    grad-of-grad needs (reference: ``egr::RunBackward`` with
+    ``create_graph`` + generated double-grad nodes)."""
+    _backward_walk(tensors, grad_tensors, retain_graph=True,
+                   capture=capture, write_leaf_grad=write_leaf_grad,
+                   create_graph=True)
+
+
+def _apply_node_grads(node, out_grads, create_graph):
+    """One node's pullback in the chosen grad representation."""
+    if not create_graph:
+        return node.vjp_fn(tuple(out_grads))
+    nx = len(node.inputs)
+    if node.fwd_fn is not None:
+        fwd = node.fwd_fn
+
+        def grad_fn(*args, _fwd=fwd, _nx=nx):
+            xs, gs = args[:_nx], args[_nx:]
+            _, vjp = jax.vjp(_fwd, *xs)
+            return vjp(tuple(gs))
+        res = apply_jax(node.op_name + "_grad", grad_fn,
+                        *node.inputs, *out_grads, n_outputs=nx)
+        return res if isinstance(res, tuple) else (res,)
+    # custom node (PyLayer) without a re-linearizable forward: grads
+    # are correct but constant w.r.t. further differentiation
+    raw = node.vjp_fn(tuple(as_jax(g) for g in out_grads))
+    return tuple(None if g is None else _wrap_out(g) for g in raw)
+
+
+def _backward_walk(tensors, grad_tensors, *, retain_graph, capture,
+                   write_leaf_grad, create_graph):
+    """The ONE queue-based backward walk. ``create_graph`` switches the
+    grad representation: raw arrays + saved vjp closures (fast path) vs
+    recorded Tensors + re-linearized pullbacks (differentiable grads).
+    Everything else — seeding, toposort, hook firing, dtype casts, leaf
+    writes — is shared so the two modes cannot drift."""
     grad_tensors = grad_tensors or [None] * len(tensors)
-    grads: dict = {}  # id(tensor) -> accumulated grad array
-    keepalive = {}
+    grads: dict = {}
+    keepalive: dict = {}
+
+    if create_graph:
+        to_grad = lambda g: g if isinstance(g, Tensor) \
+            else _wrap_out(as_jax(g))
+        ones = lambda t: _wrap_out(jnp.ones_like(t._data))
+        zeros = lambda shape, dt: _wrap_out(jnp.zeros(shape, dt))
+        dtype_of = lambda g: as_jax(g).dtype
+        fire = lambda t, g: _wrap_out(_fire_hooks(t, as_jax(g)))
+        leaf_write = _accumulate_leaf_tensor
+    else:
+        to_grad = as_jax
+        ones = lambda t: jnp.ones_like(t._data)
+        zeros = jnp.zeros
+        dtype_of = lambda g: g.dtype
+        fire = _fire_hooks
+        leaf_write = _accumulate_leaf
 
     roots = []
     for t, g in zip(tensors, grad_tensors):
@@ -672,16 +764,14 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
             if t.size != 1:
                 raise RuntimeError(
                     "grad must be provided for non-scalar backward()")
-            g_arr = jnp.ones_like(t._data)
+            g_v = ones(t)
         else:
-            g_arr = as_jax(g)
-        grads[id(t)] = grads.get(id(t), 0) + g_arr
+            g_v = to_grad(g)
+        prev = grads.get(id(t))
+        grads[id(t)] = g_v if prev is None else prev + g_v
         keepalive[id(t)] = t
         if t.grad_node is None:
-            if write_leaf_grad:
-                _accumulate_leaf(t, grads[id(t)])
-            if capture is not None and id(t) in capture:
-                capture[id(t)] = grads[id(t)]
+            pass    # leaf root: written once by the final loop below
         elif t.grad_node.released:
             raise RuntimeError(
                 "Trying to backward through the graph a second time, but "
@@ -690,10 +780,7 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
         else:
             roots.append(t.grad_node)
 
-    if not roots:
-        return
-
-    nodes, pending = _toposort_nodes(roots)
+    nodes, pending = _toposort_nodes(roots) if roots else ([], {})
     ready = [n for n in nodes if pending.get(id(n), 0) == 0]
     processed = set()
 
@@ -708,14 +795,20 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
             t = ref()
             g = grads.get(id(t)) if t is not None else None
             if g is None:
-                g = jnp.zeros(shape, dt)
+                g = zeros(shape, dt)
             elif t is not None and t._hooks:
                 # hooks fire once on the fully-accumulated grad (all
                 # consumers of this node's outputs have been processed)
-                g = _fire_hooks(t, g)
+                g = fire(t, g)
                 grads[id(t)] = g
+            if dtype_of(g) != dt:
+                # mixed-precision consumers (AMP O1) accumulate f32
+                # grads against bf16 outputs; the vjp wants the
+                # output's dtype (under create_graph the cast is a
+                # recorded op, staying differentiable)
+                g = g.astype(dt)
             out_grads.append(g)
-        in_grads = node.vjp_fn(tuple(out_grads))
+        in_grads = _apply_node_grads(node, out_grads, create_graph)
         for t, g in zip(node.inputs, in_grads):
             if g is None:
                 continue
@@ -734,18 +827,18 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
                 pending[id(parent)] -= 1
                 if pending[id(parent)] == 0:
                     ready.append(parent)
-        if not retain_graph:
+        if not retain_graph and not create_graph:
             node.release()
 
     # write .grad on leaves; fill capture dict for requested tensors
     for tid, t in keepalive.items():
         if t.grad_node is None and t._hooks and tid in grads:
-            grads[tid] = _fire_hooks(t, grads[tid])
+            grads[tid] = fire(t, grads[tid])
         if capture is not None and tid in capture:
             capture[tid] = grads[tid]
         if (write_leaf_grad and t.grad_node is None
                 and not t.stop_gradient):
-            _accumulate_leaf(t, grads[tid])
+            leaf_write(t, grads[tid])
 
 
 def _fire_hooks(t: "Tensor", g_arr):
@@ -757,111 +850,6 @@ def _fire_hooks(t: "Tensor", g_arr):
     return gt._data
 
 
-def _run_backward_create_graph(tensors, grad_tensors=None, capture=None,
-                               write_leaf_grad=True):
-    """create_graph=True backward: the same queue walk, but every grad is
-    a RECORDED Tensor. Each node's pullback is re-expressed as
-    ``jax.vjp(node.fwd_fn, *inputs)`` applied through ``apply_jax`` — a
-    tape op differentiable in (inputs, upstream grads), which is what
-    grad-of-grad needs (reference: ``egr::RunBackward`` with
-    ``create_graph`` + generated double-grad nodes)."""
-    grad_tensors = grad_tensors or [None] * len(tensors)
-    grads: dict = {}        # id(tensor) -> grad Tensor
-    keepalive: dict = {}
-
-    roots = []
-    for t, g in zip(tensors, grad_tensors):
-        if t.stop_gradient:
-            raise RuntimeError(
-                "backward() on a tensor with stop_gradient=True")
-        if g is None:
-            if t.size != 1:
-                raise RuntimeError(
-                    "grad must be provided for non-scalar backward()")
-            g_t = _wrap_out(jnp.ones_like(t._data))
-        else:
-            g_t = g if isinstance(g, Tensor) else _wrap_out(as_jax(g))
-        prev = grads.get(id(t))
-        grads[id(t)] = g_t if prev is None else prev + g_t
-        keepalive[id(t)] = t
-        if t.grad_node is None:
-            if write_leaf_grad:
-                _accumulate_leaf_tensor(t, grads[id(t)])
-            if capture is not None and id(t) in capture:
-                capture[id(t)] = grads[id(t)]
-        elif t.grad_node.released:
-            raise RuntimeError(
-                "Trying to backward through the graph a second time, but "
-                "the saved intermediate results have been freed. Specify "
-                "retain_graph=True the first time.")
-        else:
-            roots.append(t.grad_node)
-
-    if not roots:
-        return
-
-    nodes, pending = _toposort_nodes(roots)
-    ready = [n for n in nodes if pending.get(id(n), 0) == 0]
-    processed = set()
-
-    while ready:
-        node = ready.pop()
-        if id(node) in processed:
-            continue
-        processed.add(id(node))
-        out_grads: list = []
-        for ref, shape, dt in zip(node.out_refs, node.out_shapes,
-                                  node.out_dtypes):
-            t = ref()
-            g = grads.get(id(t)) if t is not None else None
-            if g is None:
-                g = _wrap_out(jnp.zeros(shape, dt))
-            elif t is not None and t._hooks:
-                g = _wrap_out(_fire_hooks(t, as_jax(g)))
-                grads[id(t)] = g
-            out_grads.append(g)
-
-        nx = len(node.inputs)
-        if node.fwd_fn is not None:
-            fwd = node.fwd_fn
-
-            def grad_fn(*args, _fwd=fwd, _nx=nx):
-                xs, gs = args[:_nx], args[_nx:]
-                _, vjp = jax.vjp(_fwd, *xs)
-                return vjp(tuple(gs))
-            res = apply_jax(node.op_name + "_grad", grad_fn,
-                            *node.inputs, *out_grads, n_outputs=nx)
-            in_grads = res if isinstance(res, tuple) else (res,)
-        else:
-            # custom node (PyLayer) without a re-linearizable forward:
-            # grads are correct but constant w.r.t. further differentiation
-            raw = node.vjp_fn(tuple(as_jax(g) for g in out_grads))
-            in_grads = tuple(None if g is None else _wrap_out(g)
-                             for g in raw)
-
-        for t, g in zip(node.inputs, in_grads):
-            if g is None:
-                continue
-            prev = grads.get(id(t))
-            grads[id(t)] = g if prev is None else prev + g
-            keepalive[id(t)] = t
-            parent = t.grad_node
-            if parent is not None and not parent.released:
-                pending[id(parent)] -= 1
-                if pending[id(parent)] == 0:
-                    ready.append(parent)
-        # create_graph implies retain_graph: nodes are never released
-
-    for tid, t in keepalive.items():
-        if t.grad_node is None and t._hooks and tid in grads:
-            grads[tid] = _wrap_out(_fire_hooks(t, as_jax(grads[tid])))
-        if capture is not None and tid in capture:
-            capture[tid] = grads[tid]
-        if (write_leaf_grad and t.grad_node is None
-                and not t.stop_gradient):
-            _accumulate_leaf_tensor(t, grads[tid])
-
-
 def _accumulate_leaf_tensor(t: "Tensor", g: "Tensor"):
     t._grad = g if t._grad is None else t._grad + g
 
@@ -871,6 +859,8 @@ def _accumulate_leaf(t: Tensor, g_arr):
         t._grad = _wrap_out(g_arr)
     else:
         t._grad = _wrap_out(t._grad._data + g_arr)
+
+
 
 
 def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -126,7 +126,12 @@ class StaticFunction:
         else:
             def pure(param_arrays, buffer_arrays, dyn_arrays):
                 args, kwargs = rebuild(dyn_arrays)
-                with functional_mode(), no_grad():
+                from ..framework.core import capture_buffer_writes
+                # no binder to thread buffer updates: roll back any
+                # functional buffer writes (BN stats, QAT averages) so
+                # tracers never leak into persistent state
+                with functional_mode(), no_grad(), \
+                        capture_buffer_writes():
                     out = traced(*args, **kwargs)
                 return _tree_to_arrays(out), []
         return jax.jit(pure)

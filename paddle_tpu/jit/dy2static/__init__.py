@@ -59,7 +59,19 @@ def convert_to_static(fn):
         func = fn.__func__
     if not isinstance(func, types.FunctionType):
         return None
-    key = func.__code__
+    # the cache key must distinguish same-code functions with different
+    # closures/defaults (factory-made closures): conversion bakes the
+    # cell CONTENTS into the rebuilt function's globals, so key on the
+    # contents' identities too — a `nonlocal` rebinding of a free var
+    # changes the content id and forces re-conversion
+    def _cell_id(c):
+        try:
+            return id(c.cell_contents)
+        except ValueError:
+            return -1
+    key = (func.__code__,
+           tuple(_cell_id(c) for c in (func.__closure__ or ())),
+           id(func.__defaults__), id(func.__kwdefaults__))
     if key not in _cache:
         _cache[key] = _convert(func)
     conv = _cache[key]

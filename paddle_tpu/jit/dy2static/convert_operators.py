@@ -96,40 +96,53 @@ def _bool_arr(v):
 # boolean operators (short-circuit preserved for concrete operands)
 # ---------------------------------------------------------------------------
 
+def _as_arr(v):
+    return as_jax(v) if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _fold_select(vals, take_first_when_truthy: bool):
+    """Python value semantics of chained and/or over traced operands:
+    `a or b` -> where(bool(a), a, b); `a and b` -> where(bool(a), b, a).
+    Folded right-to-left; all operands are evaluated (documented
+    short-circuit loss under trace, same as the reference)."""
+    acc = _as_arr(vals[-1])
+    for v in reversed(vals[:-1]):
+        va = _as_arr(v)
+        pred = _bool_arr(v)
+        if take_first_when_truthy:      # or
+            acc = jnp.where(pred, va, acc)
+        else:                           # and
+            acc = jnp.where(pred, acc, va)
+    return _wrap_out(acc)
+
+
 def And(*fns: Callable[[], Any]):
-    acc = None
     last: Any = True
-    for f in fns:
+    for i, f in enumerate(fns):
         v = f()
-        if acc is not None:
-            acc = jnp.logical_and(acc, _bool_arr(v))
-            continue
         c = _concrete_bool(v)
         if c is None:
-            acc = _bool_arr(v)
-        elif not c:
+            # traced: evaluate the rest and select by value
+            rest = [v] + [g() for g in fns[i + 1:]]
+            return _fold_select(rest, take_first_when_truthy=False)
+        if not c:
             return v           # python: `a and b` returns a when falsy
-        else:
-            last = v
-    return last if acc is None else _wrap_out(acc)
+        last = v
+    return last
 
 
 def Or(*fns: Callable[[], Any]):
-    acc = None
     last: Any = False
-    for f in fns:
+    for i, f in enumerate(fns):
         v = f()
-        if acc is not None:
-            acc = jnp.logical_or(acc, _bool_arr(v))
-            continue
         c = _concrete_bool(v)
         if c is None:
-            acc = _bool_arr(v)
-        elif c:
+            rest = [v] + [g() for g in fns[i + 1:]]
+            return _fold_select(rest, take_first_when_truthy=True)
+        if c:
             return v           # python: `a or b` returns a when truthy
-        else:
-            last = v
-    return last if acc is None else _wrap_out(acc)
+        last = v
+    return last
 
 
 def Not(v):
@@ -193,10 +206,16 @@ def _merge_one(pred_arr, a, b, name: str):
                 "(XLA needs one static shape)")
         dt = jnp.result_type(aa, bb)
         return _wrap_out(jnp.where(pred_arr, aa.astype(dt), bb.astype(dt)))
-    if isinstance(a, _UndefinedVar):
-        return b    # sound: guards ensure the undefined side is never read
-    if isinstance(b, _UndefinedVar):
-        return a
+    if isinstance(a, _UndefinedVar) or isinstance(b, _UndefinedVar):
+        # generated early-exit vars: the guard structure ensures the
+        # undefined side is never read, so take the defined side. USER
+        # vars bound in only one branch stay Undefined — a later read
+        # raises (graph break -> eager reproduces python's
+        # UnboundLocalError/None semantics) instead of silently leaking
+        # the taken-branch value onto the untaken path.
+        if name.startswith("__dy2st_"):
+            return b if isinstance(a, _UndefinedVar) else a
+        return Undefined
     if not at and not bt:
         try:
             same = bool(a == b)

@@ -160,10 +160,12 @@ class GPTModel(Layer):
 
 
 class GPTPretrainingCriterion(Layer):
+    """CE over pre-shifted labels (PaddleNLP parity: the dataset shifts;
+    ``labels[t]`` targets ``logits[t]``)."""
+
     def forward(self, logits, labels):
         def f(lg, lb):
-            lg = lg[:, :-1, :]
-            lb = lb[:, 1:].astype(jnp.int32)
+            lb = lb.astype(jnp.int32)
             logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
             picked = jnp.take_along_axis(logp, lb[..., None],
                                          axis=-1)[..., 0]

@@ -35,16 +35,19 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     if use_batch_stats:
         # update running stats eagerly (side-effectful, like Paddle); under
         # the functional/jit path tracer writes are collected by TrainStep
-        from ...framework.core import in_functional_mode
+        from ...framework.core import (functional_buffer_write,
+                                       in_functional_mode)
         batch_mean = jnp.mean(arr, axis=reduce_axes)
         batch_var = jnp.var(arr, axis=reduce_axes)
         if running_mean is not None and isinstance(running_mean, Tensor) \
                 and (in_functional_mode()
                      or not isinstance(batch_mean, jax.core.Tracer)):
-            running_mean._data = (momentum * as_jax(running_mean)
-                                  + (1 - momentum) * batch_mean)
-            running_var._data = (momentum * as_jax(running_var)
-                                 + (1 - momentum) * batch_var)
+            functional_buffer_write(
+                running_mean, (momentum * as_jax(running_mean)
+                               + (1 - momentum) * batch_mean))
+            functional_buffer_write(
+                running_var, (momentum * as_jax(running_var)
+                              + (1 - momentum) * batch_var))
 
         def f(a, *wb):
             m = jnp.mean(a, axis=reduce_axes, keepdims=True)

@@ -66,13 +66,16 @@ class BaseQuanter(Layer):
     def scales(self):
         raise NotImplementedError
 
-    def forward(self, x):
-        scale = self.scales()
-
+    def _qdq(self, x, scale):
+        """Apply QDQ with the STE backward — the single dispatch point
+        for every quanter."""
         def f(a, s):
             return fake_quant_dequant(a, s.astype(jnp.float32),
                                       jnp.float32(self.qmax))
         return apply_jax("fake_quant", f, x, scale)
+
+    def forward(self, x):
+        return self._qdq(x, self.scales())
 
 
 class AbsmaxObserver(BaseQuanter):
@@ -129,18 +132,16 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
             new_state = jnp.where(inited > 0,
                                   r * state + (1 - r) * cur, cur)
             if in_functional_mode() or not isinstance(cur, jax.core.Tracer):
-                self._state._data = new_state
-                self._inited._data = jnp.ones((), jnp.float32)
+                from ..framework.core import functional_buffer_write
+                functional_buffer_write(self._state, new_state)
+                functional_buffer_write(self._inited,
+                                        jnp.ones((), jnp.float32))
             # QDQ with the freshly-blended scale: a whole-step-jitted QAT
             # model never quantizes against an uninitialized (zero) scale
             scale = jnp.maximum(new_state, 1e-9)
         else:
             scale = jnp.maximum(state, 1e-9)
-
-        def f(a, s):
-            return fake_quant_dequant(a, s.astype(jnp.float32),
-                                      jnp.float32(self.qmax))
-        return apply_jax("fake_quant", f, x, _wrap_out(scale))
+        return self._qdq(x, _wrap_out(scale))
 
 
 def quanterize(cls=FakeQuanterWithAbsMaxObserver, **kwargs):

@@ -314,3 +314,61 @@ def test_value_semantics_of_and_or_preserved_eagerly():
         f, paddle.to_tensor(np.array([1.0], np.float32)), 0)
     np.testing.assert_allclose(out.numpy(), [4.0])
     assert bool(flag) is False
+
+
+# --------------------------------------------- r3 review regressions
+
+def test_factory_closures_not_cross_cached():
+    """Same code object, different closure cells: each conversion must
+    see ITS closure's values."""
+    def make(scale):
+        def f(x):
+            if x.sum() > 0:
+                return x * scale
+            return x - 1
+        return f
+
+    f2 = paddle.jit.to_static(make(2.0))
+    f10 = paddle.jit.to_static(make(10.0))
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(_no_fallback(f2, x).numpy(), [6.0])
+    np.testing.assert_allclose(_no_fallback(f10, x).numpy(), [30.0])
+
+
+def test_one_branch_bound_local_graph_breaks_not_leaks():
+    """A local bound only in the taken branch must not leak its value
+    onto the untaken path — python semantics (None / UnboundLocalError)
+    via eager fallback, never a silently wrong tensor."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+            return y
+
+    neg = paddle.to_tensor(np.array([-1.0], np.float32))
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        out = f(neg)
+    assert out is None                  # python: falls off the end
+    pos = paddle.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(f(pos).numpy(), [2.0])
+
+
+def test_or_value_semantics_with_traced_operand():
+    """`a or b` / `a and b` keep python VALUE semantics for traced
+    operands (where-select), not a boolean collapse."""
+    @paddle.jit.to_static
+    def f(x, d):
+        hop = d or 4.0
+        both = d and x
+        return x * hop, both
+
+    x = paddle.to_tensor(np.array([1.0], np.float32))
+    d_truthy = paddle.to_tensor(np.array(8.0, np.float32))
+    out, both = _no_fallback(f, x, d_truthy)
+    np.testing.assert_allclose(out.numpy(), [8.0])
+    np.testing.assert_allclose(both.numpy(), [1.0])
+    d_falsy = paddle.to_tensor(np.array(0.0, np.float32))
+    out2, both2 = f(x, d_falsy)
+    np.testing.assert_allclose(out2.numpy(), [4.0])
+    np.testing.assert_allclose(both2.numpy(), [0.0])

@@ -42,7 +42,8 @@ def test_qwen2_moe_aux_loss_and_grads():
     model = Qwen2MoeForCausalLM(cfg)
     ids = paddle.to_tensor(
         np.random.RandomState(0).randint(0, 64, (2, 16)).astype(np.int64))
-    loss = model(ids, labels=ids)
+    labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
+    loss = model(ids, labels=labels)
     loss.backward()
     # router + stacked expert weights must receive gradients
     blk = model.qwen2_moe.layers[0].mlp
@@ -115,7 +116,8 @@ def test_qwen2_moe_recompute_trains():
     losses = _train_steps(model, batch, n=6)
     assert losses[-1] < losses[0], losses
     # router still gets gradients through the remat boundary
-    loss = model(ids, labels=ids)
+    labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
+    loss = model(ids, labels=labels)
     loss.backward()
     g = model.qwen2_moe.layers[0].mlp.gate.weight.grad
     assert g is not None and np.abs(g.numpy()).sum() > 0
@@ -168,8 +170,9 @@ def test_qwen2_moe_expert_parallel_mesh():
         opt = paddle.optimizer.AdamW(1e-3,
                                      parameters=model.parameters())
         step = TrainStep(model, lambda out, a, k: out, opt)
-        l0 = float(step(ids, labels=ids))
-        l1 = float(step(ids, labels=ids))
+        labels = paddle.to_tensor(np.roll(np.asarray(ids.numpy()), -1, axis=1))
+        l0 = float(step(ids, labels=labels))
+        l1 = float(step(ids, labels=labels))
         assert np.isfinite(l0) and np.isfinite(l1)
     finally:
         denv.set_mesh(None)

@@ -39,7 +39,8 @@ def _tiny_cfg():
 def _batch(cfg, bsz=4, seq=16, seed=0):
     rng = np.random.RandomState(seed)
     ids = rng.randint(0, cfg.vocab_size, (bsz, seq)).astype(np.int64)
-    labels = rng.randint(0, cfg.vocab_size, (bsz, seq)).astype(np.int64)
+    # dataset-shifts convention (criterion does not shift)
+    labels = np.roll(ids, -1, axis=1)
     return paddle.to_tensor(ids), paddle.to_tensor(labels)
 
 
