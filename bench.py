@@ -103,6 +103,73 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     }
 
 
+def _moe_bench():
+    """Qwen2-MoE-shaped pretrain step: tokens/s/chip + router drop rate
+    (single-chip scale of the 57B-A14B geometry: GQA attention, shared
+    expert + 32 routed experts, top-4, capacity-limited GShard
+    dispatch)."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+
+    steps = int(os.environ.get("BENCH_MOE_STEPS", 5))
+    cfg = Qwen2MoeConfig(
+        vocab_size=32000,
+        hidden_size=int(os.environ.get("BENCH_MOE_HIDDEN", 1024)),
+        intermediate_size=int(os.environ.get("BENCH_MOE_FFN", 2816)),
+        moe_intermediate_size=int(
+            os.environ.get("BENCH_MOE_EFFN", 704)),
+        shared_expert_intermediate_size=int(
+            os.environ.get("BENCH_MOE_SFFN", 2816)),
+        num_hidden_layers=int(os.environ.get("BENCH_MOE_LAYERS", 4)),
+        num_attention_heads=16, num_key_value_heads=8,
+        num_experts=int(os.environ.get("BENCH_MOE_EXPERTS", 32)),
+        num_experts_per_tok=int(os.environ.get("BENCH_MOE_TOPK", 4)),
+        max_position_embeddings=2048, dtype="bfloat16")
+    paddle.seed(0)
+    model = Qwen2MoeForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.train()
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 multi_precision=True)
+    step = TrainStep(model, lambda out, a, k: out, opt)
+
+    batch, seq = int(os.environ.get("BENCH_MOE_BATCH", 4)), 2048
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    x = paddle.to_tensor(ids)
+    y = paddle.to_tensor(np.roll(ids, -1, axis=1))
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+
+    drops = model.collect_drop_rates(x)
+
+    loss = step(x, y)
+    _ = float(loss.numpy())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    val = float(loss.numpy())
+    dt = time.perf_counter() - t0
+    out = {
+        "moe_tokens_per_sec_per_chip": round(batch * seq * steps / dt, 1),
+        "step_time_ms": round(1000 * dt / steps, 1),
+        "n_params": n_params,
+        "drop_rate_mean": round(float(np.mean(drops)), 4),
+        "drop_rate_per_block": [round(d, 4) for d in drops],
+        "loss": round(val, 4),
+        "config": {"hidden": cfg.hidden_size,
+                   "experts": cfg.num_experts,
+                   "top_k": cfg.num_experts_per_tok,
+                   "layers": cfg.num_hidden_layers,
+                   "batch": batch, "seq": seq},
+    }
+    del step, opt, model, loss, x, y
+    gc.collect()
+    return out
+
+
 def _decode_bench():
     """KV-cache generate() throughput (tokens/sec, greedy)."""
     import paddle_tpu as paddle
@@ -173,8 +240,12 @@ def main():
         remat=os.environ.get("BENCH_R_REMAT", "full"),
         remat_interval=int(os.environ.get("BENCH_R_INTERVAL", 2)))
     try:
+        moe = _moe_bench()
+    except Exception as exc:   # aux benches must not sink the metric
+        moe = {"error": repr(exc)}
+    try:
         decode = _decode_bench()
-    except Exception as exc:  # decode bench must not sink the metric
+    except Exception as exc:
         decode = {"error": repr(exc)}
 
     result = {
@@ -183,7 +254,8 @@ def main():
         "unit": "fraction_of_peak",
         "vs_baseline": round(large["mfu"] / 0.40, 4),
         "detail": {"large": large, "base": base,
-                   "remat_regime": remat_regime, "decode": decode},
+                   "remat_regime": remat_regime, "moe": moe,
+                   "decode": decode},
     }
     print(json.dumps(result))
 

@@ -42,6 +42,8 @@ from . import device
 from . import distributed
 from . import incubate
 from . import utils
+from . import text
+from . import onnx
 from .framework import errors
 # NOTE: not `from .framework import log` — that would shadow the
 # paddle.log math op with the logging module

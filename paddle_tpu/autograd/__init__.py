@@ -153,6 +153,12 @@ def jacobian(ys, xs, batch_axis=None):
 
 
 def hessian(func_or_ys, xs=None, batch_axis=None):
-    raise NotImplementedError(
-        "hessian: use the functional API (paddle_tpu.incubate.autograd) "
-        "backed by jax.hessian")
+    """Functional Hessian (``paddle.autograd.hessian``): pass a scalar
+    function and inputs; backed by ``incubate.autograd.Hessian``
+    (jax.hessian)."""
+    if not callable(func_or_ys):
+        raise NotImplementedError(
+            "hessian over recorded outputs: pass the FUNCTION instead "
+            "(hessian(func, xs)) — the functional API")
+    from ..incubate.autograd import Hessian
+    return Hessian(func_or_ys, xs)

@@ -16,7 +16,8 @@ import numpy as np
 
 from ..framework.core import Tensor, as_jax
 
-__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
+           "save_state_dict_shards", "load_state_dict_shards"]
 
 
 def _to_arrays(state_dict: Dict[str, Any]):
@@ -37,7 +38,16 @@ def _checkpointer():
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, async_save=False):
+                    coordinator_rank=0, async_save=False,
+                    format="distcp"):
+    """``format="distcp"`` (default): per-shard files + global metadata,
+    the reference's transparent layout; ``format="orbax"``: one orbax
+    tree (fast path for huge arrays). ``async_save=True`` keeps the
+    orbax async path — the distcp writer is synchronous."""
+    if async_save:
+        return async_save_state_dict(state_dict, path)
+    if format == "distcp":
+        return save_state_dict_shards(state_dict, path)
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     tree = _to_arrays(state_dict)
@@ -59,8 +69,12 @@ def async_save_state_dict(state_dict, path, **kw):
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0):
     """Load into the provided state_dict IN PLACE, resharding each tensor
-    to its current sharding (mesh/degree may differ from save time)."""
+    to its current sharding (mesh/degree may differ from save time).
+    Auto-detects the on-disk layout: the per-shard ``*.distcp`` +
+    metadata layout or the orbax tree."""
     path = os.path.abspath(path)
+    if os.path.exists(os.path.join(path, "metadata.json")):
+        return load_state_dict_shards(state_dict, path)
     ckptr = _checkpointer()
     restored = ckptr.restore(path)
 
@@ -83,4 +97,123 @@ def load_state_dict(state_dict, path, process_group=None,
                 dst[k] = src[k]
 
     apply(state_dict, restored)
+    return state_dict
+
+
+# ---------------------------------------------------------------------------
+# per-shard files + global metadata (reference layout semantics:
+# ``python/paddle/distributed/checkpoint/save_state_dict.py`` writes
+# ``<rank>_0.distcp`` shard files and a Metadata with
+# LocalTensorMetadata/LocalTensorIndex; load reshards across meshes via
+# the metadata — ``load_state_dict.py``)
+# ---------------------------------------------------------------------------
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+def save_state_dict_shards(state_dict, path):
+    """Write each tensor's DEVICE shards into per-shard ``N_0.distcp``
+    pickles plus a global ``metadata.json`` mapping tensor name ->
+    (shape, dtype, shard slices, file). On a single-controller mesh the
+    device index plays the reference's rank role."""
+    import json
+    import pickle
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    flat = {k: as_jax(v) if isinstance(v, Tensor) else v
+            for k, v in _flatten(state_dict).items()}
+    per_file: dict = {}
+    meta = {"tensors": {}, "extras": {}}
+    for name, arr in flat.items():
+        if not hasattr(arr, "addressable_shards"):
+            try:
+                meta["extras"][name] = np.asarray(arr).tolist()
+            except Exception as exc:
+                raise TypeError(
+                    f"state entry {name!r} ({type(arr).__name__}) is "
+                    f"not serializable into the checkpoint: {exc}; "
+                    "convert it to arrays/scalars before saving") \
+                    from exc
+            continue
+        entry = {"shape": list(np.shape(arr)),
+                 "dtype": str(np.asarray(arr.dtype)), "shards": []}
+        seen = set()
+        for shard in arr.addressable_shards:
+            idx = tuple(
+                (0 if sl.start is None else int(sl.start),
+                 (dim if sl.stop is None else int(sl.stop)))
+                for sl, dim in zip(shard.index, np.shape(arr)))
+            if idx in seen:      # replicated copies: store once
+                continue
+            seen.add(idx)
+            fname = f"{shard.device.id}_0.distcp"
+            key = f"{name}@{'_'.join(f'{a}-{b}' for a, b in idx)}"
+            per_file.setdefault(fname, {})[key] = np.asarray(shard.data)
+            entry["shards"].append({"file": fname, "key": key,
+                                    "offsets": [a for a, _ in idx],
+                                    "ends": [b for _, b in idx]})
+        meta["tensors"][name] = entry
+    for fname, blob in per_file.items():
+        with open(os.path.join(path, fname), "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_state_dict_shards(state_dict, path):
+    """Reassemble tensors from the shard files per the metadata and
+    redistribute to each destination tensor's CURRENT sharding — the
+    cross-mesh reshard-on-load the reference implements."""
+    import json
+    import pickle
+    path = os.path.abspath(path)
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    blobs: dict = {}
+
+    def shard_data(ref):
+        if ref["file"] not in blobs:
+            with open(os.path.join(path, ref["file"]), "rb") as f:
+                blobs[ref["file"]] = pickle.load(f)
+        return blobs[ref["file"]][ref["key"]]
+
+    flat_dst = _flatten(state_dict)
+    missing = [name for name, v in flat_dst.items()
+               if isinstance(v, Tensor)
+               and name not in meta["tensors"]
+               and name not in meta.get("extras", {})]
+    if missing:
+        raise KeyError(
+            f"checkpoint at {path} is missing {len(missing)} tensor(s) "
+            f"the destination expects (first few: {missing[:5]}); "
+            "refusing a silent partial load")
+    for name, v in flat_dst.items():
+        if not isinstance(v, Tensor):
+            continue
+        ent = meta["tensors"].get(name)
+        if ent is None:
+            v._data = jax.numpy.asarray(
+                meta["extras"][name]).astype(v._data.dtype)
+            continue
+        full = np.zeros(ent["shape"], np.dtype(ent["dtype"]))
+        for ref in ent["shards"]:
+            sl = tuple(slice(a, b) for a, b in zip(ref["offsets"],
+                                                   ref["ends"]))
+            full[sl] = shard_data(ref)
+        arr = jax.numpy.asarray(full)
+        sharding = getattr(v._data, "sharding", None)
+        if sharding is not None:
+            try:
+                arr = jax.device_put(arr, sharding)
+            except Exception:
+                pass
+        v._data = arr.astype(v._data.dtype)
     return state_dict

@@ -9,6 +9,12 @@ Two execution regimes, matching SURVEY.md §5.8:
     Paddle scripts run unchanged on one chip.
 
 ``Group`` carries a mesh-axis name instead of an NCCL communicator.
+
+A third regime covers the reference's EAGER multi-process ProcessGroup
+(Gloo role): when the process was launched with world_size > 1 (launch
+env present), facades called OUTSIDE shard_map execute REAL
+cross-process collectives over the native-TCPStore eager backend
+(``eager_backend.py``) instead of identity.
 """
 from __future__ import annotations
 
@@ -99,6 +105,23 @@ def _axis(group):
     return g.axis_name
 
 
+def _eager(*tensors):
+    """The cross-process backend, or None. Traced values fall through to
+    the shard_map/identity regimes — a host-side store exchange cannot
+    run on tracers."""
+    for t in tensors:
+        a = as_jax(t) if isinstance(t, Tensor) else t
+        if isinstance(a, jax.core.Tracer):
+            return None
+    from .eager_backend import get_eager_backend
+    return get_eager_backend()
+
+
+def _group_ranks(group):
+    g = group or _get_default_group()
+    return g, list(g.ranks)
+
+
 def _maybe_axis_active(axis_name) -> bool:
     if axis_name is None:
         return False
@@ -117,6 +140,14 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                ReduceOp.MIN: jax.lax.pmin,
                ReduceOp.AVG: jax.lax.pmean}
         out = fns[op](arr, axis)
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return _wrap_out(out)
+    be = _eager(arr)
+    if be is not None:
+        g, ranks = _group_ranks(group)
+        out = jnp.asarray(be.all_reduce(np.asarray(arr), op, ranks))
         if isinstance(tensor, Tensor):
             tensor._data = out
             return tensor
@@ -140,6 +171,21 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.extend(_wrap_out(gathered[i]) for i in range(n))
             return
         return _wrap_out(gathered)
+    be = _eager(arr)
+    if be is not None:
+        if axis != 0:
+            raise NotImplementedError(
+                "all_gather(axis != 0) across processes is not "
+                "supported; transpose first")
+        g, ranks = _group_ranks(group)
+        parts = [_wrap_out(jnp.asarray(a))
+                 for a in be.all_gather(np.asarray(arr), ranks)]
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            tensor_list.extend(parts)
+            return
+        # match the shard_map regime's stacked [world, ...] shape
+        return _wrap_out(jnp.stack([as_jax(t) for t in parts], axis=0))
     if isinstance(tensor_list, list):
         tensor_list.clear()
         tensor_list.append(tensor if isinstance(tensor, Tensor)
@@ -149,7 +195,12 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
 
 
 def all_gather_object(obj_list, obj, group=None):
+    be = _eager()
     obj_list.clear()
+    if be is not None:
+        g, ranks = _group_ranks(group)
+        obj_list.extend(be.all_gather_object(obj, ranks))
+        return
     obj_list.append(obj)
 
 
@@ -166,15 +217,36 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             tensor._data = out
             return tensor
         return _wrap_out(out)
+    be = _eager(src)
+    if be is not None:
+        g, ranks = _group_ranks(group)
+        out = jnp.asarray(be.reduce_scatter(np.asarray(src), op, ranks))
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return _wrap_out(out)
     return tensor
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    be = _eager(tensor)
+    if be is not None and not _maybe_axis_active(_axis(group)):
+        g, ranks = _group_ranks(group)
+        out = be.broadcast(np.asarray(as_jax(tensor)), src, ranks)
+        if isinstance(tensor, Tensor):
+            tensor._data = jnp.asarray(out)
+            return tensor
+        return _wrap_out(jnp.asarray(out))
     # replicated-by-construction on the mesh; identity otherwise
     return tensor
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    be = _eager()
+    if be is not None:
+        g, ranks = _group_ranks(group)
+        new = be.broadcast(list(object_list), src, ranks)
+        object_list[:] = new
     return object_list
 
 
@@ -195,10 +267,16 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None,
         stacked = jnp.stack([as_jax(t) for t in in_tensor_list])
     else:
         stacked = as_jax(in_tensor_list)
+    be = _eager(stacked)
     if _maybe_axis_active(ax_name):
         out = jax.lax.all_to_all(stacked, ax_name, split_axis=0,
                                  concat_axis=0, tiled=False)
         outs = [_wrap_out(out[i]) for i in range(out.shape[0])]
+    elif be is not None and isinstance(in_tensor_list, (list, tuple)):
+        g, ranks = _group_ranks(group)
+        got = be.all_to_all([np.asarray(as_jax(t))
+                             for t in in_tensor_list], ranks)
+        outs = [_wrap_out(jnp.asarray(a)) for a in got]
     else:
         outs = [t if isinstance(t, Tensor) else _wrap_out(as_jax(t))
                 for t in (in_tensor_list if isinstance(
@@ -229,8 +307,12 @@ def send(tensor, dst=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks == 1:
         return
+    be = _eager(tensor)
+    if be is not None:
+        be.send(np.asarray(as_jax(tensor)), dst)
+        return
     raise NotImplementedError(
-        "point-to-point send outside shard_map: use ppermute-based "
+        "point-to-point send INSIDE traced code: use ppermute-based "
         "pipeline schedules (paddle_tpu.distributed.fleet pp) instead")
 
 
@@ -238,8 +320,15 @@ def recv(tensor, src=0, group=None, sync_op=True):
     g = group or _get_default_group()
     if g.nranks == 1:
         return tensor
+    be = _eager(tensor)
+    if be is not None:
+        out = jnp.asarray(be.recv(src))
+        if isinstance(tensor, Tensor):
+            tensor._data = out
+            return tensor
+        return _wrap_out(out)
     raise NotImplementedError(
-        "point-to-point recv outside shard_map: use ppermute-based "
+        "point-to-point recv INSIDE traced code: use ppermute-based "
         "pipeline schedules (paddle_tpu.distributed.fleet pp) instead")
 
 
@@ -266,6 +355,11 @@ def batch_isend_irecv(p2p_op_list):
 
 
 def barrier(group=None):
+    be = _eager()
+    if be is not None:
+        g, ranks = _group_ranks(group)
+        be.barrier(ranks)
+        return
     try:
         (jnp.zeros(()) + 0).block_until_ready()
     except Exception:

@@ -1,0 +1,220 @@
+"""Cross-process EAGER collectives over the native TCPStore (the Gloo
+role: reference ``ProcessGroupGloo`` — ``paddle/fluid/distributed/
+collective/process_group_gloo.cc``).
+
+The TPU data path never uses this: jitted/shard_map code lowers
+collectives to XLA ops over ICI (SURVEY §5.8). This backend exists for
+the reference's EAGER ProcessGroup semantics — utility collectives and
+multi-process tests (TestDistBase pattern: driver spawns ranks, each
+executes real cross-process ops). Implementation: every rank posts its
+payload to the rendezvous store under a (group, op, sequence) key and
+reads the peers' — O(world²) store traffic, which is fine for the
+control-plane/test role this backend serves. Keys are delete()d by the
+last reader so long runs don't grow the store.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["StoreBackend", "get_eager_backend", "init_eager_backend"]
+
+
+class StoreBackend:
+    def __init__(self, host: str, port: int, rank: int, world_size: int,
+                 timeout: float = 120.0):
+        from ..native import TCPStore
+        self.rank = int(rank)
+        self.world = int(world_size)
+        self.store = TCPStore(host=host, port=int(port),
+                              is_master=self.rank == 0,
+                              world_size=self.world, timeout=timeout)
+        self._seq: Dict[Tuple, int] = {}
+
+    # -- plumbing -------------------------------------------------------
+    def _next(self, gkey, op) -> int:
+        k = (gkey, op)
+        s = self._seq.get(k, 0)
+        self._seq[k] = s + 1
+        return s
+
+    @staticmethod
+    def _gkey(ranks: Sequence[int]) -> str:
+        return "-".join(str(r) for r in ranks)
+
+    def _post(self, key: str, obj) -> None:
+        self.store.set(key, pickle.dumps(obj, protocol=4))
+
+    def _fetch(self, key: str):
+        self.store.wait([key])
+        return pickle.loads(self.store.get(key))
+
+    def _done(self, base: str, ranks) -> None:
+        """Mark this rank done reading; the last reader deletes the
+        op's keys (best effort — delete may not exist on old stores)."""
+        n = self.store.add(base + "/done", 1)
+        if n == len(ranks):
+            for r in ranks:
+                try:
+                    self.store.delete_key(f"{base}/{r}")
+                except Exception:
+                    pass
+            try:
+                self.store.delete_key(base + "/done")
+            except Exception:
+                pass
+
+    def _exchange(self, op: str, ranks: Sequence[int], payload):
+        """All participating ranks post; each returns {rank: payload}."""
+        gkey = self._gkey(ranks)
+        seq = self._next(gkey, op)
+        base = f"eager/{gkey}/{op}/{seq}"
+        self._post(f"{base}/{self.rank}", payload)
+        out = {r: self._fetch(f"{base}/{r}") for r in ranks}
+        self._done(base, ranks)
+        return out
+
+    # -- collectives ----------------------------------------------------
+    def all_reduce(self, arr: np.ndarray, op: str,
+                   ranks: Sequence[int]) -> np.ndarray:
+        vals = self._exchange("allreduce", ranks, np.asarray(arr))
+        ordered = [vals[r] for r in sorted(vals)]
+        if op in ("sum", "avg"):
+            out = np.sum(ordered, axis=0)
+            if op == "avg":
+                out = out / len(ordered)
+        elif op == "max":
+            out = np.max(ordered, axis=0)
+        elif op == "min":
+            out = np.min(ordered, axis=0)
+        elif op == "prod":
+            out = np.prod(ordered, axis=0)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+        return out.astype(np.asarray(arr).dtype)
+
+    def all_gather(self, arr: np.ndarray,
+                   ranks: Sequence[int]) -> List[np.ndarray]:
+        vals = self._exchange("allgather", ranks, np.asarray(arr))
+        return [vals[r] for r in sorted(vals)]
+
+    def all_gather_object(self, obj, ranks: Sequence[int]) -> list:
+        vals = self._exchange("allgather_obj", ranks, obj)
+        return [vals[r] for r in sorted(vals)]
+
+    def broadcast(self, arr, src: int, ranks: Sequence[int]):
+        gkey = self._gkey(ranks)
+        seq = self._next(gkey, "bcast")
+        base = f"eager/{gkey}/bcast/{seq}"
+        if self.rank == src:
+            self._post(f"{base}/{src}", arr)
+            out = arr
+        else:
+            out = self._fetch(f"{base}/{src}")
+        # all participants count in; the LAST one deletes the value
+        n = self.store.add(base + "/done", 1)
+        if n == len(ranks):
+            for k in (f"{base}/{src}", base + "/done"):
+                try:
+                    self.store.delete_key(k)
+                except Exception:
+                    pass
+        return out
+
+    def reduce_scatter(self, arr: np.ndarray, op: str,
+                       ranks: Sequence[int]) -> np.ndarray:
+        n = len(ranks)
+        if np.shape(arr)[0] % n != 0:
+            raise ValueError(
+                f"reduce_scatter: dim0 {np.shape(arr)[0]} not divisible "
+                f"by the group size {n}")
+        if self.rank not in ranks:
+            return np.asarray(arr)
+        full = self.all_reduce(arr, op, ranks)
+        chunk = full.shape[0] // n
+        idx = sorted(ranks).index(self.rank)
+        return full[idx * chunk:(idx + 1) * chunk]
+
+    def all_to_all(self, chunks: List[np.ndarray],
+                   ranks: Sequence[int]) -> List[np.ndarray]:
+        srt = sorted(ranks)
+        payload = {r: c for r, c in zip(srt, chunks)}
+        vals = self._exchange("alltoall", ranks, payload)
+        return [vals[r][self.rank] for r in srt]
+
+    # -- p2p ------------------------------------------------------------
+    def send(self, arr, dst: int) -> None:
+        seq = self._next(("p2p", self.rank, dst), "send")
+        self._post(f"eager/p2p/{self.rank}-{dst}/{seq}", np.asarray(arr))
+
+    def recv(self, src: int):
+        seq = self._next(("p2p", src, self.rank), "recv")
+        key = f"eager/p2p/{src}-{self.rank}/{seq}"
+        out = self._fetch(key)
+        try:
+            self.store.delete_key(key)   # single reader: delete now
+        except Exception:
+            pass
+        return out
+
+    def barrier(self, ranks: Sequence[int]) -> None:
+        self._exchange("barrier", ranks, 0)
+
+
+_backend: Optional[StoreBackend] = None
+_backend_failed = False
+
+
+def init_eager_backend(host=None, port=None, rank=None, world_size=None):
+    """Explicitly initialize the eager cross-process backend (also done
+    lazily by the collective facades when the launch env is present)."""
+    global _backend
+    if _backend is None:
+        from . import env as _env
+        e = _env._env()
+        rank = e.rank if rank is None else rank
+        world_size = e.world_size if world_size is None else world_size
+        if host is None or port is None:
+            master = os.environ.get("PADDLE_EAGER_STORE") or \
+                os.environ.get("PADDLE_MASTER")
+            if not master:
+                raise RuntimeError(
+                    "eager backend needs PADDLE_MASTER or "
+                    "PADDLE_EAGER_STORE (host:port)")
+            host, p = master.rsplit(":", 1)
+            # offset from the rendezvous port: the launch controller's
+            # store may already own PADDLE_MASTER
+            port = int(p) + 2 if port is None else port
+    if _backend is None:
+        _backend = StoreBackend(host, int(port), rank, world_size)
+    return _backend
+
+
+def get_eager_backend() -> Optional[StoreBackend]:
+    """The process backend, auto-initialized from the launch env when
+    world_size > 1; None in a single-process world (facades then keep
+    their identity semantics)."""
+    global _backend, _backend_failed
+    if _backend is not None or _backend_failed:
+        return _backend
+    from . import env as _env
+    if _env.get_world_size() <= 1:
+        return None
+    if not (os.environ.get("PADDLE_MASTER")
+            or os.environ.get("PADDLE_EAGER_STORE")):
+        return None
+    try:
+        return init_eager_backend()
+    except Exception as exc:
+        _backend_failed = True   # don't retry per op
+        import warnings
+        warnings.warn(
+            f"eager collective backend FAILED to initialize ({exc!r}); "
+            "cross-process collectives on this rank degrade to "
+            "single-process identity — ranks may silently diverge. Fix "
+            "the store address (PADDLE_EAGER_STORE/PADDLE_MASTER) or "
+            "unset the launch env.")
+        return None

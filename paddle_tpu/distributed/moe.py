@@ -164,21 +164,26 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
         gates = eff_prob
     gates = jnp.where(keep, gates, 0.0).astype(x.dtype)
 
-    # dispatch mask [s, k, e, c]
-    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, c), c + 1,
-                            dtype=x.dtype)[..., :c]
-    disp = onehot.astype(x.dtype)[..., None] * pos_oh[:, :, None, :]
-    disp = jnp.sum(disp, axis=1)               # [s, e, c]
-    comb = jnp.einsum("sk,ske,skc->sec", gates,
-                      onehot.astype(x.dtype), pos_oh)
-
-    expert_in = jnp.einsum("sec,sd->ecd", disp, x)
+    # scatter-pack tokens into expert buffers — NO [s, e, c] one-hot
+    # mask (the einsum formulation materializes s*e*c elements, which
+    # OOMs at real MoE scale); dropped slots scatter into a discard row
+    flat_e = topk_idx.reshape(-1)                       # [s*k]
+    flat_p = jnp.where(keep, pos, c).reshape(-1)        # [s*k]
+    src = jnp.broadcast_to(x[:, None, :], (s, top_k, d)) \
+        .reshape(s * top_k, d)
+    src = src * keep.reshape(-1, 1).astype(x.dtype)
+    buf = jnp.zeros((e, c + 1, d), x.dtype)
+    buf = buf.at[flat_e, flat_p].add(src)
+    expert_in = buf[:, :c]
     if expert_axis is not None:
         expert_in = _ep_constraint(expert_in, expert_axis)
     expert_out = expert_fn(expert_in)          # [e, c, d_out]
     if expert_axis is not None:
         expert_out = _ep_constraint(expert_out, expert_axis)
-    y = jnp.einsum("sec,ecd->sd", comb, expert_out)
+    # combine: gather each (token, slot)'s expert output
+    kp_safe = jnp.minimum(flat_p, c - 1).reshape(s, top_k)
+    picked = expert_out[topk_idx, kp_safe]     # [s, k, d_out]
+    y = jnp.einsum("sk,skd->sd", gates, picked)
     if return_stats:
         # fraction of requested (token, slot) dispatches that were
         # dropped — capacity overflow plus random-routing skips

@@ -41,11 +41,21 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     if degree <= 1:
         return model, optimizer, scaler
     if level == "p_g_os":
+        skipped = []
         for p in model.parameters():
             if _shardable_dim0(p, degree) and getattr(
                     p, "dist_spec", None) is None:
                 spec = ["sharding"] + [None] * (len(p.shape) - 1)
                 annotate_param(p, spec)
+            elif not _shardable_dim0(p, degree):
+                skipped.append((p.name, tuple(p.shape)))
+        if skipped:
+            from ..framework.log import logger
+            logger.warning(
+                "sharding stage-3: %d parameter(s) have dim0 not "
+                "divisible by degree %d and stay REPLICATED (first "
+                "few: %s) — pad those dims for full memory savings",
+                len(skipped), degree, skipped[:3])
     if level in ("os_g", "p_g_os"):
         shard_gradients(optimizer)
     shard_optimizer_states(optimizer, degree)

@@ -44,9 +44,35 @@ def save(obj, path, protocol=4, **configs):
         pickle.dump(_tensor_to_numpy(obj), f, protocol=protocol)
 
 
+class _PaddleCompatUnpickler(pickle.Unpickler):
+    """Reads REAL PaddlePaddle ``.pdparams``/``.pdopt`` pickles without
+    paddle installed: references to ``paddle.*`` classes resolve to a
+    permissive stub whose reconstructed payload is kept as-is (real
+    paddle 2.x checkpoints store numpy arrays, so the tensors themselves
+    need no paddle code)."""
+
+    class _Stub:
+        def __init__(self, *a, **k):
+            self.args = a
+
+        def __setstate__(self, state):
+            self.state = state
+
+    def find_class(self, module, name):
+        if module.split(".")[0] in ("paddle", "paddle_tpu_missing"):
+            return _PaddleCompatUnpickler._Stub
+        return super().find_class(module, name)
+
+
 def load(path, return_numpy=False, **configs):
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        try:
+            obj = pickle.load(f)
+        except (ModuleNotFoundError, AttributeError):
+            # a checkpoint written by REAL paddle referencing paddle
+            # classes: retry with the compat unpickler
+            f.seek(0)
+            obj = _PaddleCompatUnpickler(f).load()
     if return_numpy:
         return obj
     return _numpy_to_tensor(obj)

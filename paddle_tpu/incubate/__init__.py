@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 
 
 def softmax_mask_fuse_upper_triangle(x):

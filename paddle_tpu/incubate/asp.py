@@ -1,0 +1,96 @@
+"""``paddle.incubate.asp`` — Automatic SParsity (reference:
+``python/paddle/incubate/asp/``): 2:4 structured sparsity masks, model
+pruning, and an optimizer decorator that re-applies masks after each
+step so pruned weights stay zero through training.
+
+TPU-first: masks are plain arrays applied with fused elementwise
+multiplies (XLA folds them into the matmul inputs); the 2:4 pattern is
+computed with a reshape + top-2 selection, no CUDA sparse kernels."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded: set = set()
+_masks: Dict[int, "jnp.ndarray"] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(as_jax(x) if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
+    """n:m structured mask along the LAST dim: keep the n
+    largest-|w| entries of every m-block."""
+    arr = np.asarray(as_jax(tensor) if isinstance(tensor, Tensor)
+                     else tensor)
+    if arr.ndim < 2 or arr.shape[-1] % m != 0:
+        return np.ones_like(arr)
+    flat = np.abs(arr).reshape(-1, m)
+    kth = np.partition(flat, m - n - 1, axis=1)[:, m - n - 1:m - n]
+    mask = (np.abs(arr).reshape(-1, m) > kth)
+    # ties can keep more than n: enforce exactly n via argsort fallback
+    bad = mask.sum(1) != n
+    if bad.any():
+        order = np.argsort(-flat[bad], axis=1)[:, :n]
+        fix = np.zeros_like(mask[bad])
+        np.put_along_axis(fix, order, True, axis=1)
+        mask[bad] = fix
+    return mask.reshape(arr.shape).astype(arr.dtype)
+
+
+def set_excluded_layers(model=None, param_names=None, main_program=None):
+    for n in (param_names or []):
+        _excluded.add(n)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(name, p):
+    if name in _excluded:
+        return False
+    shape = tuple(p.shape)
+    return len(shape) == 2 and shape[-1] % 4 == 0
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best",
+                with_mask=True):
+    """Apply n:m masks to every prunable 2-D weight; masks are retained
+    so ``decorate``-wrapped optimizers keep the pattern sparse."""
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, mask_algo, n=n, m=m)
+        p._data = as_jax(p) * jnp.asarray(mask)
+        _masks[id(p)] = jnp.asarray(mask)
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` to re-apply the sparsity masks after each
+    update (reference ``OptimizerWithSparsityGuarantee``)."""
+    orig_step = optimizer.step
+
+    def step(*a, **k):
+        out = orig_step(*a, **k)
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._data = as_jax(p) * mask
+        return out
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer

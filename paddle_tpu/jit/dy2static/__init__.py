@@ -29,7 +29,12 @@ __all__ = ["convert_to_static", "graph_break_report", "clear_report",
            "Dy2StUnsupported"]
 
 _BREAKS: List[Dict[str, Any]] = []
-_cache: Dict[Any, Optional[types.FunctionType]] = {}
+# bounded LRU: factory-made closures get one entry per closure instance
+# (the key includes cell-content ids), so an unbounded dict would pin
+# every closure a loop ever created
+from collections import OrderedDict
+_cache: "OrderedDict[Any, Optional[types.FunctionType]]" = OrderedDict()
+_CACHE_MAX = 256
 
 
 def record_break(func_name: str, lineno: int, reason: str) -> None:
@@ -74,6 +79,10 @@ def convert_to_static(fn):
            id(func.__defaults__), id(func.__kwdefaults__))
     if key not in _cache:
         _cache[key] = _convert(func)
+        while len(_cache) > _CACHE_MAX:
+            _cache.popitem(last=False)
+    else:
+        _cache.move_to_end(key)
     conv = _cache[key]
     if conv is None:
         return None

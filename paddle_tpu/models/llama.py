@@ -132,10 +132,10 @@ def cached_attention(qh, kh, vh, kc, vc, off, head_dim,
     scores = jnp.einsum(
         "blgrd,bsgd->bgrls", q5, kc2.astype(qh.dtype),
         preferred_element_type=jnp.float32) / math.sqrt(head_dim)
-    if bias.shape[:2] == (b, h):          # per-head extra bias
-        bias5 = bias.reshape(b, hkv, rep, l, S)
-    else:                                 # broadcast causal mask
-        bias5 = bias[:, :, None]          # [1,1,1,L,S]
+    if bias.shape[1] == h:                # per-head bias (any batch dim)
+        bias5 = bias.reshape(bias.shape[0], hkv, rep, l, S)
+    else:                                 # broadcast causal mask (H=1)
+        bias5 = bias[:, :, None]          # [B|1,1,1,L,S]
     scores = scores + bias5
     w = jax.nn.softmax(scores, axis=-1).astype(qh.dtype)
     out = jnp.einsum("bgrls,bsgd->blgrd", w, vc2.astype(qh.dtype))

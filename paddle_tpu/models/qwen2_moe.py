@@ -135,19 +135,33 @@ class Qwen2MoeSparseBlock(Layer):
         x2 = reshape(x, [-1, d])
         logits = self.gate(x2)
 
+        collect = getattr(self, "_collect_stats", False)
+
         def f(x_arr, logit_arr, gate_up, down):
             efn = self.experts.expert_fn(gate_up, down)
-            y, aux = moe_dispatch_combine(
+            out = moe_dispatch_combine(
                 x_arr, logit_arr, cfg.num_experts,
                 top_k=cfg.num_experts_per_tok,
                 capacity_factor=cfg.capacity_factor, expert_fn=efn,
                 expert_axis=cfg.expert_axis,
-                normalize_gates=cfg.norm_topk_prob)
-            return y, aux
+                normalize_gates=cfg.norm_topk_prob,
+                return_stats=collect)
+            if collect:
+                y, aux, stats = out
+                return y, aux, stats["drop_rate"]
+            return out
 
-        y, aux = apply_jax("qwen2_moe_block", f, x2, logits,
-                           self.experts.gate_up_proj,
-                           self.experts.down_proj, n_outputs=2)
+        if collect:
+            y, aux, drop = apply_jax("qwen2_moe_block", f, x2, logits,
+                                     self.experts.gate_up_proj,
+                                     self.experts.down_proj, n_outputs=3)
+            # eager-only diagnostic (a traced value here would be a
+            # leaked tracer — use collect_drop_rates(), which runs eager)
+            self.drop_rate = drop
+        else:
+            y, aux = apply_jax("qwen2_moe_block", f, x2, logits,
+                               self.experts.gate_up_proj,
+                               self.experts.down_proj, n_outputs=2)
 
         shared = self.shared_expert(x2)
         from ..ops.math import multiply, add
@@ -309,3 +323,29 @@ class Qwen2MoeForCausalLM(Layer, GenerationMixin):
             loss = add(loss, scale(
                 aux_total, self.config.router_aux_loss_coef))
         return loss
+
+    def collect_drop_rates(self, input_ids):
+        """Per-sparse-block expert-capacity drop rates for one EAGER
+        forward (reference: the MoE stack's capacity-drop telemetry).
+        Returns a list of floats, one per sparse block."""
+        blocks = [lay.mlp for lay in self.qwen2_moe.layers
+                  if isinstance(lay.mlp, Qwen2MoeSparseBlock)]
+        for b in blocks:
+            b._collect_stats = True
+        was_training = self.training
+        self.eval()
+        try:
+            from ..framework.core import no_grad
+            with no_grad():                 # diagnostic: no tape
+                self(input_ids)
+        finally:
+            if was_training:
+                self.train()
+            for b in blocks:
+                b._collect_stats = False
+        import numpy as np
+        out = []
+        for b in blocks:
+            out.append(float(np.asarray(as_jax(b.drop_rate))))
+            b.drop_rate = None              # release the graph/activations
+        return out

@@ -1,0 +1,29 @@
+"""``paddle.onnx`` (reference: ``python/paddle/onnx/export.py`` over
+paddle2onnx).
+
+TPU-first: the deployable graph artifact of this framework is serialized
+StableHLO (``paddle.jit.save``), which XLA-based runtimes consume
+directly. ``export`` always produces that artifact and says so loudly — a true
+``.onnx`` conversion is not implemented, and the warning tells the user
+exactly what was written and how to serve it."""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export ``layer``: writes the StableHLO deployable
+    (``<path>.pdmodel`` + params, loadable with ``paddle.jit.load`` /
+    the inference Predictor) and returns its path, warning that the
+    format is StableHLO rather than ONNX."""
+    from .jit import save as jit_save
+    base = path[:-5] if path.endswith(".onnx") else path
+    jit_save(layer, base, input_spec=input_spec)
+    warnings.warn(
+        "paddle.onnx.export: wrote the StableHLO deployable to "
+        f"{base}.pdmodel (load with paddle.jit.load or the inference "
+        "Predictor). StableHLO->ONNX conversion is not implemented — "
+        "serve the artifact with the XLA runtime.")
+    return base + ".pdmodel"

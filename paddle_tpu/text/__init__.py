@@ -1,0 +1,125 @@
+"""``paddle.text`` (reference: ``python/paddle/text/``) — ViterbiDecoder
+plus the text datasets (offline synthetic fallbacks, same pattern as
+``paddle_tpu.vision.datasets``)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+from ..nn.layer.layers import Layer
+from ..io import Dataset
+
+__all__ = ["ViterbiDecoder", "viterbi_decode", "Imdb", "UCIHousing"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (``paddle.text.viterbi_decode`` /
+    ``paddle/phi/kernels/cpu/viterbi_decode_kernel.cc`` parity).
+
+    potentials: [B, L, T] unary emissions; transition_params: [T, T];
+    lengths: [B] int64. Returns (scores [B], paths [B, L]).
+    TPU-first: the per-step max-product recursion is a ``lax.scan``
+    carrying (best score per tag, backpointers)."""
+
+    def f(pot, trans, lens):
+        b, seq, t = pot.shape
+        start = pot[:, 0, :]
+        if include_bos_eos_tag:
+            # BOS = tag t-2: transitions out of BOS added at step 0
+            start = start + trans[t - 2][None, :]
+
+        def step(carry, xs):
+            score = carry                        # [B, T]
+            emit, idx = xs                       # [B, T], scalar
+            cand = score[:, :, None] + trans[None]  # [B, T_from, T_to]
+            best = jnp.max(cand, axis=1) + emit
+            bp = jnp.argmax(cand, axis=1)
+            live = (idx < lens)[:, None]
+            score2 = jnp.where(live, best, score)
+            return score2, jnp.where(live, bp,
+                                     jnp.arange(t)[None, :])
+
+        idxs = jnp.arange(1, seq)
+        final, bps = jax.lax.scan(step, start,
+                                  (jnp.transpose(pot[:, 1:],
+                                                 (1, 0, 2)), idxs))
+        if include_bos_eos_tag:
+            final = final + trans[:, t - 1][None, :]  # into EOS
+        last_tag = jnp.argmax(final, axis=-1)
+        scores = jnp.max(final, axis=-1)
+
+        def backtrack(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, prev     # emit the tag at THIS position
+
+        _, path_rev = jax.lax.scan(backtrack, last_tag, bps,
+                                   reverse=True)
+        paths = jnp.concatenate(
+            [jnp.transpose(path_rev, (1, 0)), last_tag[:, None]],
+            axis=1)                              # [B, L]
+        # positions beyond each length keep the final tag (reference
+        # semantics: caller slices by length)
+        return scores, paths.astype(jnp.int64)
+
+    return apply_jax("viterbi_decode", f, potentials, transition_params,
+                     lengths, n_outputs=2)
+
+
+class ViterbiDecoder(Layer):
+    """``paddle.text.ViterbiDecoder`` parity."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(np.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset surface. Offline synthetic fallback:
+    token-id sequences whose label correlates with a marker token (same
+    split-stable pattern as the synthetic vision datasets)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 vocab_size=5000, seq_len=64, n=512):
+        seed = 1234 if mode == "train" else 4321
+        rng = np.random.RandomState(seed)
+        self.docs = rng.randint(2, vocab_size, (n, seq_len)) \
+            .astype(np.int64)
+        self.labels = rng.randint(0, 2, (n,)).astype(np.int64)
+        self.docs[:, 0] = self.labels          # separable marker
+        self.word_idx = {i: i for i in range(vocab_size)}
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], int(self.labels[i])
+
+
+class UCIHousing(Dataset):
+    """UCI housing regression surface (13 features -> price), synthetic
+    offline fallback with a fixed linear ground truth + noise."""
+
+    def __init__(self, data_file=None, mode="train", n=404):
+        seed = 1234 if mode == "train" else 4321
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = np.linspace(-1.0, 1.0, 13).astype(np.float32)
+        self.y = (self.x @ w + 0.05 * rng.randn(n)) \
+            .astype(np.float32)[:, None]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

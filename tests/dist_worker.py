@@ -1,0 +1,92 @@
+"""Per-rank worker for the multi-process eager-collective tests
+(reference TestDistBase pattern: the driver spawns N of these, each
+executes REAL cross-process collectives, results are written per rank
+and asserted by the driver)."""
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    mode, out_dir = sys.argv[1], sys.argv[2]
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    res = {"rank": rank, "world": world}
+
+    if mode == "collectives":
+        t = paddle.to_tensor(np.full((4,), float(rank + 1), np.float32))
+        dist.all_reduce(t)
+        res["allreduce_sum"] = t.numpy().tolist()
+
+        t2 = paddle.to_tensor(np.full((2,), float(rank), np.float32))
+        lst = []
+        dist.all_gather(lst, t2)
+        res["allgather"] = [x.numpy().tolist() for x in lst]
+
+        b = paddle.to_tensor(np.array([rank * 10.0 + 5.0], np.float32))
+        dist.broadcast(b, src=1)
+        res["broadcast"] = b.numpy().tolist()
+
+        if rank == 0:
+            dist.send(paddle.to_tensor(np.array([123.0], np.float32)),
+                      dst=1)
+        elif rank == 1:
+            r = paddle.to_tensor(np.zeros(1, np.float32))
+            dist.recv(r, src=0)
+            res["recv"] = r.numpy().tolist()
+
+        rs = paddle.to_tensor(
+            np.arange(world * 2, dtype=np.float32) + rank)
+        out = dist.reduce_scatter(rs)
+        res["reduce_scatter"] = out.numpy().tolist()
+
+        chunks = [paddle.to_tensor(
+            np.array([rank * 100.0 + d], np.float32))
+            for d in range(world)]
+        outs = []
+        dist.alltoall(chunks, outs)
+        res["alltoall"] = [x.numpy().tolist() for x in outs]
+
+        dist.barrier()
+
+    elif mode == "dp":
+        paddle.seed(42)
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        model = paddle.DataParallel(net)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4).astype(np.float32)
+        Y = rng.randn(8, 1).astype(np.float32)
+        n = 8 // world
+        sl = slice(rank * n, (rank + 1) * n)
+        losses = []
+        shard_losses = []
+        for _ in range(4):
+            out = model(paddle.to_tensor(X[sl]))
+            loss = ((out - paddle.to_tensor(Y[sl])) ** 2).mean()
+            loss.backward()
+            model.apply_collective_grads()
+            opt.step()
+            opt.clear_grad()
+            shard_losses.append(float(loss.numpy()))
+            g = paddle.to_tensor(
+                np.array([float(loss.numpy())], np.float32))
+            dist.all_reduce(g)
+            losses.append(float(g.numpy()[0]) / world)
+        res["losses"] = losses
+        res["shard_losses"] = shard_losses
+
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(res, f)
+
+
+if __name__ == "__main__":
+    main()

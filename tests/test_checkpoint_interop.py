@@ -1,0 +1,147 @@
+"""Checkpoint interop (round-2 verdict item 9): per-shard files +
+global metadata with cross-mesh reshard-on-load (reference:
+``python/paddle/distributed/checkpoint/``), and reading real-Paddle
+``.pdparams`` pickles."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import env as denv
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    denv.set_mesh(None)
+
+
+def _sharded_params(mesh, specs):
+    """Create named tensors device_put onto the mesh with given specs."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rng = np.random.RandomState(0)
+    out = {}
+    for name, (shape, spec) in specs.items():
+        arr = jax.numpy.asarray(rng.randn(*shape).astype(np.float32))
+        arr = jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+        out[name] = paddle.Tensor.__new__(paddle.Tensor)
+        out[name]._data = arr
+        for attr, val in (("stop_gradient", True), ("grad_node", None),
+                          ("_grad", None), ("name", name),
+                          ("persistable", True), ("_hooks", None),
+                          ("is_leaf_override", None)):
+            setattr(out[name], attr, val)
+    return out
+
+
+def test_distcp_cross_mesh_reshard(tmp_path):
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    devs = np.array(jax.devices()[:8])
+    specs = {
+        "w1": ((8, 16), ("dp", "mp")),
+        "w2": ((16, 8), ("mp", None)),
+        "b": ((16,), (None,)),
+    }
+    mesh_a = Mesh(devs.reshape(4, 2), ("dp", "mp"))
+    with mesh_a:
+        sd_a = _sharded_params(mesh_a, specs)
+    want = {k: np.asarray(v._data) for k, v in sd_a.items()}
+    path = str(tmp_path / "ckpt")
+    save_state_dict(sd_a, path)
+
+    # transparent layout: per-shard files + metadata
+    files = os.listdir(path)
+    assert "metadata.json" in files
+    assert any(f.endswith(".distcp") for f in files)
+
+    # load on a DIFFERENT mesh shape with different shardings
+    mesh_b = Mesh(devs.reshape(2, 4), ("dp", "mp"))
+    with mesh_b:
+        sd_b = _sharded_params(mesh_b, {
+            "w1": ((8, 16), (None, "mp")),
+            "w2": ((16, 8), ("dp", None)),
+            "b": ((16,), (None,)),
+        })
+    load_state_dict(sd_b, path)
+    for k in specs:
+        np.testing.assert_allclose(np.asarray(sd_b[k]._data), want[k])
+        # destination sharding preserved
+        assert sd_b[k]._data.sharding.mesh.shape == {"dp": 2, "mp": 4}
+
+
+def test_distcp_model_state_dict_roundtrip(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    want = {k: v.numpy().copy() for k, v in sd.items()}
+    save_state_dict(sd, str(tmp_path / "m"))
+
+    paddle.seed(99)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    sd2 = net2.state_dict()
+    load_state_dict(sd2, str(tmp_path / "m"))
+    for k, v in sd2.items():
+        np.testing.assert_allclose(v.numpy(), want[k], rtol=1e-6)
+
+
+def test_real_paddle_pdparams_reads(tmp_path):
+    """A synthetic checkpoint in REAL paddle's wire format: plain pickle
+    of name->ndarray plus the structured-name map paddle writes."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    blob = {name: np.random.RandomState(i).randn(
+        *[int(s) for s in p.shape]).astype(np.float32)
+        for i, (name, p) in enumerate(net.named_parameters())}
+    blob["StructuredToParameterName@@"] = {
+        name: name for name in list(blob)}
+    p = tmp_path / "real.pdparams"
+    with open(p, "wb") as f:
+        pickle.dump(blob, f, protocol=2)
+
+    state = paddle.load(str(p))
+    net.set_state_dict({k: v for k, v in state.items()
+                        if k != "StructuredToParameterName@@"})
+    for name, param in net.named_parameters():
+        np.testing.assert_allclose(param.numpy(), blob[name])
+
+
+def test_pdparams_with_paddle_class_references(tmp_path):
+    """Pickles that reference paddle.* classes (older formats) must not
+    crash the reader — arrays still come out."""
+    class LoDTensor:             # masquerades as a paddle-internal class
+        pass
+    LoDTensor.__module__ = "paddle.base.core"
+    LoDTensor.__qualname__ = "LoDTensor"
+    meta = LoDTensor()
+    meta.extra = [1, 2, 3]
+
+    payload = {"meta": meta, "w": np.ones((2, 2), np.float32)}
+    p = tmp_path / "classy.pdparams"
+    # register a throwaway fake paddle module so the PICKLER accepts the
+    # class reference; it is gone again by load time
+    import sys
+    import types
+    mods = {"paddle": types.ModuleType("paddle"),
+            "paddle.base": types.ModuleType("paddle.base"),
+            "paddle.base.core": types.ModuleType("paddle.base.core")}
+    mods["paddle.base.core"].LoDTensor = LoDTensor
+    sys.modules.update(mods)
+    try:
+        with open(p, "wb") as f:
+            pickle.dump(payload, f, protocol=2)
+    finally:
+        for k in mods:
+            sys.modules.pop(k, None)
+
+    out = paddle.load(str(p), return_numpy=True)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               payload["w"])     # arrays intact
+    assert out["meta"] is not None               # stubbed, not crashed
