@@ -129,16 +129,15 @@ def _placements_to_spec(mesh: ProcessMesh, placements, ndim: int):
     tensor_axes: List = [None] * ndim
     for mesh_dim, pl in enumerate(placements):
         if isinstance(pl, Partial):
-            # GSPMD arrays hold global values; a user-visible pending-
-            # reduction state does not exist outside compiled programs.
-            # Silently replicating would be numerically wrong by a
-            # factor of the mesh-dim size — refuse instead.
-            raise NotImplementedError(
-                f"Partial placement on mesh dim {mesh_dim} is not "
-                "representable on materialized arrays (partial-sum "
-                "states only exist transiently inside compiled GSPMD "
-                "programs). psum the value onto Replicate() first, or "
-                "use Shard(dim).")
+            # TPU-first semantics: pending-reduction state only exists
+            # transiently INSIDE compiled GSPMD programs (XLA inserts
+            # the psum where needed). A materialized dist tensor with a
+            # Partial placement therefore carries the REDUCED value and
+            # keeps Partial as metadata — reshard(..., Replicate()) is
+            # then the identity p_to_r, matching the reference's
+            # observable contract without inventing per-rank state the
+            # single-controller model doesn't have.
+            continue
         if isinstance(pl, Shard):
             name = mesh.dim_names[mesh_dim]
             cur = tensor_axes[pl.dim]

@@ -18,6 +18,7 @@ __all__ = [
     "pixel_unshuffle", "channel_shuffle", "one_hot",
     "scaled_dot_product_attention", "sequence_mask", "class_center_sample",
     "grid_sample", "affine_grid", "temporal_shift", "npair_loss",
+    "pairwise_distance", "pdist", "zeropad2d",
 ]
 
 
@@ -333,3 +334,52 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
                         + jnp.mean(jnp.sum(p * p, axis=1))) * 0.25
         return ce + reg
     return apply_jax("npair", f, anchor, positive, labels)
+
+
+def _p_norm_lastdim(d, p, keepdims=False):
+    """p-norm along the last dim with the degenerate norms paddle
+    supports: p=inf (max), p=-inf (min), p=0 (nonzero count)."""
+    import math as _math
+    ad = jnp.abs(d)
+    if p == float("inf"):
+        return jnp.max(ad, axis=-1, keepdims=keepdims)
+    if p == float("-inf"):
+        return jnp.min(ad, axis=-1, keepdims=keepdims)
+    if p == 0:
+        return jnp.sum((ad != 0).astype(d.dtype), axis=-1,
+                       keepdims=keepdims)
+    return jnp.sum(ad ** p, axis=-1, keepdims=keepdims) ** (1.0 / p)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    """``F.pairwise_distance``: p-norm of (x - y) along the last dim."""
+    def f(a, b):
+        return _p_norm_lastdim(a - b + epsilon, p, keepdims=keepdim)
+    return apply_jax("pairwise_distance", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """``paddle.pdist``: condensed pairwise distances of rows — the
+    upper triangle (i < j) of the [N, N] distance matrix."""
+    def f(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        full = _p_norm_lastdim(d, p)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return full[iu, ju]
+    return apply_jax("pdist", f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """``F.zeropad2d``: pad H/W with zeros; padding is
+    [left, right, top, bottom]."""
+    l, r, t, b = [int(v) for v in padding]
+
+    def f(a):
+        if data_format == "NCHW":
+            widths = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            widths = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(a, widths)
+    return apply_jax("zeropad2d", f, x)

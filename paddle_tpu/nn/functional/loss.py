@@ -15,6 +15,8 @@ __all__ = [
     "sigmoid_focal_loss", "hinge_embedding_loss", "triplet_margin_loss",
     "soft_margin_loss", "square_error_cost", "log_loss", "poisson_nll_loss",
     "multi_label_soft_margin_loss", "dice_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss",
+    "margin_cross_entropy",
 ]
 
 
@@ -308,3 +310,95 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
                                                        axis=reduce_dims)
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
     return apply_jax("dice", f, input, label)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """``F.triplet_margin_with_distance_loss`` parity: triplet loss with
+    a user distance callable (defaults to pairwise L2)."""
+    if distance_function is None:
+        from .common import pairwise_distance as distance_function
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        from ...ops.math import minimum
+        d_an = minimum(d_an, d_pn)
+
+    def f(ap, an):
+        return _reduce(jnp.maximum(ap - an + margin, 0.0), reduction)
+    return apply_jax("triplet_with_distance", f, d_ap, d_an)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (``F.hsigmoid_loss`` /
+    ``paddle/phi/kernels/cpu/hsigmoid_loss_kernel.cc``) for the default
+    complete binary tree (custom path_table/path_code not supported)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss custom trees (path_table/path_code)")
+    import numpy as _np
+    code_len = max(int(_np.ceil(_np.log2(max(num_classes, 2)))), 1)
+
+    def f(x, y, w, *maybe_b):
+        # complete-binary-tree codes for each class id: walk from the
+        # root; node ids and left/right bits derived from (y + C) >> k
+        b, d = x.shape
+        losses = jnp.zeros((b,), jnp.float32)
+        # label arrives as [N] or [N, 1] (paddle documents both)
+        node = y.reshape(-1).astype(jnp.int32) + num_classes
+        for _ in range(code_len):
+            parent = node // 2
+            bit = (node % 2).astype(jnp.float32)  # 1 = right child
+            live = parent >= 1
+            idx = jnp.clip(parent - 1, 0, w.shape[0] - 1)
+            logit = jnp.einsum("bd,bd->b", x, w[idx])
+            if maybe_b:
+                logit = logit + maybe_b[0][idx, 0] \
+                    if maybe_b[0].ndim > 1 else logit + maybe_b[0][idx]
+            # sigmoid CE against the branch bit
+            losses = losses + jnp.where(
+                live,
+                jnp.maximum(logit, 0.0) - logit * bit
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))),
+                0.0)
+            node = parent
+        # paddle returns the UNREDUCED per-sample loss [N, 1]
+        return losses[:, None]
+
+    args = [input, label, weight] + ([bias] if bias is not None else [])
+    return apply_jax("hsigmoid_loss", f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """ArcFace-style margin softmax (``F.margin_cross_entropy`` /
+    ``paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu``):
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled
+    softmax CE. Single-group (non-model-parallel) semantics; under a
+    sharded mesh the class dim rides GSPMD like every other op."""
+    def f(lg, y):
+        theta = jnp.arccos(jnp.clip(lg.astype(jnp.float32), -1.0, 1.0))
+        # label arrives as [N] or [N, 1] (paddle documents both)
+        y32 = y.reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(y32, lg.shape[-1], dtype=jnp.float32)
+        target_theta = margin1 * theta + margin2
+        adjusted = jnp.cos(target_theta) - margin3
+        out = jnp.where(onehot > 0, adjusted, lg.astype(jnp.float32))
+        out = out * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(logp, y32[:, None], axis=-1)[:, 0]
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+    if return_softmax:
+        return apply_jax("margin_cross_entropy", f, logits, label,
+                         n_outputs=2)
+    return apply_jax("margin_cross_entropy", f, logits, label)

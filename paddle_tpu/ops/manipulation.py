@@ -33,6 +33,7 @@ __all__ = [
     "diagonal", "diagonal_scatter", "diag_embed", "fill_diagonal_",
     "shard_index", "tensordot", "rank", "shape",
     "column_stack", "row_stack", "take", "block_diag", "combinations",
+    "hstack", "vstack", "dstack", "slice_scatter",
 ]
 
 
@@ -744,3 +745,34 @@ def combinations(x, r=2, with_replacement=False, name=None):
     def f(a):
         return a[jnp.asarray(idx)]
     return apply_jax("combinations", f, x)
+
+
+def hstack(x, name=None):
+    """``paddle.hstack``: stack along axis 1 (axis 0 for 1-D)."""
+    arrs = [t for t in x]
+    return apply_jax("hstack", lambda *a: jnp.hstack(a), *arrs)
+
+
+def vstack(x, name=None):
+    arrs = [t for t in x]
+    return apply_jax("vstack", lambda *a: jnp.vstack(a), *arrs)
+
+
+def dstack(x, name=None):
+    arrs = [t for t in x]
+    return apply_jax("dstack", lambda *a: jnp.dstack(a), *arrs)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
+    """``paddle.slice_scatter``: write ``value`` into the slice of ``x``
+    selected by axes/starts/ends/strides (out of place)."""
+    strides = strides or [1] * len(axes)
+
+    import builtins
+
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return apply_jax("slice_scatter", f, x, value)

@@ -31,6 +31,8 @@ __all__ = [
     "i0", "i0e", "i1", "i1e", "polygamma", "hypot", "ldexp", "copysign",
     "nextafter", "count_nonzero", "broadcast_shape", "log_normal",
     "trapezoid", "cumulative_trapezoid", "renorm", "signbit", "sinc",
+    "nanquantile", "frexp", "polar", "logaddexp", "positive", "binomial",
+    "standard_gamma",
 ]
 
 
@@ -481,3 +483,66 @@ def signbit(x, name=None):
 def sinc(x, name=None):
     return apply_jax("sinc", jnp.sinc, x)
 
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    """``paddle.nanquantile``: quantile ignoring NaNs (same q/axis
+    handling as ``quantile``)."""
+    ax = axis_or_none(axis)
+    qv = as_jax(q) if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply_jax(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, qv, axis=ax, keepdims=keepdim,
+                                  method=interpolation), x)
+
+
+def frexp(x, name=None):
+    """``paddle.frexp``: mantissa in [0.5, 1) and integer exponent."""
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(jnp.int32)
+    return apply_jax("frexp", f, x, n_outputs=2)
+
+
+def polar(abs, angle, name=None):
+    """``paddle.polar``: complex from magnitude and phase."""
+    def f(r, t):
+        return (r * jnp.cos(t)) + 1j * (r * jnp.sin(t))
+    return apply_jax("polar", f, abs, angle)
+
+
+def logaddexp(x, y, name=None):
+    return apply_jax("logaddexp", jnp.logaddexp, x, y)
+
+
+def positive(x, name=None):
+    return apply_jax("positive", lambda a: +a, x)
+
+
+def binomial(count, prob, name=None):
+    """``paddle.binomial``: per-element binomial draws."""
+    import jax as _jax
+    from ..framework import random as _random
+    key = _random.next_key()
+
+    def f(n, p):
+        return _jax.random.binomial(
+            key, n.astype(jnp.float32), p.astype(jnp.float32)
+        ).astype(jnp.int64)
+    from ._dispatch import nodiff
+    return nodiff(f, count, prob)
+
+
+def standard_gamma(x, name=None):
+    """``paddle.standard_gamma``: Gamma(alpha=x, scale=1) draws."""
+    import jax as _jax
+    from ..framework import random as _random
+    key = _random.next_key()
+
+    def f(a):
+        return _jax.random.gamma(key, a.astype(jnp.float32)) \
+            .astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating)
+                    else jnp.float32)
+    from ._dispatch import nodiff
+    return nodiff(f, x)

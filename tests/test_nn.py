@@ -379,3 +379,96 @@ def test_spectral_norm_state_persists():
         out = sn(w)
     sigma = np.linalg.svd(out.numpy(), compute_uv=False)[0]
     np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+# ------------------------------------------------ round-3 functionals
+
+def test_pairwise_distance_and_pdist():
+    rng = np.random.RandomState(0)
+    a = rng.randn(4, 8).astype(np.float32)
+    b = rng.randn(4, 8).astype(np.float32)
+    got = F.pairwise_distance(paddle.to_tensor(a),
+                              paddle.to_tensor(b)).numpy()
+    want = np.linalg.norm(a - b + 1e-6, axis=-1)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    pd = F.pdist(paddle.to_tensor(a)).numpy()
+    from scipy.spatial.distance import pdist as spdist
+    np.testing.assert_allclose(pd, spdist(a), rtol=1e-4)
+
+
+def test_zeropad2d_both_formats():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4, 5).astype(np.float32)
+    out = F.zeropad2d(paddle.to_tensor(x), [1, 2, 3, 4]).numpy()
+    assert out.shape == (2, 3, 11, 8)
+    np.testing.assert_allclose(out[:, :, 3:7, 1:6], x)
+    out2 = F.zeropad2d(paddle.to_tensor(x.transpose(0, 2, 3, 1)),
+                       [1, 2, 3, 4], data_format="NHWC").numpy()
+    assert out2.shape == (2, 11, 8, 3)
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(5)
+    rng = np.random.RandomState(0)
+    num_classes = 8
+    x = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+    y = paddle.to_tensor((rng.randint(0, num_classes, (32,)))
+                         .astype(np.int64))
+    w = paddle.to_tensor(
+        (rng.randn(num_classes - 1, 16) * 0.1).astype(np.float32),
+        stop_gradient=False)
+    losses = []
+    for _ in range(30):
+        per_sample = F.hsigmoid_loss(x, y, num_classes, w)
+        assert per_sample.shape == [32, 1]   # paddle: unreduced [N, 1]
+        loss = per_sample.mean()
+        loss.backward()
+        w._data = (w - 0.5 * w.grad)._data
+        w.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.8
+
+
+def test_hsigmoid_and_margin_ce_accept_2d_labels():
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(4, 16).astype(np.float32))
+    y2d = paddle.to_tensor(rng.randint(0, 8, (4, 1)).astype(np.int64))
+    w = paddle.to_tensor(rng.randn(7, 16).astype(np.float32))
+    out = F.hsigmoid_loss(x, y2d, 8, w)
+    assert out.shape == [4, 1]
+    cos = paddle.to_tensor((rng.rand(4, 10).astype(np.float32) - .5))
+    a = float(F.margin_cross_entropy(cos, y2d, scale=4.0).numpy())
+    b = float(F.margin_cross_entropy(
+        cos, paddle.to_tensor(y2d.numpy().reshape(-1)),
+        scale=4.0).numpy())
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_pairwise_distance_inf_and_zero_norms():
+    a = paddle.to_tensor(np.array([[3.0, -1.0]], np.float32))
+    b = paddle.to_tensor(np.zeros((1, 2), np.float32))
+    inf_d = F.pairwise_distance(a, b, p=float("inf"), epsilon=0.0)
+    np.testing.assert_allclose(inf_d.numpy(), [3.0])
+    zero_d = F.pairwise_distance(a, b, p=0.0, epsilon=0.0)
+    np.testing.assert_allclose(zero_d.numpy(), [2.0])
+
+
+def test_nanquantile_list_q():
+    x = np.array([[1.0, np.nan, 3.0, 5.0]], np.float32)
+    got = paddle.nanquantile(paddle.to_tensor(x), [0.25, 0.75],
+                             axis=1).numpy()
+    np.testing.assert_allclose(got, np.nanquantile(x, [0.25, 0.75],
+                                                   axis=1), rtol=1e-6)
+
+
+def test_margin_cross_entropy_reduces_to_ce_without_margins():
+    rng = np.random.RandomState(2)
+    cosines = (rng.rand(6, 10).astype(np.float32) - 0.5) * 1.8
+    y = rng.randint(0, 10, (6,)).astype(np.int64)
+    got = F.margin_cross_entropy(
+        paddle.to_tensor(cosines), paddle.to_tensor(y),
+        margin1=1.0, margin2=0.0, margin3=0.0, scale=1.0)
+    want = F.cross_entropy(paddle.to_tensor(cosines),
+                           paddle.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()),
+                               float(want.numpy()), rtol=1e-4)

@@ -343,3 +343,61 @@ def test_op_inventory_generates_and_is_current(tmp_path):
     assert "registered ops" in text
     for op in ("matmul", "trapezoid", "take", "reshape"):
         assert f"| `{op}` |" in text or f"`{op}`" in text, op
+
+
+# ------------------------------------------------- round-3 op additions
+
+def test_r3_math_ops_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(3, 8).astype(np.float32)
+    x[0, 0] = np.nan
+    np.testing.assert_allclose(
+        paddle.nanquantile(paddle.to_tensor(x), 0.5, axis=1).numpy(),
+        np.nanquantile(x, 0.5, axis=1), rtol=1e-5)
+    m, e = paddle.frexp(paddle.to_tensor(np.array([8.0, 0.75],
+                                                  np.float32)))
+    nm, ne = np.frexp(np.array([8.0, 0.75], np.float32))
+    np.testing.assert_allclose(m.numpy(), nm)
+    np.testing.assert_array_equal(e.numpy(), ne)
+    r = np.abs(rng.randn(4)).astype(np.float32)
+    t = rng.randn(4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.polar(paddle.to_tensor(r), paddle.to_tensor(t)).numpy(),
+        r * np.exp(1j * t), rtol=1e-5)
+    a, b = rng.randn(4).astype(np.float32), rng.randn(4).astype(np.float32)
+    np.testing.assert_allclose(
+        paddle.logaddexp(paddle.to_tensor(a),
+                         paddle.to_tensor(b)).numpy(),
+        np.logaddexp(a, b), rtol=1e-5)
+
+
+def test_r3_stack_family():
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(2, 3).astype(np.float32) for _ in range(2)]
+    ts = [paddle.to_tensor(v) for v in xs]
+    np.testing.assert_allclose(paddle.hstack(ts).numpy(), np.hstack(xs))
+    np.testing.assert_allclose(paddle.vstack(ts).numpy(), np.vstack(xs))
+    np.testing.assert_allclose(paddle.dstack(ts).numpy(), np.dstack(xs))
+
+
+def test_r3_slice_scatter():
+    base = np.zeros((4, 6), np.float32)
+    val = np.ones((4, 2), np.float32) * 7
+    out = paddle.slice_scatter(paddle.to_tensor(base),
+                               paddle.to_tensor(val),
+                               axes=[1], starts=[2], ends=[4])
+    want = base.copy()
+    want[:, 2:4] = 7
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_r3_random_families():
+    paddle.seed(0)
+    c = paddle.binomial(paddle.to_tensor(np.full((1000,), 20.0,
+                                                 np.float32)),
+                        paddle.to_tensor(np.full((1000,), 0.3,
+                                                 np.float32)))
+    assert 5.0 < float(c.numpy().mean()) < 7.0   # mean = n*p = 6
+    g = paddle.standard_gamma(paddle.to_tensor(
+        np.full((1000,), 4.0, np.float32)))
+    assert 3.5 < float(g.numpy().mean()) < 4.5   # mean = alpha
