@@ -56,6 +56,7 @@ class PipelineLayer(Layer):
                  recompute_ctx=None, num_virtual_pipeline_stages=None):
         super().__init__()
         self._loss_fn = loss_fn
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         self._topo = topology
         hcg = get_hcg()
         self._num_stages = num_stages or (
@@ -195,13 +196,16 @@ class PipelineLayer(Layer):
             kind == "layer" and not _LayerBinder(obj).buffer_items
             for kind, obj, _ in items)
 
-    def _stage_machinery(self, pre, body, post, recompute=False):
-        """Shared stage plumbing for BOTH pipeline engines (GPipe scan
-        and 1F1B): binders, param tensors, the per-stage chain closures,
-        and the stage-major [pp, lps, ...] stacking."""
+    def _stage_machinery(self, pre, body, post, recompute=False,
+                         n_parts=None):
+        """Shared stage plumbing for the pipeline engines (GPipe scan,
+        1F1B, interleaved): binders, param tensors, the per-part chain
+        closures, and part-major stacking. ``n_parts`` defaults to pp;
+        interleaved engines pass pp * v."""
         from ...jit import _LayerBinder
         pp = self._num_stages
-        lps = len(body) // pp
+        n_parts = n_parts or pp
+        lps = len(body) // n_parts
         binder = _LayerBinder(body[0])
         n_p = len(binder.param_items)
 
@@ -242,12 +246,13 @@ class PipelineLayer(Layer):
             return h
 
         def stack_body(body_flat):
+            # part-major: part index p covers layers [p*lps, (p+1)*lps)
             per = [body_flat[kk * n_p:(kk + 1) * n_p]
                    for kk in range(len(body))]
             return [
-                jnp.stack([jnp.stack([per[s * lps + i][j]
+                jnp.stack([jnp.stack([per[pt * lps + i][j]
                                       for i in range(lps)])
-                           for s in range(pp)])
+                           for pt in range(n_parts)])
                 for j in range(n_p)
             ]
 
@@ -350,7 +355,24 @@ class PipelineLayer(Layer):
         # 1F1B recomputes stage interiors on every B slot by design
         # (activation remat is built into the schedule), so the
         # recompute_interval knob is moot here
-        mach = self._stage_machinery(pre, body, post, recompute=False)
+        pp = self._num_stages
+        v = max(self._num_virtual_stages, 1)
+        x_a = as_jax(x)
+        b = x_a.shape[0]
+        nm = self._adjust_nm(b, n_micro)
+        if v > 1 and (len(body) % (pp * v) != 0 or nm % pp != 0):
+            if getattr(self, "_v_logged", None) != (len(body), nm, v):
+                from ...framework.log import logger
+                logger.warning(
+                    "PipelineLayer: interleave needs body %% (pp*v) == 0 "
+                    "and n_micro %% pp == 0 (body=%d, pp*v=%d, "
+                    "n_micro=%d) — ignoring "
+                    "num_virtual_pipeline_stages=%d",
+                    len(body), pp * v, nm, v)
+                self._v_logged = (len(body), nm, v)
+            v = 1
+        mach = self._stage_machinery(pre, body, post, recompute=False,
+                                     n_parts=pp * v)
         lps = mach["lps"]
         loss_fn = self._loss_fn
 
@@ -362,10 +384,7 @@ class PipelineLayer(Layer):
         post_arrs = [as_jax(p) for p in mach["post_tensors"]]
         body_arrs = [as_jax(p) for p in mach["body_tensors"]]
 
-        x_a = as_jax(x)
         y_a = as_jax(labels)
-        b = x_a.shape[0]
-        nm = self._adjust_nm(b, n_micro)
         feeds = x_a.reshape((nm, b // nm) + x_a.shape[1:])
         lfeeds = y_a.reshape((nm, b // nm) + y_a.shape[1:])
 
@@ -373,11 +392,27 @@ class PipelineLayer(Layer):
         # — stacking, scan, grads — compiles once and is re-dispatched
         # per step (re-tracing the scan per step would dominate)
         key = (feeds.shape, str(feeds.dtype), lfeeds.shape,
-               str(lfeeds.dtype), nm)
+               str(lfeeds.dtype), nm, v, lps)
         cache = self.__dict__.setdefault("_1f1b_jit_cache", {})
         runner = cache.get(key)
         if runner is None:
             def runner_fn(body_a, pre_a, post_a, feeds_a, lfeeds_a):
+                if v > 1:
+                    from ..pipeline_1f1b import pipeline_interleaved_grads
+                    # engine layout [pp, v, lps, ...]: model part
+                    # c*pp + s lives at (stage s, chunk c)
+                    parts = mach["stack_body"](body_a)  # [pp*v, lps,...]
+                    stacked = [
+                        jnp.stack([jnp.stack([pj[c * pp + s]
+                                              for c in range(v)])
+                                   for s in range(pp)])
+                        for pj in parts
+                    ]
+                    return pipeline_interleaved_grads(
+                        mach["stage_fn"], stacked, feeds_a, last_fn,
+                        v, first_fn=mach["first_fn"], first_params=pre_a,
+                        last_params=post_a, last_feeds=lfeeds_a,
+                        mesh=mesh)
                 stacked = mach["stack_body"](body_a)
                 return pipeline_1f1b_grads(
                     mach["stage_fn"], stacked, feeds_a, last_fn,
@@ -394,9 +429,16 @@ class PipelineLayer(Layer):
                                 else as_jax(p.grad) + g)
 
         for li, lay in enumerate(body):
-            s, i = divmod(li, lps)
-            for j, (_, p) in enumerate(_LayerBinder(lay).param_items):
-                accum(p, g_stacked[j][s, i])
+            part, i = divmod(li, lps)
+            if v > 1:
+                c, s = divmod(part, pp)
+                for j, (_, p) in enumerate(
+                        _LayerBinder(lay).param_items):
+                    accum(p, g_stacked[j][s, c, i])
+            else:
+                for j, (_, p) in enumerate(
+                        _LayerBinder(lay).param_items):
+                    accum(p, g_stacked[j][part, i])
         for p, g in zip(mach["pre_tensors"], g_first):
             accum(p, g)
         for p, g in zip(mach["post_tensors"], g_last):

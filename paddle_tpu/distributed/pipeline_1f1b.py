@@ -35,7 +35,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from . import env as _env
 from .pipeline import _live_batch_axes
 
-__all__ = ["make_1f1b_schedule", "pipeline_1f1b_grads"]
+__all__ = ["make_1f1b_schedule", "pipeline_1f1b_grads",
+           "make_interleaved_schedule", "pipeline_interleaved_grads"]
 
 _IDLE, _F, _B = 0, 1, 2
 
@@ -93,6 +94,51 @@ def make_1f1b_schedule(pp: int, n_micro: int):
             np.array(mi_rows, np.int32).T)
 
 
+def _pipe_env(mesh, axis, batch_axes, feeds, last_feeds, first_fn,
+              first_params):
+    """Shared prologue for both 1F1B engines: batch-axis partitioning,
+    per-device feed/boundary shapes, and in/out spec helpers."""
+    batch_spec = _live_batch_axes(mesh, axis, batch_axes, feeds.shape[1])
+    _axes = (batch_spec,) if isinstance(batch_spec, str) \
+        else (batch_spec or ())
+    n_dp = int(np.prod([mesh.shape[a] for a in _axes])) if _axes else 1
+    local_mb = feeds.shape[1] // n_dp
+    feed_spec = P(None, batch_spec, *([None] * (feeds.ndim - 2)))
+    lf_spec = None if last_feeds is None else P(
+        None, batch_spec if last_feeds.shape[1] == feeds.shape[1]
+        else None, *([None] * (last_feeds.ndim - 2)))
+    local_feed = jax.ShapeDtypeStruct((local_mb,) + feeds.shape[2:],
+                                      feeds.dtype)
+    if first_fn is not None:
+        h_struct = jax.eval_shape(first_fn, first_params, local_feed)
+    else:
+        h_struct = local_feed
+    rep = lambda tree: jax.tree_util.tree_map(
+        lambda x: P(*([None] * jnp.ndim(x))), tree)
+    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
+    return {"axes": _axes, "n_dp": n_dp, "feed_spec": feed_spec,
+            "lf_spec": lf_spec, "h_shape": h_struct.shape,
+            "h_dtype": h_struct.dtype, "rep": rep,
+            "zeros_like_tree": zeros_like_tree}
+
+
+def _pipe_outputs(axis, axes, nm, n_dp, loss_acc, gm_acc, gf_acc,
+                  gl_acc):
+    """Shared epilogue: broadcast the loss, mean-scale and psum grads
+    (pp owns its shard of the mid grads; first/last grads live on their
+    owner stages)."""
+    dp_plus_pp = (axis,) + tuple(axes)
+    loss = jax.lax.psum(loss_acc, dp_plus_pp) / (nm * n_dp)
+    scale = 1.0 / (nm * n_dp)
+    ps = lambda tree: jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, dp_plus_pp) * scale, tree)
+    gm_out = jax.tree_util.tree_map(
+        lambda g: (jax.lax.psum(g, tuple(axes)) * scale
+                   if axes else g * scale)[None], gm_acc)
+    return loss, gm_out, ps(gf_acc), ps(gl_acc)
+
+
 def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
                         last_fn: Callable, *, first_fn=None,
                         first_params=None, last_params=None,
@@ -113,31 +159,14 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
     nm = feeds.shape[0]
     op_tab, mi_tab = make_1f1b_schedule(pp, nm)
     T = op_tab.shape[1]
-
-    batch_spec = _live_batch_axes(mesh, axis, batch_axes, feeds.shape[1])
-    _axes = (batch_spec,) if isinstance(batch_spec, str) \
-        else (batch_spec or ())
-    n_dp = int(np.prod([mesh.shape[a] for a in _axes])) if _axes else 1
-    local_mb = feeds.shape[1] // n_dp
-    feed_spec = P(None, batch_spec, *([None] * (feeds.ndim - 2)))
-    lf_spec = None if last_feeds is None else P(
-        None, batch_spec if last_feeds.shape[1] == feeds.shape[1]
-        else None, *([None] * (last_feeds.ndim - 2)))
-
-    local_feed = jax.ShapeDtypeStruct((local_mb,) + feeds.shape[2:],
-                                      feeds.dtype)
-    if first_fn is not None:
-        h_struct = jax.eval_shape(first_fn, first_params, local_feed)
-    else:
-        h_struct = local_feed
-    h_shape, h_dtype = h_struct.shape, h_struct.dtype
-
+    env = _pipe_env(mesh, axis, batch_axes, feeds, last_feeds,
+                    first_fn, first_params)
+    _axes, n_dp = env["axes"], env["n_dp"]
+    feed_spec, lf_spec = env["feed_spec"], env["lf_spec"]
+    h_shape, h_dtype = env["h_shape"], env["h_dtype"]
+    rep, zeros_like_tree = env["rep"], env["zeros_like_tree"]
     in_spec_params = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
-    rep = lambda tree: jax.tree_util.tree_map(
-        lambda x: P(*([None] * jnp.ndim(x))), tree)
-    zeros_like_tree = lambda tree: jax.tree_util.tree_map(
-        lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
 
     op_arr = jnp.asarray(op_tab)
     mi_arr = jnp.asarray(mi_tab)
@@ -258,17 +287,321 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
         # loss: only the last stage accumulated; grads for first/last
         # params: only their owner stages. dp shards each saw 1/n_dp of
         # the batch; the loss is the mean over shards.
-        dp_plus_pp = (axis,) + tuple(_axes)
-        loss = jax.lax.psum(loss_acc, dp_plus_pp) / (nm * n_dp)
-        scale = 1.0 / (nm * n_dp)
-        ps = lambda tree, axes: jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(g, axes) * scale, tree)
-        gm_out = jax.tree_util.tree_map(
-            lambda g: (jax.lax.psum(g, tuple(_axes)) * scale
-                       if _axes else g * scale)[None], gm_acc)
-        gf_out = ps(gf_acc, dp_plus_pp)
-        gl_out = ps(gl_acc, dp_plus_pp)
-        return loss, gm_out, gf_out, gl_out
+        return _pipe_outputs(axis, _axes, nm, n_dp, loss_acc,
+                             gm_acc, gf_acc, gl_acc)
+
+    from .shard_utils import manual_region, shard_map_compat
+    mapped = shard_map_compat(
+        per_device, mesh,
+        (in_spec_params, feed_spec, rep(first_params), rep(last_params),
+         lf_spec),
+        (P(), jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+         rep(first_params), rep(last_params)))
+    with manual_region():
+        loss, g_stacked, g_first, g_last = mapped(
+            stacked_params, feeds, first_params, last_params, last_feeds)
+    return loss, (g_stacked, g_first, g_last)
+
+
+# ---------------------------------------------------------------------------
+# interleaved virtual stages (Megatron interleaved 1F1B — reference:
+# ``pipeline_parallel.py`` with ``num_virtual_pipeline_stages``: each
+# device hosts v model CHUNKS; model part index = chunk * pp + stage, so
+# a microbatch crosses every device v times. Cuts the bubble fraction
+# by ~v at the cost of v x boundary traffic.)
+# ---------------------------------------------------------------------------
+
+def make_interleaved_schedule(pp: int, n_micro: int, v: int):
+    """Slot tables for interleaved 1F1B. Returns (op[pp,T], mi[pp,T],
+    ci[pp,T]): op in {0 idle, 1 F, 2 B}; mi the micro; ci the chunk.
+
+    Queue order per stage follows the published schedule (warmup
+    forwards grouped chunk-major over micro-groups of size pp, then
+    one-F-one-B, then drain); slots are assigned by the same greedy
+    dependency simulation as the flat schedule."""
+    if v <= 1:
+        op, mi = make_1f1b_schedule(pp, n_micro)
+        return op, mi, np.zeros_like(op)
+    if n_micro % pp != 0:
+        # the chunk-major micro-grouping is only feasible when micros
+        # fill whole groups; other queue orders deadlock (verified)
+        raise ValueError(
+            f"interleaved schedule needs n_micro % pp == 0 "
+            f"(got n_micro={n_micro}, pp={pp}); pad the microbatch "
+            "count or use v=1")
+
+    total_f = v * n_micro
+
+    def f_order():
+        # i-th forward -> (chunk, micro), chunk-major within
+        # micro-groups of pp (same order on every stage)
+        out = []
+        for i in range(total_f):
+            group, rem = divmod(i, pp * v)
+            chunk, pos = divmod(rem, pp)
+            out.append((chunk, group * pp + pos))
+        return out
+
+    def b_order():
+        return [(v - 1 - c, m) for c, m in f_order()]
+
+    seqs = []
+    for s in range(pp):
+        fs = f_order()
+        bs = b_order()
+        warm = min((pp - s - 1) * 2 + (v - 1) * pp, total_f)
+        seq = [("F",) + fs[i] for i in range(warm)]
+        bi = 0
+        for fi in range(warm, total_f):
+            seq.append(("F",) + fs[fi])
+            seq.append(("B",) + bs[bi])
+            bi += 1
+        while bi < total_f:
+            seq.append(("B",) + bs[bi])
+            bi += 1
+        seqs.append(seq)
+
+    # dependency-respecting greedy slot assignment
+    slot_f, slot_b = {}, {}
+    ptr = [0] * pp
+    op_rows, mi_rows, ci_rows = [], [], []
+    t = 0
+    limit = 16 * (v * n_micro + pp) + 32
+    while any(ptr[s] < len(seqs[s]) for s in range(pp)):
+        col_op = [_IDLE] * pp
+        col_mi = [0] * pp
+        col_ci = [0] * pp
+        commit = []
+        for s in range(pp):
+            if ptr[s] >= len(seqs[s]):
+                continue
+            kind, c, m = seqs[s][ptr[s]]
+            if kind == "F":
+                if s > 0:
+                    ok = slot_f.get((s - 1, c, m), limit) < t
+                elif c > 0:
+                    ok = slot_f.get((pp - 1, c - 1, m), limit) < t
+                else:
+                    ok = True
+            else:
+                if s == pp - 1 and c == v - 1:
+                    ok = slot_f.get((s, c, m), limit) < t
+                elif s == pp - 1:
+                    ok = slot_b.get((0, c + 1, m), limit) < t
+                else:
+                    ok = slot_b.get((s + 1, c, m), limit) < t
+            if ok:
+                col_op[s] = _F if kind == "F" else _B
+                col_mi[s] = m
+                col_ci[s] = c
+                commit.append((s, kind, c, m))
+        for s, kind, c, m in commit:
+            (slot_f if kind == "F" else slot_b)[(s, c, m)] = t
+            ptr[s] += 1
+        op_rows.append(col_op)
+        mi_rows.append(col_mi)
+        ci_rows.append(col_ci)
+        t += 1
+        if t > limit:
+            raise RuntimeError(
+                f"interleaved schedule did not converge (pp={pp}, "
+                f"n_micro={n_micro}, v={v})")
+    return (np.array(op_rows, np.int32).T,
+            np.array(mi_rows, np.int32).T,
+            np.array(ci_rows, np.int32).T)
+
+
+def _ring_depth(op_tab, ci_tab, pp):
+    """Max in-flight micros per (stage, chunk): sizes the save/recv
+    rings; computed from the tables so correctness never depends on a
+    schedule-shape assumption."""
+    peak = 1
+    for s in range(pp):
+        live = {}
+        for t in range(op_tab.shape[1]):
+            key = int(ci_tab[s, t])
+            if op_tab[s, t] == _F:
+                live[key] = live.get(key, 0) + 1
+                peak = max(peak, live[key])
+            elif op_tab[s, t] == _B:
+                live[key] = live.get(key, 0) - 1
+    return peak
+
+
+def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
+                               last_fn: Callable, v: int, *,
+                               first_fn=None, first_params=None,
+                               last_params=None, last_feeds=None,
+                               mesh: Optional[Mesh] = None,
+                               axis: str = "pp",
+                               batch_axes=("dp", "sharding")):
+    """Interleaved-virtual-stage 1F1B train pass. Like
+    :func:`pipeline_1f1b_grads`, but each device hosts ``v`` model
+    chunks (stacked_params leaves are [pp, v, ...]; model part
+    ``c*pp + s`` lives at (stage s, chunk c)) and a microbatch crosses
+    the ring ``v`` times. Returns
+    ``(mean_loss, (g_stacked [pp, v, ...], g_first, g_last))``."""
+    mesh = mesh or _env.get_mesh()
+    pp = mesh.shape[axis]
+    nm = feeds.shape[0]
+    op_tab, mi_tab, ci_tab = make_interleaved_schedule(pp, nm, v)
+    T = op_tab.shape[1]
+    ring = _ring_depth(op_tab, ci_tab, pp)
+    env = _pipe_env(mesh, axis, batch_axes, feeds, last_feeds,
+                    first_fn, first_params)
+    _axes, n_dp = env["axes"], env["n_dp"]
+    feed_spec, lf_spec = env["feed_spec"], env["lf_spec"]
+    h_shape, h_dtype = env["h_shape"], env["h_dtype"]
+    rep, zeros_like_tree = env["rep"], env["zeros_like_tree"]
+    in_spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+
+    op_arr = jnp.asarray(op_tab)
+    mi_arr = jnp.asarray(mi_tab)
+    ci_arr = jnp.asarray(ci_tab)
+
+    def per_device(params_block, mbs, fparams, lparams, lfeeds):
+        # leaves [1, v, ...] -> [v, ...]
+        params_local = jax.tree_util.tree_map(lambda x: x[0],
+                                              params_block)
+        stage = jax.lax.axis_index(axis)
+        perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
+        is_first = stage == 0
+        is_last = stage == pp - 1
+
+        zr = lambda: jnp.zeros((v, ring) + h_shape, h_dtype)
+        g_mid0 = zeros_like_tree(params_local)        # [v, ...]
+        g_first0 = zeros_like_tree(fparams)
+        g_last0 = zeros_like_tree(lparams)
+
+        def chunk_params(c):
+            return jax.tree_util.tree_map(lambda x: x[c], params_local)
+
+        def chunk_zero_like(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape[1:], x.dtype), tree)
+
+        def lf_of(m):
+            return None if lfeeds is None else lfeeds[m]
+
+        def body_idle(oprnd):
+            in_ring, fbuf, gbuf, m, c = oprnd
+            zeros_h = jnp.zeros(h_shape, h_dtype)
+            return (in_ring, zeros_h, zeros_h,
+                    chunk_zero_like(params_local), g_first0, g_last0,
+                    jnp.zeros((), jnp.float32), c)
+
+        def body_F(oprnd):
+            in_ring, fbuf, gbuf, m, c = oprnd
+            p_c = chunk_params(c)
+            first_part = is_first & (c == 0)
+            last_part = is_last & (c == v - 1)
+            if first_fn is not None:
+                x0 = jax.lax.cond(
+                    first_part, lambda: first_fn(fparams, mbs[m]),
+                    lambda: jnp.zeros(h_shape, h_dtype))
+                x_in = jnp.where(first_part, x0, fbuf[c, m % ring])
+            else:
+                x_in = jnp.where(first_part, mbs[m].astype(h_dtype),
+                                 fbuf[c, m % ring])
+            in_ring = in_ring.at[c, m % ring].set(x_in)
+            y = jax.lax.cond(last_part,
+                             lambda: jnp.zeros(h_shape, h_dtype),
+                             lambda: stage_fn(p_c, x_in))
+            return (in_ring, y, jnp.zeros(h_shape, h_dtype),
+                    chunk_zero_like(params_local), g_first0, g_last0,
+                    jnp.zeros((), jnp.float32), c)
+
+        def body_B(oprnd):
+            in_ring, fbuf, gbuf, m, c = oprnd
+            p_c = chunk_params(c)
+            x_saved = in_ring[c, m % ring]
+            g_in = gbuf[c, m % ring]
+            first_part = is_first & (c == 0)
+            last_part = is_last & (c == v - 1)
+
+            def last_case():
+                def loss_of(p_mid, p_last, x):
+                    y = stage_fn(p_mid, x)
+                    return last_fn(p_last, y, lf_of(m)).astype(
+                        jnp.float32)
+                (loss, (gm, gl, gx)) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1, 2))(p_c, lparams, x_saved)
+                return gm, g_first0, gl, gx, loss
+
+            def first_case():
+                if first_fn is None:
+                    return mid_case()
+
+                def fwd(p_first, p_mid, feed):
+                    return stage_fn(p_mid, first_fn(p_first, feed))
+                _, pull = jax.vjp(fwd, fparams, p_c, mbs[m])
+                gf, gm, _ = pull(g_in)
+                return gm, gf, g_last0, jnp.zeros(h_shape, h_dtype), \
+                    jnp.zeros((), jnp.float32)
+
+            def mid_case():
+                _, pull = jax.vjp(
+                    lambda p, x: stage_fn(p, x), p_c, x_saved)
+                gm, gx = pull(g_in)
+                return gm, g_first0, g_last0, gx, \
+                    jnp.zeros((), jnp.float32)
+
+            gm, gf, gl, gx, loss = jax.lax.cond(
+                last_part, last_case,
+                lambda: jax.lax.cond(first_part, first_case, mid_case))
+            return (in_ring, jnp.zeros(h_shape, h_dtype), gx, gm, gf,
+                    gl, loss, c)
+
+        def slot(carry, t):
+            (in_ring, fbuf, gbuf, gm_acc, gf_acc, gl_acc,
+             loss_acc) = carry
+            op = op_arr[stage, t]
+            m = mi_arr[stage, t]
+            c = ci_arr[stage, t]
+            (in_ring, send_f, send_g, gm, gf, gl, loss,
+             c_out) = jax.lax.switch(op, [body_idle, body_F, body_B],
+                                     (in_ring, fbuf, gbuf, m, c))
+            recv_f = jax.lax.ppermute(send_f, axis, perm_fwd)
+            recv_g = jax.lax.ppermute(send_g, axis, perm_bwd)
+            prev = (stage - 1) % pp
+            nxt = (stage + 1) % pp
+            p_op, p_mi, p_ci = op_arr[prev, t], mi_arr[prev, t], \
+                ci_arr[prev, t]
+            n_op, n_mi, n_ci = op_arr[nxt, t], mi_arr[nxt, t], \
+                ci_arr[nxt, t]
+            # forward routing: normal hop keeps the chunk; the wrap from
+            # the last stage feeds the NEXT chunk at stage 0
+            take_f = (p_op == _F) & (
+                (stage > 0) | ((stage == 0) & (p_ci < v - 1)))
+            fdst = jnp.where(stage == 0, jnp.minimum(p_ci + 1, v - 1),
+                             p_ci)
+            fbuf = jnp.where(take_f,
+                             fbuf.at[fdst, p_mi % ring].set(recv_f),
+                             fbuf)
+            # backward routing mirrors it: the wrap from stage 0 feeds
+            # the PREVIOUS chunk at the last stage
+            take_g = (n_op == _B) & (
+                (stage < pp - 1) | ((stage == pp - 1) & (n_ci > 0)))
+            gdst = jnp.where(stage == pp - 1, jnp.maximum(n_ci - 1, 0),
+                             n_ci)
+            gbuf = jnp.where(take_g,
+                             gbuf.at[gdst, n_mi % ring].set(recv_g),
+                             gbuf)
+            add = jax.tree_util.tree_map
+            gm_acc = add(lambda acc, g: acc.at[c].add(g), gm_acc, gm)
+            return (in_ring, fbuf, gbuf, gm_acc,
+                    add(jnp.add, gf_acc, gf), add(jnp.add, gl_acc, gl),
+                    loss_acc + loss), None
+
+        carry0 = (zr(), zr(), zr(), g_mid0, g_first0, g_last0,
+                  jnp.zeros((), jnp.float32))
+        (in_ring, fbuf, gbuf, gm_acc, gf_acc, gl_acc,
+         loss_acc), _ = jax.lax.scan(slot, carry0, jnp.arange(T))
+
+        return _pipe_outputs(axis, _axes, nm, n_dp, loss_acc,
+                             gm_acc, gf_acc, gl_acc)
 
     from .shard_utils import manual_region, shard_map_compat
     mapped = shard_map_compat(
