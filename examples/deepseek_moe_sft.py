@@ -1,0 +1,108 @@
+"""DeepSeekMoE supervised finetune the way a PaddleNLP LLM user writes
+it (reference pattern: ``PaddleNLP/llm/run_finetune.py`` with
+``deepseek`` configs): instruction-style data with prompt tokens masked
+out of the loss (ignore_index), aux-load-balance loss folded in, AdamW
+with linear warmup + decay, then greedy generation from a finetuned
+prompt.
+
+    python examples/deepseek_moe_sft.py --tiny
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.models.deepseek_moe import (DeepseekMoeConfig,
+                                            DeepseekMoeForCausalLM)
+
+IGNORE = -100
+
+
+class InstructionPairs(Dataset):
+    """prompt = [p, x]; response = the arithmetic chain x, 2x, 3x (mod
+    V). Loss sees only response positions (prompt labels = IGNORE)."""
+
+    def __init__(self, vocab, n=256, resp_len=6, seed=0):
+        rng = np.random.RandomState(seed)
+        p = rng.randint(4, vocab, size=(n, 2)).astype(np.int64)
+        xs = p[:, 1:2]
+        resp = np.concatenate(
+            [(xs * (k + 2)) % vocab for k in range(resp_len)],
+            axis=1).astype(np.int64)
+        ids = np.concatenate([p, resp], axis=1)
+        self.inp = ids[:, :-1]
+        labels = np.roll(ids, -1, axis=1)[:, :-1]
+        labels[:, : p.shape[1] - 1] = IGNORE      # mask the prompt
+        self.labels = labels
+
+    def __len__(self):
+        return len(self.inp)
+
+    def __getitem__(self, i):
+        return self.inp[i], self.labels[i]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--steps", type=int, default=250)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    args = ap.parse_args(argv)
+
+    cfg = DeepseekMoeConfig.tiny(vocab=64, hidden=96, layers=3, heads=4,
+                                 kv_heads=4, moe_ffn=48, dense_ffn=144,
+                                 experts=8, shared=1, topk=2) \
+        if args.tiny else DeepseekMoeConfig()
+    paddle.seed(9)
+    model = DeepseekMoeForCausalLM(cfg)
+    model.train()
+
+    sched = paddle.optimizer.lr.LinearWarmup(
+        paddle.optimizer.lr.PolynomialDecay(
+            learning_rate=args.lr, decay_steps=args.steps, end_lr=0.0),
+        warmup_steps=10, start_lr=0.0, end_lr=args.lr)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=sched, parameters=model.parameters(),
+        weight_decay=0.01, grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    from paddle_tpu.jit import TrainStep
+    # model(input_ids, labels=...) returns masked CE + aux-balance loss
+    # (ignore_index=-100 masks the prompt positions)
+    step_fn = TrainStep(model, lambda out, a, k: out, opt)
+    loader = DataLoader(InstructionPairs(cfg.vocab_size),
+                        batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    losses, step = [], 0
+    while step < args.steps:
+        for xb, yb in loader:
+            loss = step_fn(paddle.to_tensor(np.asarray(xb)),
+                           labels=paddle.to_tensor(np.asarray(yb)))
+            sched.step()
+            losses.append(float(loss.numpy()))
+            step += 1
+            if step >= args.steps:
+                break
+    print(f"sft loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0] * 0.5, "DeepSeekMoE SFT did not learn"
+
+    # ---- greedy generation reproduces the finetuned chain ----
+    model.eval()
+    x = 7
+    prompt = np.array([[5, x]], np.int64)
+    out = model.generate(paddle.to_tensor(prompt), max_new_tokens=4,
+                         decode_strategy="greedy_search")
+    ids = np.asarray(out[0].numpy() if isinstance(out, (tuple, list))
+                     else out.numpy())[0]
+    want = [(x * (k + 2)) % cfg.vocab_size for k in range(len(ids))]
+    n_match = int((ids == np.asarray(want)).sum())
+    print("greedy:", ids.tolist(), "want:", want,
+          f"matches {n_match}/{len(ids)}")
+    return losses, n_match / len(ids)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Qwen2 (dense) pretraining + generation the way a PaddleNLP LLM user
+writes it (reference pattern: ``PaddleNLP/llm/run_pretrain.py`` with a
+qwen2 config + ``predict/predictor.py``): causal-LM pretrain with the
+pretraining criterion, bf16 autocast, whole-step compile, then greedy
+and top-p generation from the trained model.
+
+    python examples/qwen2_pretrain_generate.py --tiny
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.models.qwen2 import (Qwen2Config, Qwen2ForCausalLM,
+                                     Qwen2PretrainingCriterion)
+
+
+class CausalCorpus(Dataset):
+    """Deterministic next-token structure: ids[t+1] = (ids[t]*3+2)%V."""
+
+    def __init__(self, vocab, seq_len, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        start = rng.randint(0, vocab, size=(n, 1))
+        rows = [start]
+        for _ in range(seq_len - 1):
+            rows.append((rows[-1] * 3 + 2) % vocab)
+        ids = np.concatenate(rows, axis=1).astype(np.int64)
+        self.inp = ids[:, :-1]
+        self.labels = ids[:, 1:]        # dataset-shifts convention
+
+    def __len__(self):
+        return len(self.inp)
+
+    def __getitem__(self, i):
+        return self.inp[i], self.labels[i]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--seq_len", type=int, default=33)
+    args = ap.parse_args(argv)
+
+    cfg = Qwen2Config.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, ffn=176) \
+        if args.tiny else Qwen2Config()
+    assert cfg.qkv_bias, "Qwen2 must carry qkv bias"
+    paddle.seed(13)
+    model = Qwen2ForCausalLM(cfg)
+    model.train()
+
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(
+        learning_rate=args.lr, T_max=args.steps)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=sched, parameters=model.parameters(),
+        weight_decay=0.01, grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    criterion = Qwen2PretrainingCriterion(cfg)
+
+    from paddle_tpu.jit import TrainStep
+    step_fn = TrainStep(
+        model, lambda out, a, k: criterion(
+            out, paddle.Tensor(k["_labels"][0])), opt)
+
+    loader = DataLoader(CausalCorpus(cfg.vocab_size, args.seq_len + 1),
+                        batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    losses, step = [], 0
+    while step < args.steps:
+        for xb, yb in loader:
+            loss = step_fn(paddle.to_tensor(np.asarray(xb)),
+                           _labels=(paddle.to_tensor(np.asarray(yb)),))
+            sched.step()
+            losses.append(float(loss.numpy()))
+            step += 1
+            if step >= args.steps:
+                break
+    print(f"qwen2 pretrain loss: {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0] * 0.1, "Qwen2 pretraining did not learn"
+
+    # ---- generation must follow the learned chain ----
+    model.eval()
+    prompt = np.array([[9, (9 * 3 + 2) % cfg.vocab_size]], np.int64)
+    out = model.generate(paddle.to_tensor(prompt), max_new_tokens=8,
+                         decode_strategy="greedy_search")
+    ids = np.asarray(out[0].numpy() if isinstance(out, (tuple, list))
+                     else out.numpy())[0]
+    want, cur = [], int(prompt[0, -1])
+    for _ in range(len(ids)):
+        cur = (cur * 3 + 2) % cfg.vocab_size
+        want.append(cur)
+    n_match = int((ids == np.asarray(want)).sum())
+    print("greedy:", ids.tolist(), "want:", want,
+          f"matches {n_match}/{len(ids)}")
+    assert n_match >= len(ids) // 2, "generation did not follow the chain"
+
+    out_s = model.generate(paddle.to_tensor(prompt), max_new_tokens=4,
+                           decode_strategy="sampling", top_p=0.9,
+                           temperature=0.7)
+    ids_s = np.asarray(out_s[0].numpy() if isinstance(out_s, (tuple, list))
+                       else out_s.numpy())
+    print("sampling OK:", ids_s[0].tolist())
+    return losses, n_match / len(ids)
+
+
+if __name__ == "__main__":
+    main()

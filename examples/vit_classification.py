@@ -1,0 +1,96 @@
+"""ViT image classification the way a PaddleClas user writes it
+(reference pattern: ``PaddleClas ppcls/arch/backbone/model_zoo/
+vision_transformer.py`` + train.py): patch embedding via Conv2D, class
+token + learned position embeddings, pre-norm TransformerEncoder, and
+``paddle.Model.fit`` (hapi) driving training with Accuracy metric.
+
+    python examples/vit_classification.py --tiny
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.io import Dataset
+
+
+class SyntheticShapes(Dataset):
+    """4-class synthetic images: a bright square in one of 4 quadrants
+    (+noise) — learnable by attention over patches."""
+
+    def __init__(self, n=512, size=32, seed=0):
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, 3, size, size).astype(np.float32) * 0.3
+        self.y = rng.randint(0, 4, size=(n,)).astype(np.int64)
+        h = size // 2
+        for i, c in enumerate(self.y):
+            r0, c0 = (c // 2) * h, (c % 2) * h
+            self.x[i, :, r0:r0 + h, c0:c0 + h] += 1.5
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class ViT(nn.Layer):
+    def __init__(self, image_size=32, patch_size=8, num_classes=4,
+                 d_model=96, nhead=4, layers=3, ffn=192):
+        super().__init__()
+        n_patches = (image_size // patch_size) ** 2
+        self.patch_embed = nn.Conv2D(3, d_model, kernel_size=patch_size,
+                                     stride=patch_size)
+        self.cls_token = paddle.create_parameter(
+            [1, 1, d_model], "float32",
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_embed = paddle.create_parameter(
+            [1, n_patches + 1, d_model], "float32",
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model, nhead, ffn, dropout=0.0, activation="gelu",
+            normalize_before=True)
+        self.encoder = nn.TransformerEncoder(enc_layer, layers,
+                                             norm=nn.LayerNorm(d_model))
+        self.head = nn.Linear(d_model, num_classes)
+
+    def forward(self, x):
+        p = self.patch_embed(x)                       # [B, D, H', W']
+        p = p.flatten(start_axis=2).transpose([0, 2, 1])   # [B, N, D]
+        cls = self.cls_token.expand([p.shape[0], 1, p.shape[2]])
+        h = paddle.concat([cls, p], axis=1) + self.pos_embed
+        h = self.encoder(h)
+        return self.head(h[:, 0])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--epochs", type=int, default=15)
+    ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args(argv)
+
+    paddle.seed(3)
+    net = ViT() if args.tiny else ViT(d_model=384, nhead=6, layers=12,
+                                      ffn=1536)
+    model = paddle.Model(net)
+    opt = paddle.optimizer.AdamW(learning_rate=args.lr,
+                                 parameters=net.parameters(),
+                                 weight_decay=0.05)
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  paddle.metric.Accuracy())
+    train_ds = SyntheticShapes(n=512, seed=0)
+    val_ds = SyntheticShapes(n=128, seed=1)
+    model.fit(train_ds, epochs=args.epochs,
+              batch_size=args.batch_size, verbose=0)
+    res = model.evaluate(val_ds, batch_size=args.batch_size, verbose=0)
+    acc = float(res["acc"])
+    print(f"ViT val accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    acc = main()
+    assert acc > 0.9, f"ViT did not learn: {acc}"

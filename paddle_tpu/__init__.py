@@ -59,7 +59,7 @@ from . import distribution
 from .hapi import Model, summary
 from .hapi import callbacks
 from .framework.io import save, load
-from .nn.layer.layers import Layer
+from .nn.layer.layers import Layer, create_parameter
 from .parallel import DataParallel
 from .base_flags import set_flags, get_flags
 

@@ -24,6 +24,9 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_dataloader_start_method": "spawn",  # or "fork"/"forkserver"
     "FLAGS_paddle_tpu_default_matmul_precision": "default",
     "FLAGS_log_level": 0,
+    # pre-registered here (not at consumer import) so set_flags before the
+    # consumer module loads never warns "not consumed"
+    "FLAGS_paddle_tpu_remat_policy": "full",
 }
 
 

@@ -178,16 +178,24 @@ def init_eager_backend(host=None, port=None, rank=None, world_size=None):
         rank = e.rank if rank is None else rank
         world_size = e.world_size if world_size is None else world_size
         if host is None or port is None:
-            master = os.environ.get("PADDLE_EAGER_STORE") or \
-                os.environ.get("PADDLE_MASTER")
+            eager_store = os.environ.get("PADDLE_EAGER_STORE")
+            master = eager_store or os.environ.get("PADDLE_MASTER")
             if not master:
                 raise RuntimeError(
                     "eager backend needs PADDLE_MASTER or "
                     "PADDLE_EAGER_STORE (host:port)")
             host, p = master.rsplit(":", 1)
-            # offset from the rendezvous port: the launch controller's
-            # store may already own PADDLE_MASTER
-            port = int(p) + 2 if port is None else port
+            if port is None:
+                if eager_store:
+                    # an explicit PADDLE_EAGER_STORE names the exact
+                    # store address — honor its port verbatim
+                    port = int(p)
+                else:
+                    # derived from PADDLE_MASTER: offset past the
+                    # launch controller's rendezvous store (which owns
+                    # that port), unless overridden explicitly
+                    port = int(os.environ.get("PADDLE_EAGER_STORE_PORT")
+                               or int(p) + 2)
     if _backend is None:
         _backend = StoreBackend(host, int(port), rank, world_size)
     return _backend
@@ -210,11 +218,21 @@ def get_eager_backend() -> Optional[StoreBackend]:
         return init_eager_backend()
     except Exception as exc:
         _backend_failed = True   # don't retry per op
-        import warnings
-        warnings.warn(
-            f"eager collective backend FAILED to initialize ({exc!r}); "
-            "cross-process collectives on this rank degrade to "
-            "single-process identity — ranks may silently diverge. Fix "
-            "the store address (PADDLE_EAGER_STORE/PADDLE_MASTER) or "
-            "unset the launch env.")
-        return None
+        if os.environ.get("PADDLE_EAGER_ALLOW_DEGRADE", "").lower() in (
+                "1", "true", "yes", "on"):
+            import warnings
+            warnings.warn(
+                f"eager collective backend FAILED to initialize ({exc!r});"
+                " cross-process collectives on this rank degrade to "
+                "single-process identity — ranks may silently diverge "
+                "(PADDLE_EAGER_ALLOW_DEGRADE is set).")
+            return None
+        # a launch env with world_size > 1 promised a real backend; a
+        # silent per-rank identity fallback would let ranks diverge —
+        # fail loudly instead (PADDLE_EAGER_ALLOW_DEGRADE=1 opts out)
+        raise RuntimeError(
+            "eager collective backend failed to initialize for a "
+            f"world_size={_env.get_world_size()} launch: {exc!r}. Set "
+            "PADDLE_EAGER_STORE / PADDLE_EAGER_STORE_PORT to a reachable "
+            "store address, or PADDLE_EAGER_ALLOW_DEGRADE=1 to accept "
+            "single-process identity semantics.") from exc

@@ -411,21 +411,73 @@ def make_interleaved_schedule(pp: int, n_micro: int, v: int):
             np.array(ci_rows, np.int32).T)
 
 
-def _ring_depth(op_tab, ci_tab, pp):
-    """Max in-flight micros per (stage, chunk): sizes the save/recv
-    rings; computed from the tables so correctness never depends on a
-    schedule-shape assumption."""
-    peak = 1
+def _ring_depth(op_tab, mi_tab, ci_tab, pp, v):
+    """Minimal ring size such that no two in-flight entries of ANY of the
+    three ``m % ring``-slotted buffers collide, computed from the tables
+    so correctness never depends on a schedule-shape assumption.
+
+    Occupancy windows per (stage, chunk), keyed by micro m:
+    - in_ring (saved stage input): own F slot -> own B slot;
+    - fbuf (boundary activation):  prev-stage F slot (ppermute arrival,
+      end of slot) -> own F slot (read at slot start, so a same-slot
+      rewrite is safe);
+    - gbuf (boundary gradient):    next-stage B slot -> own B slot.
+    Two windows with m1 % ring == m2 % ring collide iff one's write lands
+    strictly inside the other's window."""
+    T = op_tab.shape[1]
+    f_slot, b_slot = {}, {}
     for s in range(pp):
-        live = {}
-        for t in range(op_tab.shape[1]):
-            key = int(ci_tab[s, t])
+        for t in range(T):
+            k = (s, int(ci_tab[s, t]), int(mi_tab[s, t]))
             if op_tab[s, t] == _F:
-                live[key] = live.get(key, 0) + 1
-                peak = max(peak, live[key])
+                f_slot[k] = t
             elif op_tab[s, t] == _B:
-                live[key] = live.get(key, 0) - 1
-    return peak
+                b_slot[k] = t
+
+    spans = {}   # (buffer, stage, chunk) -> [(t_write, t_read, m)]
+
+    def add(buf, s, c, tw, tr, m):
+        spans.setdefault((buf, s, c), []).append((tw, tr, m))
+
+    for (s, c, m), tf in f_slot.items():
+        tb = b_slot.get((s, c, m))
+        if tb is not None:
+            add("in", s, c, tf, tb, m)                    # in_ring
+        # fbuf: who wrote this activation? prev stage's F (chunk-routed)
+        prev = (s - 1) % pp
+        src_c = c - 1 if s == 0 else c
+        if not (s == 0 and c == 0):
+            tw = f_slot.get((prev, src_c, m))
+            if tw is not None:
+                add("f", s, c, tw, tf, m)
+        # gbuf: written by next stage's B, read at own B
+        if tb is not None and not (s == pp - 1 and c == v - 1):
+            nxt = (s + 1) % pp
+            src_c = c + 1 if s == pp - 1 else c
+            tw = b_slot.get((nxt, src_c, m))
+            if tw is not None:
+                add("g", s, c, tw, tb, m)
+
+    def collides(ring):
+        for key, lst in spans.items():
+            same_slot_read_ok = key[0] in ("f", "g")   # read-then-write
+            for i in range(len(lst)):
+                tw1, tr1, m1 = lst[i]
+                for j in range(i + 1, len(lst)):
+                    tw2, tr2, m2 = lst[j]
+                    if m1 % ring != m2 % ring:
+                        continue
+                    hi1 = tr1 if same_slot_read_ok else tr1 + 1
+                    hi2 = tr2 if same_slot_read_ok else tr2 + 1
+                    if tw1 < tw2 < hi1 or tw2 < tw1 < hi2:
+                        return True
+        return False
+
+    ring = 1
+    n_micro = int(mi_tab.max()) + 1 if mi_tab.size else 1
+    while ring < n_micro and collides(ring):
+        ring += 1
+    return ring
 
 
 def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
@@ -446,7 +498,7 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
     nm = feeds.shape[0]
     op_tab, mi_tab, ci_tab = make_interleaved_schedule(pp, nm, v)
     T = op_tab.shape[1]
-    ring = _ring_depth(op_tab, ci_tab, pp)
+    ring = _ring_depth(op_tab, mi_tab, ci_tab, pp, v)
     env = _pipe_env(mesh, axis, batch_axes, feeds, last_feeds,
                     first_fn, first_params)
     _axes, n_dp = env["axes"], env["n_dp"]

@@ -56,6 +56,7 @@ class _GradState(threading.local):
 
 
 _grad_state = _GradState()
+_warned_to_device = False
 
 
 def in_functional_mode() -> bool:
@@ -87,13 +88,15 @@ def functional_buffer_write(t: "Tensor", new_arr) -> None:
 def capture_buffer_writes():
     """Roll back functional buffer writes on exit (binderless
     ``to_static``: there is no binder to thread the new values, so
-    keeping them would leak trace-time tracers into persistent state)."""
+    keeping them would leak trace-time tracers into persistent state).
+    Yields the journal so callers can inspect what was (speculatively)
+    written — dy2static uses a non-empty journal to graph-break."""
     prev = _grad_state.buffer_capture
-    _grad_state.buffer_capture = []
+    _grad_state.buffer_capture = journal = []
     try:
-        yield
+        yield journal
     finally:
-        for t, old in reversed(_grad_state.buffer_capture):
+        for t, old in reversed(journal):
             t._data = old
         _grad_state.buffer_capture = prev
 
@@ -411,7 +414,18 @@ class Tensor:
             elif isinstance(a, dtypes.DType):
                 t = t.astype(a)
             elif isinstance(a, (Place, str)):
-                pass  # single-process device moves are no-ops on TPU
+                # single-process device moves are no-ops on TPU (XLA owns
+                # placement); say so once instead of silently ignoring
+                global _warned_to_device
+                if not _warned_to_device:
+                    _warned_to_device = True
+                    import warnings
+                    warnings.warn(
+                        f"Tensor.to({a!r}): device moves are ignored in "
+                        "single-process TPU execution (XLA owns "
+                        "placement); use dist.shard_tensor / "
+                        "paddle.device.set_device for placement control. "
+                        "(warned once)")
         return t
 
     def pin_memory(self):

@@ -26,10 +26,16 @@ __all__ = ["GenerationConfig", "GenerationMixin", "LoadedGeneration", "load_gene
 @dataclass
 class GenerationConfig:
     max_new_tokens: int = 20
-    decode_strategy: str = "greedy_search"  # or "sampling"
+    # greedy_search | sampling | beam_search | group_beam_search
+    decode_strategy: str = "greedy_search"
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    num_beams: int = 1
+    num_beam_groups: int = 1
+    diversity_rate: float = 0.0        # PaddleNLP group-beam penalty
+    length_penalty: float = 0.0        # score / len**length_penalty
+    early_stopping: bool = False
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None
     seed: Optional[int] = None
@@ -84,17 +90,14 @@ class GenerationMixin:
 
     @staticmethod
     def _resolve_strategy(strategy):
-        if strategy not in ("greedy_search", "sampling"):
+        if strategy not in ("greedy_search", "sampling", "beam_search",
+                            "group_beam_search"):
             raise NotImplementedError(
-                f"decode_strategy {strategy!r} (beam search not "
-                "implemented; use greedy_search or sampling)")
+                f"decode_strategy {strategy!r}; supported: greedy_search, "
+                "sampling, beam_search, group_beam_search")
         return strategy == "sampling"
 
-    def _build_run(self, binder, buffers, b, prompt_len, max_new,
-                   select, eos, pad, with_scores):
-        """run(params, ids, key) -> out ids [, scores]: prefill + one
-        lax.while_loop with in-loop EOS early exit."""
-
+    def _build_model_step(self, binder, buffers):
         def model_step(params_a, tok_ids, caches, off):
             t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
             out, _ = binder.call(
@@ -103,6 +106,14 @@ class GenerationMixin:
             logits, new_caches = out
             return as_jax(logits), [(as_jax(k), as_jax(v))
                                     for k, v in new_caches]
+        return model_step
+
+    def _build_run(self, binder, buffers, b, prompt_len, max_new,
+                   select, eos, pad, with_scores):
+        """run(params, ids, key) -> out ids [, scores]: prefill + one
+        lax.while_loop with in-loop EOS early exit."""
+
+        model_step = self._build_model_step(binder, buffers)
 
         def run(params_a, ids_a, key):
             caches = self.init_caches(b, prompt_len + max_new)
@@ -143,29 +154,44 @@ class GenerationMixin:
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  max_new_tokens=None, max_length=None,
                  decode_strategy=None, temperature=None, top_k=None,
-                 top_p=None, eos_token_id=None, pad_token_id=None,
-                 seed=None, **kwargs):
+                 top_p=None, num_beams=None, num_beam_groups=None,
+                 diversity_rate=None, length_penalty=None,
+                 early_stopping=None, eos_token_id=None,
+                 pad_token_id=None, seed=None, **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
-        log-probability of the chosen tokens per sequence."""
+        log-probability of the chosen tokens per sequence (for beam
+        strategies: the best hypothesis and its length-penalized
+        score)."""
         if kwargs:
             # silently dropping generation options produces output that
             # looks valid but ignores the request — fail instead
             raise TypeError(
                 f"generate() got unsupported options {sorted(kwargs)}; "
                 "supported: max_new_tokens/max_length, decode_strategy "
-                "(greedy_search|sampling), temperature, top_k, top_p, "
+                "(greedy_search|sampling|beam_search|group_beam_search), "
+                "temperature, top_k, top_p, num_beams, num_beam_groups, "
+                "diversity_rate, length_penalty, early_stopping, "
                 "eos_token_id, pad_token_id, seed")
         cfg = generation_config or GenerationConfig()
         if max_length is not None and max_new_tokens is None:
             max_new_tokens = max_length  # PaddleNLP: length of generation
         max_new = int(max_new_tokens or cfg.max_new_tokens)
-        do_sample = self._resolve_strategy(
-            decode_strategy or cfg.decode_strategy)
+        strategy = decode_strategy or cfg.decode_strategy
+        do_sample = self._resolve_strategy(strategy)
         temperature = cfg.temperature if temperature is None \
             else float(temperature)
         top_k = cfg.top_k if top_k is None else int(top_k)
         top_p = cfg.top_p if top_p is None else float(top_p)
+        num_beams = cfg.num_beams if num_beams is None else int(num_beams)
+        num_beam_groups = cfg.num_beam_groups if num_beam_groups is None \
+            else int(num_beam_groups)
+        diversity_rate = cfg.diversity_rate if diversity_rate is None \
+            else float(diversity_rate)
+        length_penalty = cfg.length_penalty if length_penalty is None \
+            else float(length_penalty)
+        early_stopping = cfg.early_stopping if early_stopping is None \
+            else bool(early_stopping)
         eos = eos_token_id if eos_token_id is not None else cfg.eos_token_id
         pad = pad_token_id if pad_token_id is not None else cfg.pad_token_id
         eos = -1 if eos is None else int(eos)   # -1 never matches
@@ -185,16 +211,50 @@ class GenerationMixin:
         params = binder.param_arrays()
         buffers = binder.buffer_arrays()
 
-        select = lambda lg, k: _select_token(
-            lg, k, do_sample=do_sample, temperature=temperature,
-            top_k=top_k, top_p=top_p)
-        run = self._build_run(binder, buffers, b, prompt_len, max_new,
-                              select, eos, pad, with_scores=True)
+        is_beam = strategy in ("beam_search", "group_beam_search")
+        # inapplicable-option guard (same policy as the unknown-kwargs
+        # guard above: dropping a requested option silently is worse
+        # than failing)
+        if is_beam and (temperature != 1.0 or top_k or top_p != 1.0):
+            raise ValueError(
+                f"{strategy} is deterministic; temperature/top_k/top_p "
+                "do not apply (use decode_strategy='sampling')")
+        if strategy == "beam_search" and (num_beam_groups > 1
+                                          or diversity_rate):
+            raise ValueError(
+                "num_beam_groups/diversity_rate require "
+                "decode_strategy='group_beam_search'")
+        if not is_beam and num_beams > 1:
+            raise ValueError(
+                f"num_beams={num_beams} requires decode_strategy="
+                "'beam_search' or 'group_beam_search' "
+                f"(got {strategy!r})")
+        if is_beam:
+            from .beam import build_beam_run
+            groups = num_beam_groups if strategy == "group_beam_search" \
+                else 1
+            run = build_beam_run(
+                self._build_model_step(binder, buffers),
+                lambda bb: self.init_caches(bb, prompt_len + max_new),
+                b, prompt_len, max_new, num_beams=num_beams,
+                num_beam_groups=groups, diversity_rate=diversity_rate,
+                length_penalty=length_penalty,
+                early_stopping=early_stopping, eos=eos, pad=pad,
+                with_scores=True)
+            jit_key = (b, prompt_len, max_new, strategy, num_beams,
+                       groups, diversity_rate, length_penalty,
+                       early_stopping, eos, pad)
+        else:
+            select = lambda lg, k: _select_token(
+                lg, k, do_sample=do_sample, temperature=temperature,
+                top_k=top_k, top_p=top_p)
+            run = self._build_run(binder, buffers, b, prompt_len, max_new,
+                                  select, eos, pad, with_scores=True)
+            jit_key = (b, prompt_len, max_new, do_sample, temperature,
+                       top_k, top_p, eos, pad)
 
         if not hasattr(self, "_generate_jit_cache"):
             self._generate_jit_cache = {}
-        jit_key = (b, prompt_len, max_new, do_sample, temperature, top_k,
-                   top_p, eos, pad)
         jitted = self._generate_jit_cache.get(jit_key)
         if jitted is None:
             jitted = jax.jit(run)
@@ -226,11 +286,25 @@ class GenerationMixin:
         params = binder.param_arrays()
         buffers = binder.buffer_arrays()
 
-        select = lambda lg, k: _select_token(
-            lg, k, do_sample=do_sample, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p)
-        run = self._build_run(binder, buffers, b, prompt, max_new,
-                              select, eos, pad, with_scores=False)
+        if cfg.decode_strategy in ("beam_search", "group_beam_search"):
+            from .beam import build_beam_run
+            groups = cfg.num_beam_groups \
+                if cfg.decode_strategy == "group_beam_search" else 1
+            run = build_beam_run(
+                self._build_model_step(binder, buffers),
+                lambda bb: self.init_caches(bb, prompt + max_new),
+                b, prompt, max_new, num_beams=cfg.num_beams,
+                num_beam_groups=groups,
+                diversity_rate=cfg.diversity_rate,
+                length_penalty=cfg.length_penalty,
+                early_stopping=cfg.early_stopping, eos=eos, pad=pad,
+                with_scores=False)
+        else:
+            select = lambda lg, k: _select_token(
+                lg, k, do_sample=do_sample, temperature=cfg.temperature,
+                top_k=cfg.top_k, top_p=cfg.top_p)
+            run = self._build_run(binder, buffers, b, prompt, max_new,
+                                  select, eos, pad, with_scores=False)
 
         def run_seeded(params_a, ids_a, seed):
             return run(params_a, ids_a, jax.random.PRNGKey(seed))

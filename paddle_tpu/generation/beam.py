@@ -1,0 +1,213 @@
+"""Beam search / group (diverse) beam search under jit (reference:
+PaddleNLP ``paddlenlp/generation/utils.py`` ``beam_search`` +
+``group_beam_search`` with ``BeamSearchScorer``; upstream beam-search
+ops ``paddle/phi/kernels`` beam_search*).
+
+TPU-first formulation (the flax-canonical static-shape algorithm, built
+independently here): beams ride a flattened [B*G*K] batch through the
+SAME cached decode step greedy uses; each step takes top-2K candidates
+per group (2K guarantees K non-EOS continuations exist), moves
+EOS-ending candidates into a K-slot finished set under the length
+penalty, gathers the KV caches by chosen-beam index, and early-stops
+inside the ``lax.while_loop`` condition when no live beam can still
+beat the worst finished hypothesis. Group/diverse beam search processes
+groups sequentially within a step, penalizing tokens already chosen by
+earlier groups at the same step (Hamming diversity, PaddleNLP
+``diversity_rate``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG = jnp.float32(-1.0e9)
+
+
+def _length_penalty(length, alpha):
+    # PaddleNLP BeamSearchScorer: score / (hyp_len ** length_penalty)
+    return jnp.power(length.astype(jnp.float32), jnp.float32(alpha))
+
+
+def build_beam_run(model_step, init_caches, b, prompt_len, max_new, *,
+                   num_beams, num_beam_groups=1, diversity_rate=0.0,
+                   length_penalty=0.0, early_stopping=False, eos=-1,
+                   pad=0, with_scores=True):
+    """Returns ``run(params, ids [B, prompt], key) -> ids [B, max_new]
+    [, scores [B]]`` — best hypothesis per batch row.
+
+    model_step(params, tok [N, L], caches, off) -> (logits [N, L, V],
+    caches); init_caches(batch) -> per-layer cache list.
+    """
+    G = int(num_beam_groups)
+    K = int(num_beams) // G
+    if num_beams % G:
+        raise ValueError(
+            f"num_beams ({num_beams}) must be divisible by "
+            f"num_beam_groups ({G})")
+    BGK = b * G * K
+    alpha = float(length_penalty)
+    div = float(diversity_rate)
+
+    def lp(length):
+        return _length_penalty(jnp.asarray(length), alpha)
+
+    def flat_gather(caches, beam_sel):
+        """Reorder [B*G*K, ...] cache rows by per-(batch, group) beam
+        selection [B, G, K] (values in [0, K))."""
+        base = (jnp.arange(b)[:, None, None] * (G * K)
+                + jnp.arange(G)[None, :, None] * K)
+        idx = (base + beam_sel).reshape(-1)
+        return [(k.take(idx, axis=0), v.take(idx, axis=0))
+                for k, v in caches]
+
+    def group_select(logp_g, live_scores_g, live_out_g, fin_scores_g,
+                     fin_out_g, step_i):
+        """One group's 2K-candidate selection at generated-length
+        ``step_i + 1``. Shapes: logp_g [B, K, V]; returns (new live
+        state, new finished state, chosen tokens [B, K], chosen source
+        beams [B, K])."""
+        V = logp_g.shape[-1]
+        cand = live_scores_g[..., None] + logp_g          # [B, K, V]
+        flat = cand.reshape(b, K * V)
+        k2 = min(2 * K, K * V)
+        scores2, idx2 = jax.lax.top_k(flat, k2)           # [B, 2K]
+        beam2 = idx2 // V
+        tok2 = (idx2 % V).astype(jnp.int32)
+        is_eos = tok2 == eos
+
+        # candidate sequences: source live rows with the token at step_i
+        src_out = jnp.take_along_axis(live_out_g, beam2[..., None],
+                                      axis=1)             # [B, 2K, L]
+        src_out = jax.lax.dynamic_update_slice(
+            src_out, tok2[..., None],
+            (jnp.int32(0), jnp.int32(0), step_i))
+
+        # ---- finished set: merge K old + 2K new EOS candidates
+        new_fin = jnp.where(is_eos, scores2 / lp(step_i + 1), NEG)
+        all_fin = jnp.concatenate([fin_scores_g, new_fin], axis=1)
+        all_out = jnp.concatenate([fin_out_g, src_out], axis=1)
+        fin_scores_g, fin_idx = jax.lax.top_k(all_fin, K)
+        fin_out_g = jnp.take_along_axis(all_out, fin_idx[..., None],
+                                        axis=1)
+
+        # ---- live set: top K non-EOS continuations of the 2K
+        live2 = jnp.where(is_eos, NEG, scores2)
+        live_scores_g, live_idx = jax.lax.top_k(live2, K)
+        tok = jnp.take_along_axis(tok2, live_idx, axis=1)
+        beam_sel = jnp.take_along_axis(beam2, live_idx, axis=1)
+        live_out_g = jnp.take_along_axis(src_out, live_idx[..., None],
+                                         axis=1)
+        return (live_scores_g, live_out_g, fin_scores_g, fin_out_g,
+                tok, beam_sel)
+
+    def run(params, ids, key=None):
+        del key
+        caches = init_caches(b)
+        logits, caches = model_step(params, ids, caches,
+                                    jnp.zeros((), jnp.int32))
+        logp0 = jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32), axis=-1)
+        V = logp0.shape[-1]
+        # tile caches to the beam batch: row b -> rows [b*G*K, (b+1)*G*K)
+        caches = [(jnp.repeat(k, G * K, axis=0),
+                   jnp.repeat(v, G * K, axis=0)) for k, v in caches]
+
+        # step 0 state: only beam 0 of each group is live (all beams
+        # hold identical prefixes — starting them all live would fill
+        # the beam with K copies of one continuation)
+        live_scores = jnp.where(jnp.arange(K)[None, None, :] == 0,
+                                0.0, NEG) * jnp.ones((b, G, 1))
+        live_out = jnp.full((b, G, K, max_new), pad, jnp.int32)
+        fin_scores = jnp.full((b, G, K), NEG)
+        fin_out = jnp.full((b, G, K, max_new), pad, jnp.int32)
+        tok = jnp.zeros((b, G, K), jnp.int32)
+
+        def one_step(logp_bgk, state, step_i):
+            """Process all groups at generated index step_i given decode
+            log-probs [B, G, K, V]; returns new state + (tok, beam_sel)
+            for the cache gather."""
+            live_scores, live_out, fin_scores, fin_out = state
+            freq = jnp.zeros((b, V), jnp.float32)
+            toks, sels = [], []
+            new_ls, new_lo, new_fs, new_fo = [], [], [], []
+            for g in range(G):       # static; groups couple via freq
+                logp_g = logp_bgk[:, g]
+                if div and g > 0:
+                    logp_g = logp_g - div * freq[:, None, :]
+                (ls, lo, fs, fo, tk, sel) = group_select(
+                    logp_g, live_scores[:, g], live_out[:, g],
+                    fin_scores[:, g], fin_out[:, g], step_i)
+                if div and G > 1:
+                    freq = freq + jax.nn.one_hot(
+                        tk, V, dtype=jnp.float32).sum(axis=1)
+                new_ls.append(ls), new_lo.append(lo)
+                new_fs.append(fs), new_fo.append(fo)
+                toks.append(tk), sels.append(sel)
+            state = (jnp.stack(new_ls, 1), jnp.stack(new_lo, 1),
+                     jnp.stack(new_fs, 1), jnp.stack(new_fo, 1))
+            return state, jnp.stack(toks, 1), jnp.stack(sels, 1)
+
+        # ---- step 0 consumes the prefill logits (same for every beam)
+        logp_bgk = jnp.broadcast_to(logp0[:, None, None, :],
+                                    (b, G, K, V))
+        (live_scores, live_out, fin_scores, fin_out), tok, beam_sel = \
+            one_step(logp_bgk, (live_scores, live_out, fin_scores,
+                                fin_out), jnp.int32(0))
+        caches = flat_gather(caches, beam_sel)
+
+        def cond(c):
+            i = c[0]
+            if bool(early_stopping):
+                # stop once every group holds K finished hypotheses
+                done = jnp.all(c[4] > NEG / 2)
+            else:
+                # optimistic live bound: no live beam can still beat
+                # the worst finished hypothesis
+                best_live = jnp.max(c[2], axis=2) / lp(max_new)
+                worst_fin = jnp.min(c[4], axis=2)
+                done = jnp.all(worst_fin >= best_live)
+            return (i < max_new) & jnp.logical_not(done)
+
+        def body(c):
+            i, tok, live_scores, live_out, fin_scores, fin_out, \
+                caches = c
+            off = jnp.asarray(prompt_len, jnp.int32) + i - 1
+            logits, caches = model_step(
+                params, tok.reshape(BGK, 1), caches, off)
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32), axis=-1)
+            logp_bgk = logp.reshape(b, G, K, V)
+            (state, ntok, beam_sel) = one_step(
+                logp_bgk, (live_scores, live_out, fin_scores, fin_out),
+                i)
+            live_scores, live_out, fin_scores, fin_out = state
+            caches = flat_gather(caches, beam_sel)
+            return (i + 1, ntok, live_scores, live_out, fin_scores,
+                    fin_out, caches)
+
+        state = (jnp.int32(1), tok, live_scores, live_out, fin_scores,
+                 fin_out, caches)
+        i, tok, live_scores, live_out, fin_scores, fin_out, _ = \
+            jax.lax.while_loop(cond, body, state)
+
+        # ---- finalize: still-live beams are valid (full-length)
+        # hypotheses ONLY when the loop ran all max_new steps; on an
+        # early exit they hold i < max_new tokens — counting those
+        # truncated prefixes (shorter = less negative logprob) would let
+        # them outrank every finished hypothesis
+        live_ok = i >= max_new
+        live_final = jnp.where(live_ok, live_scores / lp(max_new), NEG)
+        all_scores = jnp.concatenate([fin_scores, live_final], axis=2)
+        all_out = jnp.concatenate([fin_out, live_out], axis=2)
+        # across ALL groups: [B, G*2K]
+        all_scores = all_scores.reshape(b, -1)
+        all_out = all_out.reshape(b, G * 2 * K, max_new)
+        best = jnp.argmax(all_scores, axis=1)
+        out = jnp.take_along_axis(
+            all_out, best[:, None, None], axis=1)[:, 0]
+        score = jnp.take_along_axis(all_scores, best[:, None],
+                                    axis=1)[:, 0]
+        if with_scores:
+            return out, score
+        return out
+
+    return run

@@ -8,8 +8,6 @@ multiplies (XLA folds them into the matmul inputs); the 2:4 pattern is
 computed with a reshape + top-2 selection, no CUDA sparse kernels."""
 from __future__ import annotations
 
-from typing import Dict, Optional
-
 import jax.numpy as jnp
 import numpy as np
 
@@ -18,8 +16,7 @@ from ..framework.core import Tensor, as_jax, _wrap_out
 __all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
            "reset_excluded_layers", "set_excluded_layers"]
 
-_excluded: set = set()
-_masks: Dict[int, "jnp.ndarray"] = {}
+_excluded: set = set()   # legacy program-level exclusions (by param name)
 
 
 def calculate_density(x) -> float:
@@ -48,16 +45,27 @@ def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
 
 
 def set_excluded_layers(model=None, param_names=None, main_program=None):
-    for n in (param_names or []):
-        _excluded.add(n)
+    """Exclusions are scoped per model when one is given; the process-wide
+    set is kept only for the reference's program-level (model-less) API."""
+    if model is not None:
+        excl = getattr(model, "_asp_excluded", None)
+        if excl is None:
+            excl = model._asp_excluded = set()
+        excl.update(param_names or [])
+    else:
+        for n in (param_names or []):
+            _excluded.add(n)
 
 
-def reset_excluded_layers(main_program=None):
-    _excluded.clear()
+def reset_excluded_layers(main_program=None, model=None):
+    if model is not None:
+        getattr(model, "_asp_excluded", set()).clear()
+    else:
+        _excluded.clear()
 
 
-def _prunable(name, p):
-    if name in _excluded:
+def _prunable(name, p, model=None):
+    if name in _excluded or name in getattr(model, "_asp_excluded", ()):
         return False
     shape = tuple(p.shape)
     return len(shape) == 2 and shape[-1] % 4 == 0
@@ -69,11 +77,13 @@ def prune_model(model, n=2, m=4, mask_algo="mask_2d_best",
     so ``decorate``-wrapped optimizers keep the pattern sparse."""
     pruned = {}
     for name, p in model.named_parameters():
-        if not _prunable(name, p):
+        if not _prunable(name, p, model):
             continue
         mask = create_mask(p, mask_algo, n=n, m=m)
         p._data = as_jax(p) * jnp.asarray(mask)
-        _masks[id(p)] = jnp.asarray(mask)
+        # mask lives ON the parameter — no id()-keyed global that a
+        # recycled object id could mis-associate after GC
+        p._asp_mask = jnp.asarray(mask)
         pruned[name] = mask
     return pruned
 
@@ -86,7 +96,7 @@ def decorate(optimizer):
     def step(*a, **k):
         out = orig_step(*a, **k)
         for p in optimizer._parameter_list:
-            mask = _masks.get(id(p))
+            mask = getattr(p, "_asp_mask", None)
             if mask is not None:
                 p._data = as_jax(p) * mask
         return out

@@ -26,6 +26,8 @@ __all__ = ["to_static", "not_to_static", "enable_to_static", "save", "load",
            "TrainStep", "ignore_module", "TranslatedLayer", "dy2static"]
 
 _to_static_enabled = True
+_JIT_CACHE_SIZE = 64    # LRU bound on per-function compiled specializations
+_JIT_CACHE_WARN = 32    # warn once past this many live specializations
 
 
 def enable_to_static(flag: bool):
@@ -175,11 +177,26 @@ class StaticFunction:
                 self._unhashable_warned = True
             return self._fn(*args, **kwargs)
         if self._jitted is None:
-            self._jitted = {}
+            from collections import OrderedDict
+            self._jitted = OrderedDict()
         jitted = self._jitted.get(key)
         if jitted is None:
             jitted = self._build(treedef, dyn_idx, statics)
             self._jitted[key] = jitted
+            if len(self._jitted) > _JIT_CACHE_SIZE:
+                self._jitted.popitem(last=False)   # LRU-bounded
+            if (len(self._jitted) > _JIT_CACHE_WARN
+                    and not getattr(self, "_cache_growth_warned", False)):
+                self._cache_growth_warned = True
+                import warnings
+                warnings.warn(
+                    f"to_static: {getattr(self._fn, '__name__', '?')} has "
+                    f"compiled {len(self._jitted)} specializations — a "
+                    "python scalar/string argument is varying per call; "
+                    "each distinct value costs a full recompile. Pass it "
+                    "as a Tensor to trace it instead.")
+        else:
+            self._jitted.move_to_end(key)
         if self._binder is not None:
             p = self._binder.param_arrays()
             b = self._binder.buffer_arrays()

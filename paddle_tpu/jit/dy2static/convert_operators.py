@@ -249,6 +249,27 @@ def _merge_one(pred_arr, a, b, name: str):
         f"{type(scalar).__name__} in the other under a tensor condition")
 
 
+import contextlib as _ctl
+
+
+@_ctl.contextmanager
+def _no_speculative_buffer_writes(what: str):
+    """Guard speculative execution (both-branch IfElse, While discovery):
+    module-buffer writes (BN running stats, QAT averages) routed through
+    ``functional_buffer_write`` are journaled by
+    ``capture_buffer_writes`` (which also rolls them back); if any
+    happened, graph-break — last-writer-wins merging of side effects
+    would silently corrupt state, while the eager fallback is exact."""
+    from ...framework.core import capture_buffer_writes
+    with capture_buffer_writes() as journal:
+        yield
+    if journal:
+        raise Dy2StUnsupported(
+            f"a module buffer (e.g. BN running stats) is written inside "
+            f"{what}; speculative execution cannot merge side effects — "
+            "running eagerly")
+
+
 def IfElse(pred, true_fn, false_fn, init: Tuple, names: Tuple[str, ...]):
     """``convert_ifelse`` parity. Concrete predicate: run one branch.
     Traced predicate: run BOTH branches (pure trace) and merge every
@@ -260,8 +281,10 @@ def IfElse(pred, true_fn, false_fn, init: Tuple, names: Tuple[str, ...]):
         return tuple(out)
     pred_arr = _bool_arr(pred)
     try:
-        t_out = tuple(true_fn(*init))
-        f_out = tuple(false_fn(*init))
+        with _no_speculative_buffer_writes(
+                "a branch of a tensor condition"):
+            t_out = tuple(true_fn(*init))
+            f_out = tuple(false_fn(*init))
     except Dy2StUnsupported:
         raise
     except Exception as exc:
@@ -351,7 +374,9 @@ def While(cond_fn, body_fn, init: Tuple, names: Tuple[str, ...]):
     # ---- traced condition: discovery pass (one eager body run whose ops
     # are dead code under the outer jit) classifies carry vs static slots
     try:
-        new_vals = tuple(body_fn(*vals))
+        with _no_speculative_buffer_writes(
+                "the body of a tensor-condition loop (discovery pass)"):
+            new_vals = tuple(body_fn(*vals))
     except Dy2StUnsupported:
         raise
     except Exception as exc:

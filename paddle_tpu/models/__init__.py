@@ -1,11 +1,12 @@
 """Model zoo (PaddleNLP-parity transformer families + vision models via
 ``paddle_tpu.vision.models``)."""
-from . import bert, deepseek_moe, gpt, llama, qwen2_moe
+from . import bert, deepseek_moe, gpt, llama, qwen2, qwen2_moe
 from .bert import BertConfig, BertForSequenceClassification, BertModel
 from .deepseek_moe import (DeepseekMoeConfig, DeepseekMoeForCausalLM,
                            DeepseekMoeModel)
 from .gpt import GPTConfig, GPTForCausalLM, GPTModel
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaPretrainingCriterion)
+from .qwen2 import Qwen2Config, Qwen2ForCausalLM, Qwen2Model
 from .qwen2_moe import (Qwen2MoeConfig, Qwen2MoeForCausalLM,
                         Qwen2MoeModel)

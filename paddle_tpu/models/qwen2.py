@@ -1,0 +1,59 @@
+"""Dense Qwen2 family (reference: PaddleNLP
+``paddlenlp/transformers/qwen2/modeling.py`` — ``Qwen2Config``,
+``Qwen2Model``, ``Qwen2ForCausalLM``).
+
+Architecturally Qwen2 is the Llama decoder with three deltas: bias on
+the q/k/v projections (``qkv_bias=True``), a larger default rope theta
+(1e6), and tied embeddings on the small checkpoints. The TPU-first
+build shares the Llama module bodies (same GQA attention over the
+Pallas flash kernel, same RMSNorm/SwiGLU) and expresses the deltas as
+config, so the whole 4D-parallel + generation surface (pp pipe class
+included) carries over without re-implementation."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaForCausalLMPipe,
+                    LlamaModel, LlamaPretrainingCriterion)
+
+__all__ = ["Qwen2Config", "Qwen2Model", "Qwen2ForCausalLM",
+           "Qwen2ForCausalLMPipe", "Qwen2PretrainingCriterion"]
+
+
+@dataclass
+class Qwen2Config(LlamaConfig):
+    # Qwen2-7B-shaped defaults (PaddleNLP qwen2 config defaults)
+    vocab_size: int = 151936
+    hidden_size: int = 3584
+    intermediate_size: int = 18944
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 28
+    num_key_value_heads: int = 4
+    max_position_embeddings: int = 32768
+    rope_theta: float = 1e6
+    qkv_bias: bool = True            # THE Qwen2 signature delta
+
+    @staticmethod
+    def tiny(vocab=1024, hidden=256, layers=2, heads=8, kv_heads=4,
+             ffn=512):
+        return Qwen2Config(
+            vocab_size=vocab, hidden_size=hidden, intermediate_size=ffn,
+            num_hidden_layers=layers, num_attention_heads=heads,
+            num_key_value_heads=kv_heads, max_position_embeddings=2048)
+
+
+class Qwen2Model(LlamaModel):
+    """Decoder stack; `qwen2.embed_tokens` etc. via the shared body."""
+
+
+Qwen2PretrainingCriterion = LlamaPretrainingCriterion
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    def __init__(self, config: Qwen2Config):
+        super().__init__(config)
+
+
+class Qwen2ForCausalLMPipe(LlamaForCausalLMPipe):
+    """Pipeline-parallel Qwen2 (modeling_pp parity via the shared
+    shard_map+ppermute engine)."""

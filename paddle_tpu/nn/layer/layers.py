@@ -17,6 +17,33 @@ from ...framework.dtype import convert_dtype
 from ...utils import unique_name
 
 
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """``paddle.create_parameter`` parity (reference:
+    ``python/paddle/tensor/creation.py::create_parameter``): a trainable
+    Parameter outside any Layer — Xavier init for weights, zeros for
+    bias, overridable via ``default_initializer`` / ``ParamAttr``."""
+    from ..initializer import Constant, XavierNormal, _init_param
+    init = default_initializer
+    learning_rate = 1.0
+    trainable = True
+    if attr is not None and attr is not False:
+        from ..param_attr import ParamAttr
+        if isinstance(attr, ParamAttr):
+            init = attr.initializer or init
+            name = attr.name or name
+            learning_rate = attr.learning_rate
+            trainable = attr.trainable
+        elif isinstance(attr, str):
+            name = attr
+    if init is None:
+        init = Constant(0.0) if is_bias else XavierNormal()
+    data = _init_param(init, shape, dtype)
+    p = Parameter(data, dtype=dtype, trainable=trainable, name=name)
+    p.optimize_attr = {"learning_rate": learning_rate}
+    return p
+
+
 class HookRemoveHelper:
     def __init__(self, hooks: dict, hook_id: int):
         self._hooks = hooks
@@ -102,28 +129,9 @@ class Layer:
 
     def create_parameter(self, shape, attr=None, dtype=None,
                          is_bias=False, default_initializer=None):
-        from ..initializer import (Constant, XavierNormal, Normal,
-                                   _init_param)
-        dtype = dtype or self._dtype
-        init = default_initializer
-        name = None
-        learning_rate = 1.0
-        trainable = True
-        if attr is not None and attr is not False:
-            from ..param_attr import ParamAttr
-            if isinstance(attr, ParamAttr):
-                init = attr.initializer or init
-                name = attr.name
-                learning_rate = attr.learning_rate
-                trainable = attr.trainable
-            elif isinstance(attr, str):
-                name = attr
-        if init is None:
-            init = Constant(0.0) if is_bias else XavierNormal()
-        data = _init_param(init, shape, dtype)
-        p = Parameter(data, dtype=dtype, trainable=trainable, name=name)
-        p.optimize_attr = {"learning_rate": learning_rate}
-        return p
+        return create_parameter(shape, dtype or self._dtype, attr=attr,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
 
     def create_variable(self, name=None, persistable=False, dtype=None):
         import jax.numpy as jnp

@@ -57,7 +57,6 @@ def check_grad(op_fn, np_fn, inputs, grad_idx=0, rtol=1e-2, atol=1e-3,
     loss = out.sum()
     loss.backward()
     analytic = tensors[grad_idx].grad.numpy()
-    numeric = numeric_grad(lambda *a: np_fn(*a, **({} if not op_kwargs
-                                                   else {})), inputs,
+    numeric = numeric_grad(lambda *a: np_fn(*a, **op_kwargs), inputs,
                            grad_idx)
     np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)

@@ -1,0 +1,285 @@
+"""Beam search vs oracles (reference pattern: PaddleNLP
+``tests/generation`` BeamSearchScorer tests + exhaustive tiny-model
+checks).
+
+Two oracles:
+- an EXHAUSTIVE search over all V^T continuations of a tiny model —
+  with num_beams == V, beam search must find the global optimum;
+- a step-by-step numpy reference implementation of the same algorithm
+  (2K candidates, finished-set under length penalty) for beam < V.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def _tiny_model(vocab=8):
+    paddle.seed(42)
+    cfg = LlamaConfig.tiny(vocab=vocab, hidden=32, layers=1, heads=2,
+                           kv_heads=2, ffn=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _full_logprobs(model, ids):
+    """log-softmax over the full sequence's last position, eagerly."""
+    logits = model(paddle.to_tensor(np.asarray(ids, np.int64))).numpy()
+    lp = logits[:, -1, :].astype(np.float64)
+    lp = lp - lp.max(-1, keepdims=True)
+    lp = lp - np.log(np.exp(lp).sum(-1, keepdims=True))
+    return lp
+
+
+def _exhaustive_best(model, prompt, max_new, vocab, eos, alpha):
+    """Enumerate every continuation; score like the beam scorer: sum of
+    chosen-token logprobs, / len**alpha, hypotheses end at EOS or at
+    max_new."""
+    from itertools import product
+    best_score, best_seq = -np.inf, None
+    for seq in product(range(vocab), repeat=max_new):
+        ids = list(prompt)
+        total = 0.0
+        length = 0
+        valid = True
+        for t, tok in enumerate(seq):
+            lp = _full_logprobs(model, [ids])[0]
+            total += lp[tok]
+            ids.append(tok)
+            length += 1
+            if tok == eos:
+                break
+        # skip duplicates: a sequence whose EOS came before the end
+        # represents the same hypothesis as its truncation
+        if eos in seq[:length - 1]:
+            valid = False
+        if not valid:
+            continue
+        score = total / (length ** alpha if alpha else 1.0)
+        if score > best_score:
+            padded = list(seq[:length]) + [0] * (max_new - length)
+            best_score, best_seq = score, padded
+    return best_score, best_seq
+
+
+def _np_beam_reference(model, prompt, max_new, vocab, K, eos, alpha):
+    """Step-by-step numpy mirror of generation/beam.py (single group)."""
+    NEG = -1.0e9
+
+    def lp_pen(n):
+        return n ** alpha if alpha else 1.0
+
+    b_prompts = [list(prompt)]
+    live_seq = [[list(prompt)] + [list(prompt) for _ in range(K - 1)]]
+    live_scores = np.full((1, K), NEG)
+    live_scores[0, 0] = 0.0
+    fin_scores = np.full((1, K), NEG)
+    fin_seq = [[None] * K]
+
+    for i in range(max_new):
+        cand = []
+        for k in range(K):
+            lp = _full_logprobs(model, [live_seq[0][k]])[0]
+            for v in range(vocab):
+                cand.append((live_scores[0, k] + lp[v], k, v))
+        cand.sort(key=lambda t: -t[0])
+        cand = cand[: 2 * K]
+        new_fin = list(zip(fin_scores[0], fin_seq[0]))
+        new_live = []
+        for score, k, v in cand:
+            if v == eos:
+                new_fin.append((score / lp_pen(i + 1),
+                                live_seq[0][k] + [v]))
+            else:
+                new_live.append((score, live_seq[0][k] + [v]))
+        new_fin.sort(key=lambda t: -t[0])
+        fin_scores[0] = [s for s, _ in new_fin[:K]]
+        fin_seq[0] = [q for _, q in new_fin[:K]]
+        new_live = new_live[:K]
+        live_scores[0, : len(new_live)] = [s for s, _ in new_live]
+        for k, (_, q) in enumerate(new_live):
+            live_seq[0][k] = q
+
+    finals = list(zip(fin_scores[0], fin_seq[0])) + [
+        (live_scores[0, k] / lp_pen(max_new), live_seq[0][k])
+        for k in range(K)]
+    finals = [f for f in finals if f[1] is not None]
+    finals.sort(key=lambda t: -t[0])
+    score, seq = finals[0]
+    gen = seq[len(prompt):]
+    gen = gen + [0] * (max_new - len(gen))
+    return score, gen
+
+
+def test_beam_equals_exhaustive_when_beam_is_vocab():
+    vocab, max_new, eos, alpha = 6, 3, 1, 0.6
+    model, cfg = _tiny_model(vocab)
+    prompt = [3, 5]
+    want_score, want_seq = _exhaustive_best(model, prompt, max_new,
+                                            vocab, eos, alpha)
+    out, score = model.generate(
+        paddle.to_tensor(np.asarray([prompt], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=vocab, length_penalty=alpha, eos_token_id=eos,
+        pad_token_id=0)
+    got = out.numpy()[0].tolist()
+    assert got == want_seq, (got, want_seq)
+    assert abs(float(score.numpy()[0]) - want_score) < 1e-3
+
+
+def test_beam4_matches_numpy_reference():
+    vocab, max_new, K, eos, alpha = 8, 5, 4, 1, 0.8
+    model, cfg = _tiny_model(vocab)
+    prompt = [2, 7, 4]
+    want_score, want_seq = _np_beam_reference(model, prompt, max_new,
+                                              vocab, K, eos, alpha)
+    out, score = model.generate(
+        paddle.to_tensor(np.asarray([prompt], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K, length_penalty=alpha, eos_token_id=eos,
+        pad_token_id=0)
+    assert out.numpy()[0].tolist() == want_seq
+    assert abs(float(score.numpy()[0]) - want_score) < 1e-3
+
+
+def test_beam_no_eos_runs_full_length():
+    vocab, max_new, K = 8, 4, 3
+    model, cfg = _tiny_model(vocab)
+    out, score = model.generate(
+        paddle.to_tensor(np.asarray([[1, 2]], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K)
+    ids = out.numpy()[0]
+    assert ids.shape == (max_new,)
+    # beam-1 equals greedy
+    g, _ = model.generate(
+        paddle.to_tensor(np.asarray([[1, 2]], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=1)
+    greedy, _ = model.generate(
+        paddle.to_tensor(np.asarray([[1, 2]], np.int64)),
+        max_new_tokens=max_new, decode_strategy="greedy_search")
+    assert g.numpy()[0].tolist() == greedy.numpy()[0].tolist()
+
+
+def test_beam_batched_rows_independent():
+    vocab, max_new, K = 8, 4, 3
+    model, cfg = _tiny_model(vocab)
+    p1, p2 = [1, 2], [5, 3]
+    both, _ = model.generate(
+        paddle.to_tensor(np.asarray([p1, p2], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K)
+    one, _ = model.generate(
+        paddle.to_tensor(np.asarray([p1], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K)
+    two, _ = model.generate(
+        paddle.to_tensor(np.asarray([p2], np.int64)),
+        max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K)
+    assert both.numpy()[0].tolist() == one.numpy()[0].tolist()
+    assert both.numpy()[1].tolist() == two.numpy()[0].tolist()
+
+
+def test_group_beam_diversity():
+    """2 groups with a strong diversity penalty must produce a best
+    hypothesis that can differ from vanilla beam, and the run must be
+    deterministic + valid; with diversity_rate=0 group beam == beam
+    when each group is a full beam."""
+    vocab, max_new = 8, 4
+    model, cfg = _tiny_model(vocab)
+    x = paddle.to_tensor(np.asarray([[1, 6]], np.int64))
+    plain, s_plain = model.generate(
+        x, max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=2)
+    grouped, s_g = model.generate(
+        x, max_new_tokens=max_new, decode_strategy="group_beam_search",
+        num_beams=4, num_beam_groups=2, diversity_rate=0.0)
+    # group 0 of size 2 with zero diversity behaves like beam 2; the
+    # overall best must be at least as good as beam-2's best
+    assert float(s_g.numpy()[0]) >= float(s_plain.numpy()[0]) - 1e-4
+    div, s_div = model.generate(
+        x, max_new_tokens=max_new, decode_strategy="group_beam_search",
+        num_beams=4, num_beam_groups=2, diversity_rate=100.0)
+    assert div.numpy().shape == (1, max_new)
+
+
+def test_early_stopping_returns_finished_not_truncated():
+    """Early exit must NOT let a truncated live prefix (shorter = less
+    negative score) outrank finished hypotheses (r4 review finding)."""
+    vocab, max_new, K, eos = 8, 8, 2, 1
+    model, cfg = _tiny_model(vocab)
+    x = paddle.to_tensor(np.asarray([[2, 5]], np.int64))
+    out_e, s_e = model.generate(
+        x, max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K, eos_token_id=eos, pad_token_id=0,
+        early_stopping=True)
+    ids = out_e.numpy()[0]
+    # the winner must be a FINISHED hypothesis: it contains EOS, or the
+    # loop genuinely ran to full length (then non-eos everywhere is ok
+    # only if no finished hyp beat it — verify vs the non-early run)
+    out_f, s_f = model.generate(
+        x, max_new_tokens=max_new, decode_strategy="beam_search",
+        num_beams=K, eos_token_id=eos, pad_token_id=0,
+        early_stopping=False)
+    if eos in ids:
+        # pads only after eos
+        pos = list(ids).index(eos)
+        assert all(t == 0 for t in ids[pos + 1:])
+    # early stopping may settle for a worse hypothesis than exhaustive
+    # search, never a better-scored truncated one
+    assert float(s_e.numpy()[0]) <= float(s_f.numpy()[0]) + 1e-4
+
+
+def test_beam_rejects_inapplicable_options():
+    model, cfg = _tiny_model(8)
+    x = paddle.to_tensor(np.asarray([[2, 5]], np.int64))
+    with pytest.raises(ValueError, match="deterministic"):
+        model.generate(x, decode_strategy="beam_search", num_beams=2,
+                       max_new_tokens=2, temperature=0.7)
+    with pytest.raises(ValueError, match="group_beam_search"):
+        model.generate(x, decode_strategy="beam_search", num_beams=4,
+                       max_new_tokens=2, num_beam_groups=2)
+    with pytest.raises(ValueError, match="num_beams"):
+        model.generate(x, decode_strategy="greedy_search", num_beams=4,
+                       max_new_tokens=2)
+
+
+def test_generation_predictor_beam():
+    from paddle_tpu.generation import GenerationConfig
+    from paddle_tpu.inference import create_generation_predictor
+    model, cfg = _tiny_model(8)
+    pred = create_generation_predictor(
+        model, GenerationConfig(decode_strategy="beam_search",
+                                num_beams=3, max_new_tokens=4,
+                                length_penalty=0.5, eos_token_id=1))
+    prompt = np.asarray([[2, 5]], np.int64)
+    got = pred.generate(prompt)
+    want, _ = model.generate(
+        paddle.to_tensor(prompt), max_new_tokens=4,
+        decode_strategy="beam_search", num_beams=3, length_penalty=0.5,
+        eos_token_id=1)
+    assert got.tolist() == want.numpy().tolist()
+
+
+def test_beam_export_roundtrip(tmp_path):
+    from paddle_tpu.generation import GenerationConfig, load_generation
+    vocab, max_new, K = 8, 4, 3
+    model, cfg = _tiny_model(vocab)
+    prompt = np.asarray([[2, 5]], np.int64)
+    want, _ = model.generate(
+        paddle.to_tensor(prompt), max_new_tokens=max_new,
+        decode_strategy="beam_search", num_beams=K, length_penalty=0.5,
+        eos_token_id=1)
+    path = str(tmp_path / "beam_artifact")
+    model.export_generation(
+        path, batch_size=1, prompt_len=2, max_new_tokens=max_new,
+        generation_config=GenerationConfig(
+            decode_strategy="beam_search", num_beams=K,
+            length_penalty=0.5, eos_token_id=1))
+    loaded = load_generation(path)
+    got = loaded(prompt)
+    assert got.tolist() == want.numpy().tolist()

@@ -372,3 +372,39 @@ def test_or_value_semantics_with_traced_operand():
     out2, both2 = f(x, d_falsy)
     np.testing.assert_allclose(out2.numpy(), [4.0])
     np.testing.assert_allclose(both2.numpy(), [0.0])
+
+
+def test_speculative_branch_buffer_write_graph_breaks():
+    """A module-buffer write (BN running stats) inside a tensor-condition
+    branch must graph-break to eager, not merge last-writer-wins
+    (r3 advisor finding: speculative side effects)."""
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            if x.sum() > 0:
+                y = self.bn(x)      # writes running stats speculatively
+            else:
+                y = x * 2.0
+            return y.sum()
+
+    m = M()
+    m.train()
+    st = paddle.jit.to_static(M())
+    st.set_state_dict(m.state_dict())
+    st.train()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 4)
+                         .astype(np.float32))
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        out_st = st(x)              # falls back to eager
+    out_eager = m(x)
+    np.testing.assert_allclose(out_st.numpy(), out_eager.numpy(),
+                               rtol=1e-5)
+    # the fallback ran ONCE eagerly: running stats updated exactly once
+    np.testing.assert_allclose(st.bn._mean.numpy(), m.bn._mean.numpy(),
+                               rtol=1e-5)

@@ -74,11 +74,16 @@ def test_eos_stops_and_pads(llama_tiny):
     assert np.all(arr[1:] == 0)
 
 
-def test_beam_search_raises(llama_tiny):
+def test_unknown_strategy_raises(llama_tiny):
     ids = np.zeros((1, 4), np.int64)
     with pytest.raises(NotImplementedError):
         llama_tiny.generate(paddle.to_tensor(ids),
-                            decode_strategy="beam_search")
+                            decode_strategy="contrastive_search")
+    # beam search is implemented (tests/test_beam_search.py covers it)
+    out, _ = llama_tiny.generate(paddle.to_tensor(ids),
+                                 decode_strategy="beam_search",
+                                 num_beams=2, max_new_tokens=2)
+    assert out.numpy().shape == (1, 2)
 
 
 def test_generation_predictor(llama_tiny):
@@ -117,7 +122,7 @@ def test_moe_generate_smoke():
 def test_generate_rejects_unknown_kwargs(llama_tiny):
     ids = np.zeros((1, 4), np.int64)
     with pytest.raises(TypeError, match="unsupported options"):
-        llama_tiny.generate(paddle.to_tensor(ids), num_beams=4)
+        llama_tiny.generate(paddle.to_tensor(ids), min_length=4)
 
 
 def test_generate_rejects_overlong(llama_tiny):
@@ -163,4 +168,4 @@ def test_export_generation_validates(tmp_path, llama_tiny):
         llama_tiny.export_generation(
             str(tmp_path / "y"), 1, 4, 4,
             generation_config=GenerationConfig(
-                decode_strategy="beam_search"))
+                decode_strategy="contrastive_search"))

@@ -280,7 +280,7 @@ def test_interleaved_schedule_properties():
         # in-flight bound: pp*v micros per (stage, chunk) at most (the
         # interleave's memory-for-bubble trade; rings are sized from
         # the tables, so this is a sanity bound, not a correctness one)
-        assert _ring_depth(op, ci, pp) <= max(pp * v, 2)
+        assert _ring_depth(op, mi, ci, pp, v) <= max(pp * v, 2)
 
 
 def test_interleaved_1f1b_matches_sequential_grads():
@@ -315,3 +315,38 @@ def test_interleaved_1f1b_matches_sequential_grads():
     worst = max(float(np.abs(got[n] - ref_grads[n]).max())
                 for n in ref_grads)
     assert worst < 1e-4, f"worst interleaved grad diff {worst}"
+
+
+def test_interleaved_1f1b_pp4_v2_matches_sequential_grads():
+    """pp=4, v=2 (one block per stage-chunk): exercises ring sizing at a
+    deeper schedule shape than the pp=2 case — the fbuf/gbuf recv windows
+    differ from the F->B window here (advisor r3 finding)."""
+    strategy = _init_fleet(pp_degree=4, dp_degree=2)
+    strategy.pipeline_configs = {"accumulate_steps": 4,
+                                 "micro_batch_size": 2,
+                                 "schedule": "1F1B"}
+    paddle.seed(11)
+    model = _pp_layer_model(num_stages=4)
+    model._num_virtual_stages = 2        # 8 blocks = pp*v*lps, lps=1
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.RandomState(3).randint(0, 4, (8,)).astype(np.int64))
+
+    paddle.seed(11)
+    ref = _pp_layer_model(num_stages=4)
+    ref.set_state_dict(model.state_dict())
+    out = ref._run_items(ref._items, x)
+    loss_ref = ref._loss_fn(out, y)
+    loss_ref.backward()
+    ref_grads = {n: p.grad.numpy() for n, p in ref.named_parameters()
+                 if p.grad is not None}
+
+    loss = model.train_batch_1f1b(x, y, n_micro=4)
+    assert abs(float(loss.numpy()) - float(loss_ref.numpy())) < 1e-5
+    got = {n: p.grad.numpy() for n, p in model.named_parameters()
+           if p.grad is not None}
+    assert set(got) == set(ref_grads) and ref_grads
+    worst = max(float(np.abs(got[n] - ref_grads[n]).max())
+                for n in ref_grads)
+    assert worst < 1e-4, f"worst pp4-v2 interleaved grad diff {worst}"

@@ -45,3 +45,50 @@ def test_gpt_pretrain_generate_script():
         sys.path.pop(0)
     losses = main(["--tiny", "--steps", "200"])
     assert losses[-1] < losses[0] * 0.1
+
+
+def _load(name):
+    sys.path.insert(0, "examples")
+    try:
+        import importlib
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+@pytest.mark.slow
+def test_qwen2_pretrain_generate_script():
+    losses, match = _load("qwen2_pretrain_generate").main(
+        ["--tiny", "--steps", "200"])
+    assert losses[-1] < losses[0] * 0.1
+    assert match >= 0.5
+
+
+@pytest.mark.slow
+def test_deepseek_moe_sft_script():
+    losses, match = _load("deepseek_moe_sft").main(
+        ["--tiny", "--steps", "250"])
+    assert losses[-1] < losses[0] * 0.5
+    assert match >= 0.5
+
+
+@pytest.mark.slow
+def test_seq2seq_translation_script():
+    losses, acc = _load("seq2seq_translation").main(
+        ["--tiny", "--steps", "300"])
+    assert losses[-1] < losses[0] * 0.5
+    assert acc > 0.8
+
+
+@pytest.mark.slow
+def test_vit_classification_script():
+    acc = _load("vit_classification").main(
+        ["--tiny", "--epochs", "20", "--lr", "0.002"])
+    assert acc > 0.9
+
+
+@pytest.mark.slow
+def test_wgan_gp_script():
+    d_losses, g_losses, margin = _load("wgan_gp").main(
+        ["--tiny", "--steps", "40"])
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
