@@ -96,7 +96,6 @@ def main(argv=None):
         weight_decay=0.01, grad_clip=nn.ClipGradByGlobalNorm(1.0))
 
     from paddle_tpu.jit import TrainStep
-    crit = nn.CrossEntropyLoss(soft_label=False)
 
     def loss_fn(out, a, k):
         labels = paddle.Tensor(k["_labels"][0])
@@ -104,7 +103,6 @@ def main(argv=None):
                                labels.reshape([-1]))
 
     step_fn = TrainStep(model, loss_fn, opt)
-    del crit
 
     loader = DataLoader(ReversalPairs(vocab, args.seq_len),
                         batch_size=args.batch_size, shuffle=True,

@@ -21,6 +21,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -81,6 +82,65 @@ struct Server {
   std::condition_variable cv;
   std::map<std::string, std::vector<char>> kv;
 
+  // etcd-durability parity: when set, every mutation rewrites the whole
+  // map to <snapshot_path> (tmp + rename, crash-atomic). Rendezvous
+  // maps are tiny (endpoints, heartbeats), so whole-map rewrite per
+  // mutation is cheaper than a journal + compaction scheme. A restarted
+  // master preloads the file, so liveness/metadata survive rank-0 death.
+  std::string snapshot_path;
+
+  // Format: u64 count, then per entry u32 klen, key, u64 vlen, val.
+  void persist_locked() {
+    if (snapshot_path.empty()) return;
+    std::string tmp = snapshot_path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return;
+    bool ok = true;
+    auto w = [&](const void* p, size_t sz, size_t cnt) {
+      if (ok && std::fwrite(p, sz, cnt, f) != cnt) ok = false;
+    };
+    uint64_t n = kv.size();
+    w(&n, 8, 1);
+    for (const auto& it : kv) {
+      uint32_t klen = static_cast<uint32_t>(it.first.size());
+      uint64_t vlen = it.second.size();
+      w(&klen, 4, 1);
+      w(it.first.data(), 1, klen);
+      w(&vlen, 8, 1);
+      if (vlen) w(it.second.data(), 1, vlen);
+    }
+    if (std::fflush(f) != 0) ok = false;
+    if (ok) ok = ::fsync(fileno(f)) == 0;
+    if (std::fclose(f) != 0) ok = false;
+    // only replace the last good snapshot with a fully written one —
+    // a short write (ENOSPC, I/O error) must not destroy prior state
+    if (ok)
+      std::rename(tmp.c_str(), snapshot_path.c_str());
+    else
+      std::remove(tmp.c_str());
+  }
+
+  void preload() {
+    if (snapshot_path.empty()) return;
+    FILE* f = std::fopen(snapshot_path.c_str(), "rb");
+    if (!f) return;
+    uint64_t n = 0;
+    if (std::fread(&n, 8, 1, f) == 1) {
+      for (uint64_t i = 0; i < n; ++i) {
+        uint32_t klen = 0;
+        if (std::fread(&klen, 4, 1, f) != 1 || klen > (1u << 20)) break;
+        std::string key(klen, '\0');
+        if (klen && std::fread(key.data(), 1, klen, f) != klen) break;
+        uint64_t vlen = 0;
+        if (std::fread(&vlen, 8, 1, f) != 1 || vlen > kMaxValLen) break;
+        std::vector<char> val(vlen);
+        if (vlen && std::fread(val.data(), 1, vlen, f) != vlen) break;
+        kv[std::move(key)] = std::move(val);
+      }
+    }
+    std::fclose(f);
+  }
+
   ~Server() { shutdown(); }
 
   void shutdown() {
@@ -118,6 +178,7 @@ struct Server {
         {
           std::lock_guard<std::mutex> g(mu);
           kv[key] = std::move(val);
+          persist_locked();
         }
         cv.notify_all();
         uint8_t st = kOk;
@@ -167,6 +228,7 @@ struct Server {
           std::vector<char> v(8);
           memcpy(v.data(), &cur, 8);
           kv[key] = std::move(v);
+          persist_locked();
           result = cur;
         }
         cv.notify_all();
@@ -177,6 +239,7 @@ struct Server {
         {
           std::lock_guard<std::mutex> g(mu);
           n = kv.erase(key);
+          if (n) persist_locked();
         }
         uint8_t st = n ? kOk : kMissing;
         if (!send_all(fd, &st, 1)) break;
@@ -225,8 +288,21 @@ extern "C" {
 // binding INADDR_ANY would let any network peer write keys / push
 // large values at rank 0); null/empty falls back to all interfaces
 // for multi-host rendezvous.
+int64_t tcps_server_start_persist(const char* host, int port,
+                                  const char* snapshot_path,
+                                  void** out_handle);
+
 int64_t tcps_server_start_host(const char* host, int port,
                                void** out_handle) {
+  return tcps_server_start_persist(host, port, nullptr, out_handle);
+}
+
+// snapshot_path (nullable): persist the map across master restarts —
+// a new server started with the same path preloads the saved state
+// (the etcd-backed elastic master's durability, without etcd).
+int64_t tcps_server_start_persist(const char* host, int port,
+                                  const char* snapshot_path,
+                                  void** out_handle) {
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -errno;
   int one = 1;
@@ -261,6 +337,10 @@ int64_t tcps_server_start_host(const char* host, int port,
   getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
   auto* s = new Server();
   s->listen_fd = fd;
+  if (snapshot_path && snapshot_path[0]) {
+    s->snapshot_path = snapshot_path;
+    s->preload();
+  }
   s->accept_thread = std::thread([s] { s->accept_loop(); });
   *out_handle = s;
   return ntohs(addr.sin_port);

@@ -52,7 +52,7 @@ class ElasticManager:
 
     def __init__(self, host="127.0.0.1", port=0, rank=0, world_size=1,
                  is_master=None, np_range=None, timeout=30.0,
-                 join_timeout=60.0):
+                 join_timeout=60.0, snapshot_path=None):
         from ....native import TCPStore
         self.rank = int(rank)
         self.world_size = int(world_size)
@@ -60,10 +60,17 @@ class ElasticManager:
             is_master = self.rank == 0
         # join_timeout covers the initial rendezvous (rank 0 may bring
         # the store up seconds later); liveness polls use the
-        # non-blocking try_get, so no RPC timeout applies there
+        # non-blocking try_get, so no RPC timeout applies there.
+        # snapshot_path (master only) persists the store map across
+        # master restarts — the etcd-durability the reference gets from
+        # its external etcd master: a relaunched rank-0 preloads
+        # registrations/heartbeats and job metadata instead of starting
+        # from an empty store.
         self._store = TCPStore(host=host, port=port, is_master=is_master,
                                world_size=world_size,
-                               timeout=join_timeout)
+                               timeout=join_timeout,
+                               snapshot_path=(snapshot_path
+                                              if is_master else None))
         self.port = self._store.port
         self.timeout = float(timeout)
         if np_range is None:

@@ -330,7 +330,7 @@ class PipelineLayer(Layer):
             x = self._run_items(post, x)
         return x
 
-    def train_batch_1f1b(self, x, labels, n_micro):
+    def train_batch_1f1b(self, x, labels, n_micro, loss_scale=None):
         """One full 1F1B train pass (O(pp) activation memory): computes
         the mean loss and ACCUMULATES parameter gradients directly
         (``p.grad``), bypassing the tape — the schedule interleaves
@@ -396,7 +396,8 @@ class PipelineLayer(Layer):
         cache = self.__dict__.setdefault("_1f1b_jit_cache", {})
         runner = cache.get(key)
         if runner is None:
-            def runner_fn(body_a, pre_a, post_a, feeds_a, lfeeds_a):
+            def runner_fn(body_a, pre_a, post_a, feeds_a, lfeeds_a,
+                          scale_a):
                 if v > 1:
                     from ..pipeline_1f1b import pipeline_interleaved_grads
                     # engine layout [pp, v, lps, ...]: model part
@@ -412,16 +413,20 @@ class PipelineLayer(Layer):
                         mach["stage_fn"], stacked, feeds_a, last_fn,
                         v, first_fn=mach["first_fn"], first_params=pre_a,
                         last_params=post_a, last_feeds=lfeeds_a,
-                        mesh=mesh)
+                        mesh=mesh, loss_scale=scale_a)
                 stacked = mach["stack_body"](body_a)
                 return pipeline_1f1b_grads(
                     mach["stage_fn"], stacked, feeds_a, last_fn,
                     first_fn=mach["first_fn"], first_params=pre_a,
-                    last_params=post_a, last_feeds=lfeeds_a, mesh=mesh)
+                    last_params=post_a, last_feeds=lfeeds_a, mesh=mesh,
+                    loss_scale=scale_a)
             runner = jax.jit(runner_fn)
             cache[key] = runner
+        # the scale rides as a traced argument: dynamic loss scaling
+        # changes it per step without recompiling the timetable
+        scale_a = jnp.float32(1.0 if loss_scale is None else loss_scale)
         loss, (g_stacked, g_first, g_last) = runner(
-            body_arrs, pre_arrs, post_arrs, feeds, lfeeds)
+            body_arrs, pre_arrs, post_arrs, feeds, lfeeds, scale_a)
 
         def accum(p, g):
             g = jnp.asarray(g)
@@ -480,15 +485,19 @@ class PipelineParallel(Layer):
                 isinstance(self._layers, PipelineLayer) and \
                 self._layers._engine_route() is not None:
             # true 1F1B: fwd/bwd interleaved in one scan, O(pp) live
-            # activations; grads are produced directly by the engine
-            if scaler is not None and getattr(scaler, "_scale", 1.0) != 1.0:
-                raise NotImplementedError(
-                    "1F1B engine with dynamic loss scaling; use bf16 "
-                    "(scale 1.0)")
-            loss = self._layers.train_batch_1f1b(inputs, labels, n_micro)
+            # activations; grads are produced directly by the engine.
+            # GradScaler: the scale seeds the backward chain INSIDE the
+            # engine (last-stage loss seed), so boundary grads ride the
+            # ring scaled — fp16-underflow protection identical to the
+            # reference's scaled-loss backward
+            scale = getattr(scaler, "_scale", None) if scaler is not None \
+                and scaler.is_enable() else None
+            loss = self._layers.train_batch_1f1b(inputs, labels, n_micro,
+                                                 loss_scale=scale)
             if scaler is not None:
-                # scale is 1.0 so unscale_ is a pure finite-check: a
-                # NaN/Inf microbatch must SKIP the step, same as the
+                # unscale_ divides the accumulated grads by the scale
+                # and finite-checks them: a NaN/Inf microbatch SKIPS the
+                # step and update() adjusts the scale, same as the
                 # non-1F1B path
                 scaler.unscale_(optimizer)
                 scaler.step(optimizer)

@@ -193,6 +193,70 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     return y, aux
 
 
+def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
+                                  gate_up, down, normalize_gates=True,
+                                  expert_axis=None, return_stats=False):
+    """DROPLESS dispatch → SwiGLU experts → combine (reference:
+    capacity-free routing the fused-MoE kernels in
+    ``phi/kernels/fusion/`` approximate; design follows the MegaBlocks
+    grouped-matmul formulation).
+
+    No capacity factor and no dropped tokens: (token, slot) pairs are
+    sorted by expert and the expert MLP runs as TWO grouped ragged
+    matmuls (``jax.lax.ragged_dot`` — XLA's native grouped-GEMM on TPU,
+    tiling each ragged expert segment onto the MXU), so each expert
+    processes exactly its routed tokens. Under an expert-sharded mesh
+    the cross-device exchange this implies is ``ragged_all_to_all``;
+    inside one jitted program GSPMD inserts the equivalent collectives
+    from the sharding annotations.
+
+    x: [s, d]; gate_logits: [s, e]; gate_up: [e, d, 2f]; down: [e, f, d].
+    Returns (y [s, d], aux) (+ stats dict with drop_rate=0.0).
+    """
+    s, d = x.shape
+    e = num_expert
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    topk_prob, topk_idx = jax.lax.top_k(probs, top_k)       # [s, k]
+
+    # sort (token, slot) pairs by destination expert; stable order keeps
+    # in-expert arrival order deterministic
+    flat_e = topk_idx.reshape(-1)                           # [s*k]
+    order = jnp.argsort(flat_e, stable=True)
+    xs = x[order // top_k]                                  # [s*k, d]
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+
+    # expert weights shard over the EP axis (same constraint the
+    # capacity path puts on its expert buffers); GSPMD turns the
+    # token-side exchange into the ragged all-to-all equivalent
+    if expert_axis is not None:
+        gate_up = _ep_constraint(gate_up, expert_axis)
+        down = _ep_constraint(down, expert_axis)
+    gu = jax.lax.ragged_dot(xs, gate_up.astype(xs.dtype), group_sizes)
+    g, u = jnp.split(gu, 2, axis=-1)
+    h = (jax.nn.silu(g.astype(jnp.float32)).astype(xs.dtype) * u)
+    ys = jax.lax.ragged_dot(h, down.astype(xs.dtype), group_sizes)
+
+    # unsort back to (token, slot) order and combine
+    y_sorted = jnp.zeros_like(ys)
+    y_sorted = y_sorted.at[order].set(ys)
+    picked = y_sorted.reshape(s, top_k, -1)                 # [s, k, d]
+
+    if normalize_gates:
+        gates = topk_prob / jnp.maximum(
+            jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    else:
+        gates = topk_prob
+    y = jnp.einsum("sk,skd->sd", gates.astype(x.dtype), picked)
+
+    # same GShard load-balance aux as the capacity path
+    me = jnp.mean(probs, axis=0)
+    onehot0 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(me * jnp.mean(onehot0, axis=0))
+    if return_stats:
+        return y, aux, {"drop_rate": jnp.float32(0.0)}
+    return y, aux
+
+
 def _ep_constraint(arr, axis):
     from . import env as _env
     from jax.sharding import NamedSharding, PartitionSpec as P

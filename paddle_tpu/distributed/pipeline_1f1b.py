@@ -144,7 +144,8 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
                         first_params=None, last_params=None,
                         last_feeds=None, mesh: Optional[Mesh] = None,
                         axis: str = "pp",
-                        batch_axes=("dp", "sharding")):
+                        batch_axes=("dp", "sharding"),
+                        loss_scale=None):
     """Run one full 1F1B train pass; returns
     ``(mean_loss, (g_stacked, g_first, g_last))``.
 
@@ -153,6 +154,9 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
     last_fn(last_params, h, last_feed_mb) -> scalar per-micro loss
     feeds: [n_micro, mb, ...] raw stage-0 inputs.
     last_feeds: [n_micro, ...] per-micro labels for last_fn.
+    loss_scale: optional traced scalar — seeds the backward chain at the
+    last stage (fp16 GradScaler semantics: every grad comes out
+    multiplied by it; the reported loss stays unscaled).
     """
     mesh = mesh or _env.get_mesh()
     pp = mesh.shape[axis]
@@ -171,7 +175,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
     op_arr = jnp.asarray(op_tab)
     mi_arr = jnp.asarray(mi_tab)
 
-    def per_device(params_block, mbs, fparams, lparams, lfeeds):
+    def per_device(params_block, mbs, fparams, lparams, lfeeds, scale_a):
         params_local = jax.tree_util.tree_map(lambda x: x[0],
                                               params_block)
         stage = jax.lax.axis_index(axis)
@@ -179,6 +183,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
         perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
         is_first = stage == 0
         is_last = stage == pp - 1
+        seed_g = scale_a.astype(jnp.float32)
 
         zr = lambda: jnp.zeros((pp,) + h_shape, h_dtype)
         g_mid0 = zeros_like_tree(params_local)
@@ -224,9 +229,12 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
                     y = stage_fn(p_mid, x)
                     return last_fn(p_last, y, lf_of(m)).astype(
                         jnp.float32)
-                (loss, (gm, gl, gx)) = jax.value_and_grad(
-                    loss_of, argnums=(0, 1, 2))(params_local, lparams,
-                                                x_saved)
+                loss, pull = jax.vjp(loss_of, params_local, lparams,
+                                     x_saved)
+                # GradScaler: seed the chain with the loss scale — the
+                # grads (incl. the boundary gx riding the ring) come out
+                # scaled; the reported loss stays unscaled
+                gm, gl, gx = pull(seed_g)
                 return gm, g_first0, gl, gx, loss
 
             def first_case():
@@ -294,12 +302,15 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
     mapped = shard_map_compat(
         per_device, mesh,
         (in_spec_params, feed_spec, rep(first_params), rep(last_params),
-         lf_spec),
+         lf_spec, P()),
         (P(), jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
          rep(first_params), rep(last_params)))
+    scale_a = jnp.float32(1.0) if loss_scale is None \
+        else jnp.asarray(loss_scale, jnp.float32)
     with manual_region():
         loss, g_stacked, g_first, g_last = mapped(
-            stacked_params, feeds, first_params, last_params, last_feeds)
+            stacked_params, feeds, first_params, last_params, last_feeds,
+            scale_a)
     return loss, (g_stacked, g_first, g_last)
 
 
@@ -486,7 +497,8 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
                                last_params=None, last_feeds=None,
                                mesh: Optional[Mesh] = None,
                                axis: str = "pp",
-                               batch_axes=("dp", "sharding")):
+                               batch_axes=("dp", "sharding"),
+                               loss_scale=None):
     """Interleaved-virtual-stage 1F1B train pass. Like
     :func:`pipeline_1f1b_grads`, but each device hosts ``v`` model
     chunks (stacked_params leaves are [pp, v, ...]; model part
@@ -512,7 +524,7 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
     mi_arr = jnp.asarray(mi_tab)
     ci_arr = jnp.asarray(ci_tab)
 
-    def per_device(params_block, mbs, fparams, lparams, lfeeds):
+    def per_device(params_block, mbs, fparams, lparams, lfeeds, scale_a):
         # leaves [1, v, ...] -> [v, ...]
         params_local = jax.tree_util.tree_map(lambda x: x[0],
                                               params_block)
@@ -521,6 +533,7 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
         perm_bwd = [(i, (i - 1) % pp) for i in range(pp)]
         is_first = stage == 0
         is_last = stage == pp - 1
+        seed_g = scale_a.astype(jnp.float32)
 
         zr = lambda: jnp.zeros((v, ring) + h_shape, h_dtype)
         g_mid0 = zeros_like_tree(params_local)        # [v, ...]
@@ -578,8 +591,8 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
                     y = stage_fn(p_mid, x)
                     return last_fn(p_last, y, lf_of(m)).astype(
                         jnp.float32)
-                (loss, (gm, gl, gx)) = jax.value_and_grad(
-                    loss_of, argnums=(0, 1, 2))(p_c, lparams, x_saved)
+                loss, pull = jax.vjp(loss_of, p_c, lparams, x_saved)
+                gm, gl, gx = pull(seed_g)    # GradScaler seed
                 return gm, g_first0, gl, gx, loss
 
             def first_case():
@@ -659,10 +672,13 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
     mapped = shard_map_compat(
         per_device, mesh,
         (in_spec_params, feed_spec, rep(first_params), rep(last_params),
-         lf_spec),
+         lf_spec, P()),
         (P(), jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
          rep(first_params), rep(last_params)))
+    scale_a = jnp.float32(1.0) if loss_scale is None \
+        else jnp.asarray(loss_scale, jnp.float32)
     with manual_region():
         loss, g_stacked, g_first, g_last = mapped(
-            stacked_params, feeds, first_params, last_params, last_feeds)
+            stacked_params, feeds, first_params, last_params, last_feeds,
+            scale_a)
     return loss, (g_stacked, g_first, g_last)

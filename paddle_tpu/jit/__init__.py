@@ -28,6 +28,7 @@ __all__ = ["to_static", "not_to_static", "enable_to_static", "save", "load",
 _to_static_enabled = True
 _JIT_CACHE_SIZE = 64    # LRU bound on per-function compiled specializations
 _JIT_CACHE_WARN = 32    # warn once past this many live specializations
+_GUARD_MISS = object()  # sentinel: name absent (vs a None value)
 
 
 def enable_to_static(flag: bool):
@@ -105,6 +106,69 @@ class StaticFunction:
                 self._conv_fn = None
         return self._conv_fn or self._fn
 
+    _GUARDABLE = (int, float, bool, str, bytes, type(None))
+
+    def _guard_snapshot(self):
+        """SOT-style guards (reference ``python/paddle/jit/sot/``
+        guard-cache semantics): python-level values the trace closes
+        over — closure cells, module globals the code names, and scalar
+        Layer attributes — are baked into the compiled program as
+        constants. Snapshotting them into the cache key makes a change
+        re-trace instead of silently replaying stale constants. Only
+        hashable scalars are guarded; container/object state follows the
+        reference's behavior (guard on identity is out of scope — the
+        dy2static graph-break report covers those)."""
+        fn = self._fn
+        plan = getattr(self, "_guard_plan", None)
+        if plan is None:
+            # one-time plan: which (kind, name) sites held a guardable
+            # scalar at first call — steady-state calls re-read only
+            # those (a site that only LATER becomes a scalar is not
+            # guarded; that matches SOT, which guards what the traced
+            # frame actually saw)
+            plan = []
+            code = getattr(fn, "__code__", None)
+            if code is not None:
+                if getattr(fn, "__closure__", None):
+                    for i, name in enumerate(code.co_freevars):
+                        try:
+                            v = fn.__closure__[i].cell_contents
+                        except ValueError:
+                            continue
+                        if isinstance(v, self._GUARDABLE):
+                            plan.append(("c", i, name))
+                g = getattr(fn, "__globals__", {})
+                for name in code.co_names:
+                    if isinstance(g.get(name, _GUARD_MISS),
+                                  self._GUARDABLE):
+                        plan.append(("g", 0, name))
+                if self._layer is not None:
+                    for name in code.co_names:
+                        try:
+                            v = getattr(self._layer, name, _GUARD_MISS)
+                        except Exception:
+                            continue   # state-dependent property
+                        if isinstance(v, self._GUARDABLE):
+                            plan.append(("a", 0, name))
+            self._guard_plan = plan
+        out = []
+        for kind, idx, name in plan:
+            if kind == "c":
+                try:
+                    v = fn.__closure__[idx].cell_contents
+                except (ValueError, IndexError):
+                    continue
+            elif kind == "g":
+                v = fn.__globals__.get(name, _GUARD_MISS)
+            else:
+                try:
+                    v = getattr(self._layer, name, _GUARD_MISS)
+                except Exception:
+                    continue
+            if v is not _GUARD_MISS and isinstance(v, self._GUARDABLE):
+                out.append((kind + ":" + name, v))
+        return tuple(out)
+
     def _build(self, treedef, dyn_idx, statics):
         """jit specialized on the (treedef, static-leaf) signature —
         python scalars/strings/None stay python values during the trace
@@ -159,10 +223,19 @@ class StaticFunction:
             return self._fn(*args, **kwargs)
         treedef, dyn_idx, statics, dyn_arrays = self._partition(args,
                                                                 kwargs)
+        guards = self._guard_snapshot()
+        if getattr(self, "_last_guards", None) != guards:
+            # a guarded python value changed: the dy2static-converted
+            # callable baked the OLD cell contents into its rebuilt
+            # globals — drop it so conversion re-runs against the
+            # current values (the compile-cache key below changes too)
+            self._last_guards = guards
+            self.__dict__.pop("_conv_fn", None)
         try:
             key = (treedef, dyn_idx,
                    tuple((i, s) for i, s in enumerate(statics)
-                         if i not in dyn_idx))
+                         if i not in dyn_idx),
+                   guards)
             hash(key)
         except TypeError:
             # an unhashable non-tensor arg cannot key the compile cache;

@@ -50,8 +50,7 @@ Qwen2PretrainingCriterion = LlamaPretrainingCriterion
 
 
 class Qwen2ForCausalLM(LlamaForCausalLM):
-    def __init__(self, config: Qwen2Config):
-        super().__init__(config)
+    """Causal-LM head over the shared body (config carries the deltas)."""
 
 
 class Qwen2ForCausalLMPipe(LlamaForCausalLMPipe):

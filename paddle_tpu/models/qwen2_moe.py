@@ -58,6 +58,9 @@ class Qwen2MoeConfig:
     qkv_bias: bool = True                   # the Qwen2 signature detail
     recompute: bool = False
     expert_axis: str = "dp"                 # mesh axis experts shard over
+    # dropless routing: no capacity factor, no dropped tokens — experts
+    # run as grouped ragged matmuls (jax.lax.ragged_dot)
+    dropless: bool = False
     dtype: str = "float32"
 
     @staticmethod
@@ -138,14 +141,23 @@ class Qwen2MoeSparseBlock(Layer):
         collect = getattr(self, "_collect_stats", False)
 
         def f(x_arr, logit_arr, gate_up, down):
-            efn = self.experts.expert_fn(gate_up, down)
-            out = moe_dispatch_combine(
-                x_arr, logit_arr, cfg.num_experts,
-                top_k=cfg.num_experts_per_tok,
-                capacity_factor=cfg.capacity_factor, expert_fn=efn,
-                expert_axis=cfg.expert_axis,
-                normalize_gates=cfg.norm_topk_prob,
-                return_stats=collect)
+            if getattr(cfg, "dropless", False):
+                from ..distributed.moe import \
+                    moe_dispatch_combine_dropless
+                out = moe_dispatch_combine_dropless(
+                    x_arr, logit_arr, cfg.num_experts,
+                    cfg.num_experts_per_tok, gate_up, down,
+                    normalize_gates=cfg.norm_topk_prob,
+                    expert_axis=cfg.expert_axis, return_stats=collect)
+            else:
+                efn = self.experts.expert_fn(gate_up, down)
+                out = moe_dispatch_combine(
+                    x_arr, logit_arr, cfg.num_experts,
+                    top_k=cfg.num_experts_per_tok,
+                    capacity_factor=cfg.capacity_factor, expert_fn=efn,
+                    expert_axis=cfg.expert_axis,
+                    normalize_gates=cfg.norm_topk_prob,
+                    return_stats=collect)
             if collect:
                 y, aux, stats = out
                 return y, aux, stats["drop_rate"]

@@ -68,6 +68,11 @@ def load_library() -> ctypes.CDLL:
         lib.tcps_server_start_host.argtypes = [ctypes.c_char_p,
                                                ctypes.c_int,
                                                ctypes.POINTER(c)]
+        lib.tcps_server_start_persist.restype = ctypes.c_int64
+        lib.tcps_server_start_persist.argtypes = [ctypes.c_char_p,
+                                                  ctypes.c_int,
+                                                  ctypes.c_char_p,
+                                                  ctypes.POINTER(c)]
         lib.tcps_server_stop.argtypes = [c]
         lib.tcps_connect.restype = c
         lib.tcps_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
@@ -123,7 +128,7 @@ class TCPStore:
     """
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=30.0):
+                 world_size=1, timeout=30.0, snapshot_path=None):
         lib = load_library()
         self._lib = lib
         self._server = None
@@ -135,8 +140,10 @@ class TCPStore:
             # unauthenticated, so INADDR_ANY would expose rank 0.
             # NAT/docker deployments advertise an address no local
             # interface owns: fall back to all interfaces with a warning
-            bound = lib.tcps_server_start_host(host.encode(), int(port),
-                                               ctypes.byref(handle))
+            snap = (snapshot_path.encode()
+                    if snapshot_path else None)
+            bound = lib.tcps_server_start_persist(
+                host.encode(), int(port), snap, ctypes.byref(handle))
             # fall back to all interfaces ONLY when the advertised
             # address is not locally bindable (NAT/docker forwarding:
             # EADDRNOTAVAIL, or unresolvable: EINVAL) — other errors

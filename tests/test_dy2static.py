@@ -408,3 +408,57 @@ def test_speculative_branch_buffer_write_graph_breaks():
     # the fallback ran ONCE eagerly: running stats updated exactly once
     np.testing.assert_allclose(st.bn._mean.numpy(), m.bn._mean.numpy(),
                                rtol=1e-5)
+
+
+def test_guard_retrace_on_global_change():
+    """SOT guard semantics: a module-global constant baked into the
+    trace must invalidate the cache when it changes (r3 verdict #7)."""
+    import types
+    mod = types.ModuleType("guard_mod")
+    src = """
+import paddle_tpu as paddle
+FACTOR = 2.0
+def f(x):
+    return x * FACTOR
+"""
+    exec(src, mod.__dict__)
+    st = paddle.jit.to_static(mod.f)
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(st(x).numpy(), [6.0])
+    mod.f.__globals__["FACTOR"] = 5.0
+    np.testing.assert_allclose(st(x).numpy(), [15.0])
+
+
+def test_guard_retrace_on_closure_change():
+    def make(k):
+        def f(x):
+            return x * k
+        return f
+
+    f = make(2.0)
+    st = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose(st(x).numpy(), [6.0])
+    # rebind the cell value (cell_contents is writable in py3.7+)
+    f.__closure__[0].cell_contents = 7.0
+    np.testing.assert_allclose(st(x).numpy(), [21.0])
+
+
+def test_guard_retrace_on_layer_attr_change():
+    import paddle_tpu.nn as nn
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.alpha = 2.0
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x) * self.alpha
+
+    m = paddle.jit.to_static(M())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y1 = m.forward(x).numpy()
+    m.alpha = 10.0
+    y2 = m.forward(x).numpy()
+    np.testing.assert_allclose(y2, y1 * 5.0, rtol=1e-5)

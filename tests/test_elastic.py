@@ -240,3 +240,71 @@ def test_launch_hang_detection_restarts(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert "heartbeat lost" in r.stderr
     assert "elastic restart 1/1" in r.stderr
+
+
+@pytest.mark.slow
+def test_master_failover_snapshot_resume(tmp_path):
+    """Kill rank-0 (the store master) with SIGKILL and relaunch it: the
+    persisted store snapshot must restore the elastic state (worker
+    registrations survive), and training resumes from the checkpoint
+    (r3 verdict #9 — etcd-durability parity without etcd)."""
+    snap = str(tmp_path / "store.snapshot")
+    ckpt = str(tmp_path / "ckpt")
+    script = tmp_path / "master.py"
+    script.write_text(f"""
+import sys, time
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  save_checkpoint)
+mgr = ElasticManager(rank=0, world_size=2, is_master=True,
+                     snapshot_path={snap!r}, timeout=5.0)
+mgr.register()
+# job metadata a restarted master must recover
+mgr._store.set("elastic/job/world_size", "2")
+# train a step and checkpoint
+paddle.seed(0)
+w = paddle.to_tensor(np.full((4,), 7.25, np.float32))
+save_checkpoint({ckpt!r}, step=3, state_dict={{"w": w}})
+print("PORT", mgr.port, flush=True)
+time.sleep(120)   # parent SIGKILLs us here
+""")
+    env = {k: v for k, v in os.environ.items()}
+    env["PYTHONPATH"] = os.getcwd()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert line.startswith("PORT"), line
+        # a worker registers against the live master
+        worker = ElasticManager(rank=1, world_size=2, is_master=False,
+                                port=int(line.split()[1]), timeout=5.0)
+        worker.register()
+        worker.close()
+        time.sleep(0.3)          # let the snapshot land
+        proc.kill()              # SIGKILL: no cleanup, no close()
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # ---- relaunched master: same snapshot, fresh process state ----
+    mgr2 = ElasticManager(rank=0, world_size=2, is_master=True,
+                          snapshot_path=snap, timeout=1e9)
+    try:
+        polled = mgr2.poll()
+        regs = sorted(polled["alive"] + polled["dead"])
+        assert regs == [0, 1], (
+            f"registrations lost across master restart: {polled}")
+        assert mgr2._store.try_get("elastic/job/world_size") == b"2"
+    finally:
+        mgr2.close()
+
+    # training resumes from the persisted checkpoint
+    state = {"w": paddle.to_tensor(np.zeros((4,), np.float32))}
+    step = resume_or_start(ckpt, state)
+    assert step == 3
+    np.testing.assert_allclose(state["w"].numpy(), np.full((4,), 7.25))

@@ -176,3 +176,50 @@ def test_qwen2_moe_expert_parallel_mesh():
         assert np.isfinite(l0) and np.isfinite(l1)
     finally:
         denv.set_mesh(None)
+
+
+def test_dropless_matches_padded_when_nothing_drops():
+    """Dropless (ragged_dot grouped matmuls) must equal the
+    capacity-padded GShard path when capacity is large enough that the
+    padded path drops nothing (r3 verdict #4)."""
+    import dataclasses
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+    paddle.seed(3)
+    cfg = Qwen2MoeConfig.tiny(vocab=128, hidden=48, layers=2, heads=4,
+                              kv_heads=2, moe_ffn=24, shared_ffn=48,
+                              experts=4, topk=2)
+    cfg.capacity_factor = 100.0      # no drops in the padded path
+    model = Qwen2MoeForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (2, 16))
+        .astype(np.int64))
+    model.eval()
+    y_padded = model(ids).numpy()
+
+    cfg.dropless = True              # same params, dropless routing
+    y_dropless = model(ids).numpy()
+    np.testing.assert_allclose(y_dropless, y_padded, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_dropless_trains_and_reports_zero_drop():
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+    paddle.seed(4)
+    cfg = Qwen2MoeConfig.tiny(vocab=128, hidden=48, layers=2, heads=4,
+                              kv_heads=2, moe_ffn=24, shared_ffn=48,
+                              experts=4, topk=2)
+    cfg.dropless = True
+    model = Qwen2MoeForCausalLM(cfg)
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 128, (4, 16)).astype(np.int64)
+
+    def batch():
+        return paddle.to_tensor(data), paddle.to_tensor(data)
+
+    losses = _train_steps(model, batch, n=8)
+    assert losses[-1] < losses[0], losses
+    assert all(np.isfinite(l) for l in losses)
+    drops = model.collect_drop_rates(paddle.to_tensor(data))
+    assert all(d == 0.0 for d in drops), drops
