@@ -15,6 +15,7 @@ from . import fleet
 from . import auto_parallel
 from . import checkpoint
 from . import rpc
+from . import ps
 from . import sharding as sharding_mod
 from .auto_parallel import (DistAttr, Partial, Placement, ProcessMesh,
                             Replicate, Shard, Strategy, dtensor_from_fn,

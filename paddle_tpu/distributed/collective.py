@@ -170,13 +170,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.clear()
             tensor_list.extend(_wrap_out(gathered[i]) for i in range(n))
             return
+        if axis != 0:
+            # concat the per-rank shards along `axis` (same shape the
+            # eager regime returns — regimes must agree)
+            return _wrap_out(jnp.concatenate(
+                [gathered[i] for i in range(n)], axis=axis))
         return _wrap_out(gathered)
     be = _eager(arr)
     if be is not None:
-        if axis != 0:
-            raise NotImplementedError(
-                "all_gather(axis != 0) across processes is not "
-                "supported; transpose first")
         g, ranks = _group_ranks(group)
         parts = [_wrap_out(jnp.asarray(a))
                  for a in be.all_gather(np.asarray(arr), ranks)]
@@ -184,6 +185,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.clear()
             tensor_list.extend(parts)
             return
+        if axis != 0:
+            # non-0 gather axis: concatenate the per-rank shards along
+            # it (the reference concat_v2 path of c_allgather)
+            return _wrap_out(jnp.concatenate(
+                [as_jax(t) for t in parts], axis=axis))
         # match the shard_map regime's stacked [world, ...] shape
         return _wrap_out(jnp.stack([as_jax(t) for t in parts], axis=0))
     if isinstance(tensor_list, list):

@@ -1,0 +1,274 @@
+"""Parameter-server mode (reference: ``paddle/fluid/distributed/ps/``
+brpc PS — sparse/dense tables with server-side optimizers, async
+push/pull — and ``python/paddle/distributed/fleet`` PS role flow).
+
+TPU-first scope: the PS serves the SPARSE side of recommendation
+models (huge embedding tables that cannot live in HBM) from host
+memory, while the dense math runs through the normal jax path. The
+transport is the in-tree RPC stack (``distributed/rpc`` over sockets +
+TCPStore discovery) instead of brpc; tables are numpy on the server
+(the reference's are C++ host tables — same locality, simpler code).
+
+Pieces:
+- ``SparseTable`` / ``DenseTable``: server-side state with server-side
+  optimizers (async-SGD semantics: ``push`` applies the update at
+  arrival order, no global barrier — the reference's async mode).
+- ``run_server()``: hosts the tables in this process and serves
+  create/pull/push/stop via RPC.
+- ``PSClient``: worker-side facade; sparse ids shard across servers by
+  ``id % n_servers`` (the reference's hash sharding).
+- ``DistributedEmbedding``: an ``nn.Layer`` whose rows are pulled per
+  batch from the PS and whose row gradients are pushed back on
+  ``backward()`` via a grad hook.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "run_server", "stop_server",
+           "PSClient", "DistributedEmbedding"]
+
+
+class SparseTable:
+    """id -> row table with lazy row init and a server-side optimizer."""
+
+    def __init__(self, dim, dtype="float32", optimizer="sgd", lr=0.01,
+                 init_std=0.01, seed=0):
+        self.dim = int(dim)
+        self.dtype = np.dtype(dtype)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.init_std = float(init_std)
+        self.rows: Dict[int, np.ndarray] = {}
+        self.acc: Dict[int, np.ndarray] = {}   # adagrad accumulators
+        self._rng = np.random.RandomState(seed)
+        self._mu = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = (self._rng.randn(self.dim) * self.init_std).astype(
+                self.dtype)
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        with self._mu:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads) -> None:
+        grads = np.asarray(grads, self.dtype)
+        with self._mu:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                r = self._row(i)
+                if self.optimizer == "adagrad":
+                    a = self.acc.setdefault(
+                        i, np.zeros(self.dim, self.dtype))
+                    a += g * g
+                    r -= self.lr * g / (np.sqrt(a) + 1e-6)
+                else:                       # async SGD
+                    r -= self.lr * g
+
+    def n_rows(self) -> int:
+        return len(self.rows)
+
+
+class DenseTable:
+    def __init__(self, shape, dtype="float32", optimizer="sgd", lr=0.01,
+                 seed=0):
+        rng = np.random.RandomState(seed)
+        self.value = (rng.randn(*shape) * 0.01).astype(dtype)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.acc = np.zeros_like(self.value)
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self.value.copy()
+
+    def push(self, grad) -> None:
+        g = np.asarray(grad, self.value.dtype)
+        with self._mu:
+            if self.optimizer == "adagrad":
+                self.acc += g * g
+                self.value -= self.lr * g / (np.sqrt(self.acc) + 1e-6)
+            else:
+                self.value -= self.lr * g
+
+
+# ---------------------------------------------------------------------------
+# server process side: module-level state + RPC-invokable functions
+# ---------------------------------------------------------------------------
+
+_TABLES: Dict[str, object] = {}
+
+
+def _ps_create_sparse(name, dim, optimizer, lr, init_std, seed):
+    _TABLES.setdefault(name, SparseTable(dim, optimizer=optimizer, lr=lr,
+                                         init_std=init_std, seed=seed))
+    return True
+
+
+def _ps_create_dense(name, shape, optimizer, lr, seed):
+    _TABLES.setdefault(name, DenseTable(shape, optimizer=optimizer,
+                                        lr=lr, seed=seed))
+    return True
+
+
+def _ps_pull_sparse(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _ps_push_sparse(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+    return True
+
+
+def _ps_pull_dense(name):
+    return _TABLES[name].pull()
+
+
+def _ps_push_dense(name, grad):
+    _TABLES[name].push(grad)
+    return True
+
+
+def _ps_stat(name):
+    t = _TABLES[name]
+    return {"n_rows": t.n_rows()} if isinstance(t, SparseTable) \
+        else {"shape": list(t.value.shape)}
+
+
+def run_server(name, rank=None, world_size=None, master_endpoint=None):
+    """Host PS tables in this process: join the RPC world and serve
+    until ``stop_server`` (the reference's ``fleet.run_server()``)."""
+    from .. import rpc
+    rpc.init_rpc(name, rank=rank, world_size=world_size,
+                 master_endpoint=master_endpoint)
+    return name
+
+
+def stop_server():
+    from .. import rpc
+    rpc.shutdown()
+
+
+class PSClient:
+    """Worker-side facade: shards sparse ids across the server list by
+    ``id % n_servers``; dense tables live on server 0."""
+
+    def __init__(self, servers: List[str]):
+        if not servers:
+            raise ValueError("PSClient needs at least one server name")
+        self.servers = list(servers)
+
+    def _rpc(self, server, fn, *args):
+        from .. import rpc
+        return rpc.rpc_sync(server, fn, args=args)
+
+    # -- table management -----------------------------------------------
+    def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
+                            init_std=0.01):
+        for k, s in enumerate(self.servers):
+            # per-shard seed so shards don't repeat the same rows
+            self._rpc(s, _ps_create_sparse, name, dim, optimizer, lr,
+                      init_std, k)
+        self._dims = getattr(self, "_dims", {})
+        self._dims[name] = int(dim)
+        return name
+
+    def create_dense_table(self, name, shape, optimizer="sgd", lr=0.01):
+        self._rpc(self.servers[0], _ps_create_dense, name, list(shape),
+                  optimizer, lr, 0)
+        return name
+
+    # -- sparse ---------------------------------------------------------
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        n = len(self.servers)
+        which = ids % n
+        return ids, which
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        ids, which = self._shard(ids)
+        dim = getattr(self, "_dims", {}).get(name, 0)
+        out = np.zeros((len(ids), dim), np.float32)
+        for k, s in enumerate(self.servers):
+            sel = np.nonzero(which == k)[0]
+            if sel.size == 0:
+                continue
+            rows = self._rpc(s, _ps_pull_sparse, name,
+                             ids[sel].tolist())
+            if out.shape[1] != rows.shape[1] or out.dtype != rows.dtype:
+                out = np.zeros((len(ids), rows.shape[1]), rows.dtype)
+            out[sel] = rows
+        return out
+
+    def push_sparse(self, name, ids, grads) -> None:
+        ids, which = self._shard(ids)
+        grads = np.asarray(grads)
+        for k, s in enumerate(self.servers):
+            sel = np.nonzero(which == k)[0]
+            if sel.size:
+                self._rpc(s, _ps_push_sparse, name, ids[sel].tolist(),
+                          grads[sel])
+
+    # -- dense ----------------------------------------------------------
+    def pull_dense(self, name) -> np.ndarray:
+        return self._rpc(self.servers[0], _ps_pull_dense, name)
+
+    def push_dense(self, name, grad) -> None:
+        self._rpc(self.servers[0], _ps_push_dense, name,
+                  np.asarray(grad))
+
+    def stat(self, name) -> dict:
+        return self._rpc(self.servers[0], _ps_stat, name)
+
+
+class DistributedEmbedding:
+    """Embedding whose table lives on the PS (reference:
+    ``paddle.static.nn.sparse_embedding`` / distributed lookup table).
+
+    ``forward(ids)`` pulls the batch's rows into a local Tensor wired
+    into the autograd tape; after ``loss.backward()``, call
+    ``push_grads()`` to send the accumulated row gradients to the PS
+    (async-SGD: the server applies its optimizer on arrival)."""
+
+    def __init__(self, client: PSClient, name, dim, optimizer="sgd",
+                 lr=0.01):
+        self.client = client
+        self.name = client.create_sparse_table(name, dim,
+                                               optimizer=optimizer,
+                                               lr=lr)
+        self.dim = int(dim)
+        self._pending = []   # [(unique_ids, local Tensor)]
+
+    def forward(self, ids):
+        from ...framework.core import Tensor
+        import jax.numpy as jnp
+        ids_np = np.asarray(
+            ids.numpy() if hasattr(ids, "numpy") else ids, np.int64)
+        flat = ids_np.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = self.client.pull_sparse(self.name, uniq)
+        local = Tensor(jnp.asarray(rows))
+        local.stop_gradient = False
+        self._pending.append((uniq, local))
+        from ...ops.manipulation import gather, reshape
+        out = gather(local, Tensor(jnp.asarray(inverse)))
+        return reshape(out, list(ids_np.shape) + [self.dim])
+
+    __call__ = forward
+
+    def push_grads(self):
+        """Send grads of every pulled batch since the last push."""
+        for uniq, local in self._pending:
+            if local.grad is not None:
+                self.client.push_sparse(self.name, uniq,
+                                        np.asarray(local.grad.numpy()))
+        self._pending.clear()

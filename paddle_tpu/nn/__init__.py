@@ -35,7 +35,8 @@ from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                             AdaptiveAvgPool3D, AdaptiveMaxPool1D,
                             AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
-                            MaxPool3D, MaxUnPool2D)
+                            MaxPool3D, MaxUnPool1D, MaxUnPool2D,
+                            MaxUnPool3D)
 from .layer.rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,
                         RNNCellBase, SimpleRNN, SimpleRNNCell)
 from .layer.transformer import (MultiHeadAttention, Transformer,

@@ -20,7 +20,7 @@ __all__ = [
     "max_pool1d", "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
     "adaptive_avg_pool2d", "adaptive_avg_pool3d", "adaptive_max_pool1d",
     "adaptive_max_pool2d", "adaptive_max_pool3d", "unfold", "fold",
-    "max_unpool2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
 ]
 
 
@@ -251,36 +251,71 @@ def _tuplify2(v):
     return tuple(_tuplify(v, 2))
 
 
-def _max_pool2d_with_mask(x, kernel_size, stride, padding):
-    """Real argmax mask: flat H*W index of each window max (paddle's
-    return_mask contract, consumed by max_unpool2d)."""
-    kh, kw = _tuplify2(kernel_size)
-    sh, sw = _tuplify2(stride if stride is not None else kernel_size)
-    ph, pw = _tuplify2(padding)
-    B, C, H, W = x.shape
-    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+def _max_pool_nd_with_mask(x, kernel_size, stride, padding, nsp):
+    """Real argmax mask for any spatial rank: flat index (over the
+    ORIGINAL spatial dims) of each window's max — paddle's return_mask
+    contract, consumed by max_unpool{1,2,3}d. Reference kernels:
+    ``phi/kernels`` max_pool*_with_index."""
+    import numpy as _np
+    k = _tuplify(kernel_size, nsp)
+    s = _tuplify(stride if stride is not None else kernel_size, nsp)
+    p = _tuplify(padding, nsp)
+    B, C = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
                  constant_values=-jnp.inf)
-    OH = (H + 2 * ph - kh) // sh + 1
-    OW = (W + 2 * pw - kw) // sw + 1
-    ri = (jnp.arange(OH) * sh)[:, None] + jnp.arange(kh)[None, :]
-    ci = (jnp.arange(OW) * sw)[:, None] + jnp.arange(kw)[None, :]
-    # [B, C, OH, kh, OW, kw] -> [B, C, OH, OW, kh*kw]
-    patches = xp[:, :, ri[:, :, None, None], ci[None, None, :, :]]
-    patches = patches.transpose(0, 1, 2, 4, 3, 5).reshape(
-        B, C, OH, OW, kh * kw)
+    out_sz = tuple((spatial[i] + 2 * p[i] - k[i]) // s[i] + 1
+                   for i in range(nsp))
+    # advanced-index windows: dim i contributes [O_i, k_i], broadcast
+    # over the interleaved (O1, k1, ..., On, kn) grid
+    shaped = []
+    for i in range(nsp):
+        idx = (jnp.arange(out_sz[i]) * s[i])[:, None] + jnp.arange(k[i])
+        shape = [1] * (2 * nsp)
+        shape[2 * i], shape[2 * i + 1] = out_sz[i], k[i]
+        shaped.append(idx.reshape(shape))
+    patches = xp[(slice(None), slice(None)) + tuple(shaped)]
+    perm = [0, 1] + [2 + 2 * i for i in range(nsp)] + \
+        [3 + 2 * i for i in range(nsp)]
+    patches = patches.transpose(perm).reshape(
+        (B, C) + out_sz + (int(_np.prod(k)),))
     am = jnp.argmax(patches, axis=-1)
     vals = jnp.max(patches, axis=-1)
-    r = (jnp.arange(OH) * sh)[None, None, :, None] + am // kw - ph
-    c = (jnp.arange(OW) * sw)[None, None, None, :] + am % kw - pw
-    mask = (r * W + c).astype(jnp.int32)
-    return vals, mask
+    # decompose the in-window argmax into per-dim offsets, map back to
+    # original (unpadded) coordinates, flatten over the spatial dims
+    mask = jnp.zeros_like(am)
+    rem = am
+    scale = 1
+    for i in reversed(range(nsp)):
+        off = rem % k[i]
+        rem = rem // k[i]
+        start_shape = [1] * (2 + nsp)
+        start_shape[2 + i] = out_sz[i]
+        start = (jnp.arange(out_sz[i]) * s[i]).reshape(start_shape)
+        coord = start + off - p[i]
+        mask = mask + coord * scale
+        scale *= spatial[i]
+    return vals, mask.astype(jnp.int32)
+
+
+def _max_pool_mask(x, kernel_size, stride, padding, nsp, data_format,
+                   want_format, ceil_mode, op_name):
+    if data_format != want_format or ceil_mode:
+        raise NotImplementedError(
+            f"{op_name} return_mask supports {want_format}, "
+            "ceil_mode=False")
+
+    def f(a):
+        return _max_pool_nd_with_mask(a, kernel_size, stride, padding,
+                                      nsp)
+    return apply_jax(op_name + "_mask", f, x, n_outputs=2)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     if return_mask:
-        raise NotImplementedError(
-            "max_pool1d return_mask not implemented (2d has it)")
+        return _max_pool_mask(x, kernel_size, stride, padding, 1, "NCL",
+                              "NCL", ceil_mode, "max_pool1d")
     return _pool(x, kernel_size, stride, padding, 1, "max", "NCL",
                  "max_pool1d", ceil_mode)
 
@@ -290,24 +325,18 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     if not return_mask:
         return _pool(x, kernel_size, stride, padding, 2, "max",
                      data_format, "max_pool2d", ceil_mode)
-    if data_format != "NCHW" or ceil_mode:
-        raise NotImplementedError(
-            "max_pool2d return_mask supports NCHW, ceil_mode=False")
-
-    def f(a):
-        return _max_pool2d_with_mask(a, kernel_size, stride, padding)
-    vals, mask = apply_jax("max_pool2d_mask", f, x, n_outputs=2)
-    return vals, mask
+    return _max_pool_mask(x, kernel_size, stride, padding, 2,
+                          data_format, "NCHW", ceil_mode, "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    out = _pool(x, kernel_size, stride, padding, 3, "max", data_format,
-                "max_pool3d", ceil_mode)
     if return_mask:
-        raise NotImplementedError(
-            "max_pool3d return_mask not implemented (2d has it)")
-    return out
+        return _max_pool_mask(x, kernel_size, stride, padding, 3,
+                              data_format, "NCDHW", ceil_mode,
+                              "max_pool3d")
+    return _pool(x, kernel_size, stride, padding, 3, "max", data_format,
+                 "max_pool3d", ceil_mode)
 
 
 
@@ -429,27 +458,54 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
     return apply_jax("fold", f, x)
 
 
+def _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                   output_size, nsp, op_name):
+    """Scatter pooled values back to the flat positions recorded in the
+    return_mask indices (any spatial rank)."""
+    k = _tuplify(kernel_size, nsp)
+    s = _tuplify(stride if stride is not None else kernel_size, nsp)
+    p = _tuplify(padding, nsp)
+
+    def f(a, idx):
+        B, C = a.shape[0], a.shape[1]
+        out_sp = a.shape[2:]
+        if output_size is not None:
+            spatial = tuple(output_size[-nsp:])
+        else:
+            spatial = tuple((out_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                            for i in range(nsp))
+        import numpy as _np
+        n_out = int(_np.prod(out_sp))
+        flat = jnp.zeros((B, C, int(_np.prod(spatial))), a.dtype)
+        out = flat.at[
+            jnp.arange(B)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(B, C, n_out)].set(a.reshape(B, C, n_out))
+        return out.reshape((B, C) + spatial)
+    return apply_jax(op_name, f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d supports NCL only")
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 1, "max_unpool1d")
+
+
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW", name=None):
     """``paddle.nn.functional.max_unpool2d``: scatter pooled values back
     to the positions recorded in the return_mask indices."""
     if data_format != "NCHW":
         raise NotImplementedError("max_unpool2d supports NCHW only")
-    kh, kw = _tuplify2(kernel_size)
-    sh, sw = _tuplify2(stride if stride is not None else kernel_size)
-    ph, pw = _tuplify2(padding)
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 2, "max_unpool2d")
 
-    def f(a, idx):
-        B, C, OH, OW = a.shape
-        if output_size is not None:
-            H, W = output_size[-2], output_size[-1]
-        else:
-            H = (OH - 1) * sh - 2 * ph + kh
-            W = (OW - 1) * sw - 2 * pw + kw
-        flat = jnp.zeros((B, C, H * W), a.dtype)
-        out = flat.at[
-            jnp.arange(B)[:, None, None],
-            jnp.arange(C)[None, :, None],
-            idx.reshape(B, C, OH * OW)].set(a.reshape(B, C, OH * OW))
-        return out.reshape(B, C, H, W)
-    return apply_jax("max_unpool2d", f, x, indices)
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d supports NCDHW only")
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, 3, "max_unpool3d")

@@ -16,7 +16,7 @@ __all__ = [
     "soft_margin_loss", "square_error_cost", "log_loss", "poisson_nll_loss",
     "multi_label_soft_margin_loss", "dice_loss",
     "triplet_margin_with_distance_loss", "hsigmoid_loss",
-    "margin_cross_entropy",
+    "margin_cross_entropy", "ctc_loss", "gaussian_nll_loss",
 ]
 
 
@@ -402,3 +402,23 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
         return apply_jax("margin_cross_entropy", f, logits, label,
                          n_outputs=2)
     return apply_jax("margin_cross_entropy", f, logits, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """``paddle.nn.functional.ctc_loss`` — functional form of
+    ``nn.CTCLoss`` (reference wraps warpctc; here the lax.scan alpha
+    recursion in the layer)."""
+    from ..layer.loss import CTCLoss
+    return CTCLoss(blank=blank, reduction=reduction)(
+        log_probs, labels, input_lengths, label_lengths,
+        norm_by_times=norm_by_times)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """``paddle.nn.functional.gaussian_nll_loss`` — functional form of
+    ``nn.GaussianNLLLoss`` (single implementation, in the layer)."""
+    from ..layer.loss import GaussianNLLLoss
+    return GaussianNLLLoss(full=full, epsilon=epsilon,
+                           reduction=reduction)(input, label, variance)

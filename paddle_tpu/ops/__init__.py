@@ -52,7 +52,8 @@ _METHODS = [
     "nan_to_num", "lerp", "inner", "outer", "kron", "trace", "scale",
     "increment", "addmm", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm",
     "diff", "angle", "conj", "real", "imag", "digamma", "lgamma", "neg",
-    "count_nonzero", "expm1", "exponential_",
+    "count_nonzero", "expm1", "exponential_", "gammaln", "isposinf",
+    "isneginf", "isreal",
     # manipulation
     "reshape", "reshape_", "flatten", "flatten_", "transpose", "squeeze",
     "unsqueeze", "concat", "split", "chunk", "tile", "expand", "expand_as",
@@ -74,7 +75,7 @@ _METHODS = [
     "cholesky_solve", "qr", "svd", "inverse", "det", "slogdet", "solve",
     "triangular_solve", "lstsq", "matrix_power", "eig", "eigvals", "pinv",
     "cond", "matrix_rank", "cross", "histogram", "bincount", "mode", "lu",
-    "corrcoef", "cov",
+    "corrcoef", "cov", "pdist", "baddbmm", "as_strided",
     # search
     "argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
     "bucketize", "kthvalue", "unique", "unique_consecutive", "nonzero",
@@ -122,7 +123,8 @@ def _install_methods():
     for name in ["add", "subtract", "multiply", "divide", "clip", "scale",
                  "floor", "ceil", "round", "exp", "sqrt", "rsqrt", "abs",
                  "tanh", "squeeze", "unsqueeze", "remainder", "pow",
-                 "transpose", "neg", "lerp", "cast"]:
+                 "transpose", "neg", "lerp", "cast", "index_fill",
+                 "masked_fill", "put_along_axis"]:
         fn = OPS.get(name) or getattr(Tensor, name, None)
         if fn is None:
             continue

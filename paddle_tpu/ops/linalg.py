@@ -20,7 +20,7 @@ __all__ = [
     "eigvalsh", "pinv", "cond", "matrix_rank", "cross", "histogram",
     "histogramdd", "bincount", "mode", "lu", "lu_unpack", "corrcoef", "cov",
     "matrix_transpose", "householder_product", "pca_lowrank", "einsum",
-    "multi_dot", "vecdot", "ormqr", "cdist",
+    "multi_dot", "vecdot", "ormqr", "cdist", "pdist", "baddbmm",
 ]
 
 
@@ -399,3 +399,33 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
             return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
         return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
     return apply_jax("cdist", f, x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    """``paddle.pdist``: condensed pairwise distances of the rows of a
+    2-D tensor — the upper triangle of cdist(x, x), row-major."""
+    def f(a):
+        n = a.shape[0]
+        diff = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            # +1e-30 inside the sqrt (same guard as cdist above):
+            # duplicate rows would otherwise give d/dx sqrt(0) = NaN
+            d = jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
+        elif p == 1.0:
+            d = jnp.sum(jnp.abs(diff), -1)
+        elif p == float("inf"):
+            d = jnp.max(jnp.abs(diff), -1)
+        else:
+            d = jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1),
+                          1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+    return apply_jax("pdist", f, x)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """``paddle.baddbmm``: beta * input + alpha * bmm(x, y)."""
+    def f(inp, a, b):
+        prod = jnp.matmul(a, b)
+        return beta * inp.astype(prod.dtype) + alpha * prod
+    return apply_jax("baddbmm", f, input, x, y)

@@ -33,7 +33,7 @@ __all__ = [
     "diagonal", "diagonal_scatter", "diag_embed", "fill_diagonal_",
     "shard_index", "tensordot", "rank", "shape",
     "column_stack", "row_stack", "take", "block_diag", "combinations",
-    "hstack", "vstack", "dstack", "slice_scatter",
+    "hstack", "vstack", "dstack", "slice_scatter", "as_strided",
 ]
 
 
@@ -776,3 +776,31 @@ def slice_scatter(x, value, axes, starts, ends, strides=None, name=None):
             idx[int(ax)] = builtins.slice(int(s), int(e), int(st))
         return a.at[tuple(idx)].set(v.astype(a.dtype))
     return apply_jax("slice_scatter", f, x, value)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """``paddle.as_strided``: strided view re-expressed as a gather over
+    the flattened input (XLA has no aliased views; same values,
+    functional copy)."""
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+    n_elems = int(np.prod(as_jax(x).shape))
+    max_index = int(offset) + builtins_sum(
+        max((sz - 1) * st, 0) for sz, st in zip(shape, stride))
+    min_index = int(offset) + builtins_sum(
+        min((sz - 1) * st, 0) for sz, st in zip(shape, stride))
+    if max_index >= n_elems or min_index < 0:
+        raise ValueError(
+            f"as_strided: shape {shape} / stride {stride} / offset "
+            f"{offset} reads index range [{min_index}, {max_index}] of "
+            f"a {n_elems}-element tensor")
+
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(int(offset))
+        for dim, (sz, st) in enumerate(zip(shape, stride)):
+            grid_shape = [1] * len(shape)
+            grid_shape[dim] = sz
+            idx = idx + (jnp.arange(sz) * st).reshape(grid_shape)
+        return flat[idx.reshape(-1)].reshape(shape)
+    return apply_jax("as_strided", f, x)

@@ -28,6 +28,7 @@ __all__ = [
     "outer", "kron", "trace", "scale", "increment", "stanh", "multiplex",
     "addmm", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm", "diff",
     "angle", "conj", "real", "imag", "digamma", "lgamma", "multigammaln",
+    "gammaln", "isposinf", "isneginf", "isreal",
     "i0", "i0e", "i1", "i1e", "polygamma", "hypot", "ldexp", "copysign",
     "nextafter", "count_nonzero", "broadcast_shape", "log_normal",
     "trapezoid", "cumulative_trapezoid", "renorm", "signbit", "sinc",
@@ -546,3 +547,18 @@ def standard_gamma(x, name=None):
                     else jnp.float32)
     from ._dispatch import nodiff
     return nodiff(f, x)
+
+
+gammaln = _unary("gammaln", jax.scipy.special.gammaln)
+isposinf = _unary("isposinf", jnp.isposinf)
+isneginf = _unary("isneginf", jnp.isneginf)
+
+
+def isreal(x, name=None):
+    """``paddle.isreal``: True where imaginary part is zero (all-True
+    for real dtypes)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            return jnp.imag(a) == 0
+        return jnp.ones(a.shape, bool)
+    return apply_jax("isreal", f, x)

@@ -207,9 +207,65 @@ def box_coder(prior_box, prior_box_var, target_box,
 def yolo_box(x, img_size, anchors, class_num, conf_thresh,
              downsample_ratio, clip_bbox=True, name=None,
              scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
-    raise NotImplementedError(
-        "yolo_box: YOLO-specific decode postprocessing is out of scope "
-        "for the core framework (compose from nms/box_coder)")
+    """``paddle.vision.ops.yolo_box`` (reference kernel:
+    ``phi/kernels`` yolo_box): decode YOLOv3 head predictions into
+    (boxes [N, H*W*A, 4] in x1y1x2y2 image coords, scores
+    [N, H*W*A, class_num]); predictions with objectness below
+    ``conf_thresh`` are zeroed."""
+    an = list(anchors)
+    an_num = len(an) // 2
+
+    def f(pred, imgs):
+        N, C, H, W = pred.shape
+        attrs = C // an_num - (1 if iou_aware else 0)
+        # [N, A, attrs(+iou), H, W]
+        p = pred.reshape(N, an_num, C // an_num, H, W)
+        if iou_aware:
+            iou = jax.nn.sigmoid(p[:, :, 0])           # [N, A, H, W]
+            p = p[:, :, 1:]
+        assert attrs == 5 + class_num, (attrs, class_num)
+        tx, ty, tw, th = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3]
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])              # [N, A, cls, H, W]
+
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * scale_x_y - bias + gx) / W
+        cy = (jax.nn.sigmoid(ty) * scale_x_y - bias + gy) / H
+        aw = jnp.asarray(an[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(an[1::2], jnp.float32)[None, :, None, None]
+        bw = jnp.exp(tw) * aw / (downsample_ratio * W)
+        bh = jnp.exp(th) * ah / (downsample_ratio * H)
+
+        if iou_aware:
+            conf = (obj ** (1.0 - iou_aware_factor)) * \
+                (iou ** iou_aware_factor)
+        else:
+            conf = obj
+        keep = conf >= conf_thresh                     # [N, A, H, W]
+
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2.0) * img_w
+        y1 = (cy - bh / 2.0) * img_h
+        x2 = (cx + bw / 2.0) * img_w
+        y2 = (cy + bh / 2.0) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0)
+            y1 = jnp.clip(y1, 0.0)
+            x2 = jnp.minimum(x2, img_w - 1.0)
+            y2 = jnp.minimum(y2, img_h - 1.0)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)   # [N, A, H, W, 4]
+        boxes = boxes * keep[..., None].astype(boxes.dtype)
+        scores = cls * (conf * keep)[:, :, None]       # [N, A, cls, H, W]
+        # flatten anchor-major over (A, H, W) — upstream layout
+        boxes = boxes.reshape(N, an_num * H * W, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(
+            N, an_num * H * W, class_num)
+        return boxes.astype(jnp.float32), scores.astype(jnp.float32)
+
+    return apply_jax("yolo_box", f, x, img_size, n_outputs=2)
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level,

@@ -401,3 +401,81 @@ def test_r3_random_families():
     g = paddle.standard_gamma(paddle.to_tensor(
         np.full((1000,), 4.0, np.float32)))
     assert 3.5 < float(g.numpy().mean()) < 4.5   # mean = alpha
+
+
+def test_r4_op_additions_oracle():
+    """r4 script-driven widening: gammaln/isposinf/isneginf/isreal,
+    pdist, baddbmm, as_strided, inplace index_fill_/masked_fill_/
+    put_along_axis_ — numpy/scipy/torch-contract oracles."""
+    from scipy import special as sp
+    x = np.abs(np.random.RandomState(0).randn(3, 4)).astype(np.float32) + 0.5
+    np.testing.assert_allclose(paddle.gammaln(paddle.to_tensor(x)).numpy(),
+                               sp.gammaln(x), rtol=1e-4, atol=1e-5)
+    v = np.array([1.0, np.inf, -np.inf, np.nan], np.float32)
+    np.testing.assert_array_equal(
+        paddle.isposinf(paddle.to_tensor(v)).numpy(), np.isposinf(v))
+    np.testing.assert_array_equal(
+        paddle.isneginf(paddle.to_tensor(v)).numpy(), np.isneginf(v))
+    assert paddle.isreal(paddle.to_tensor(v)).numpy().all()
+
+    # pdist == condensed upper triangle of cdist
+    pts = np.random.RandomState(1).randn(5, 3).astype(np.float32)
+    want = []
+    for i in range(5):
+        for j in range(i + 1, 5):
+            want.append(np.linalg.norm(pts[i] - pts[j]))
+    np.testing.assert_allclose(paddle.pdist(paddle.to_tensor(pts)).numpy(),
+                               np.asarray(want), rtol=1e-5)
+
+    a = np.random.RandomState(2).randn(2, 3, 4).astype(np.float32)
+    b = np.random.RandomState(3).randn(2, 4, 5).astype(np.float32)
+    inp = np.random.RandomState(4).randn(2, 3, 5).astype(np.float32)
+    got = paddle.baddbmm(paddle.to_tensor(inp), paddle.to_tensor(a),
+                         paddle.to_tensor(b), beta=0.5, alpha=2.0).numpy()
+    np.testing.assert_allclose(got, 0.5 * inp + 2.0 * (a @ b), rtol=1e-5)
+
+    base = np.arange(12, dtype=np.float32)
+    st = paddle.as_strided(paddle.to_tensor(base), [3, 2], [4, 2],
+                           offset=1).numpy()
+    want_st = np.lib.stride_tricks.as_strided(
+        base[1:], (3, 2), (16, 8))   # float32: numpy strides in bytes
+    np.testing.assert_array_equal(st, want_st)
+
+    t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    t.masked_fill_(paddle.to_tensor(np.array([[True, False, True],
+                                              [False, True, False]])), 5.0)
+    np.testing.assert_array_equal(t.numpy(), [[5, 0, 5], [0, 5, 0]])
+
+    # out-of-bounds strided views raise instead of silently clamping
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="as_strided"):
+        paddle.as_strided(paddle.to_tensor(base), [4, 4], [4, 4])
+
+    # pdist gradient at duplicate rows stays finite (sqrt(0) guard)
+    dup = paddle.to_tensor(np.array([[1.0, 2.0], [1.0, 2.0],
+                                     [0.0, 1.0]], np.float32))
+    dup.stop_gradient = False
+    paddle.pdist(dup).sum().backward()
+    assert np.isfinite(dup.grad.numpy()).all()
+
+
+def test_f_ctc_and_gaussian_nll():
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(0)
+    T, B, C, S = 8, 2, 5, 3
+    logits = paddle.to_tensor(rng.randn(T, B, C).astype(np.float32))
+    labels = paddle.to_tensor(rng.randint(1, C, (B, S)).astype(np.int32))
+    il = paddle.to_tensor(np.array([T, T - 2], np.int64))
+    ll = paddle.to_tensor(np.array([S, S - 1], np.int64))
+    f_val = F.ctc_loss(logits, labels, il, ll).numpy()
+    l_val = nn.CTCLoss()(logits, labels, il, ll).numpy()
+    np.testing.assert_allclose(f_val, l_val, rtol=1e-6)
+
+    mu = rng.randn(4, 3).astype(np.float32)
+    y = rng.randn(4, 3).astype(np.float32)
+    var = np.abs(rng.randn(4, 3)).astype(np.float32) + 0.1
+    got = F.gaussian_nll_loss(paddle.to_tensor(mu), paddle.to_tensor(y),
+                              paddle.to_tensor(var)).numpy()
+    want = np.mean(0.5 * (np.log(var) + (y - mu) ** 2 / var))
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -1,0 +1,134 @@
+"""r4 tail-closure ops: max_pool1d/3d(return_mask) + max_unpool1d/3d
+(torch as oracle — same flat-index contract) and yolo_box (numpy
+reference of the upstream kernel)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1), (2, 1, 0)])
+def test_max_pool1d_mask_matches_torch(k, s, p):
+    x = np.random.RandomState(0).randn(2, 3, 12).astype(np.float32)
+    vals, mask = F.max_pool1d(paddle.to_tensor(x), k, s, p,
+                              return_mask=True)
+    tv, ti = torch.nn.functional.max_pool1d(
+        torch.tensor(x), k, s, p, return_indices=True)
+    np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), ti.numpy())
+    # unpool roundtrip
+    un = F.max_unpool1d(vals, mask, k, s, p, output_size=[12])
+    tun = torch.nn.functional.max_unpool1d(tv, ti, k, s, p,
+                                           output_size=[12])
+    np.testing.assert_allclose(un.numpy(), tun.numpy(), rtol=1e-6)
+
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1)])
+def test_max_pool3d_mask_matches_torch(k, s, p):
+    x = np.random.RandomState(1).randn(2, 2, 8, 10, 6).astype(np.float32)
+    vals, mask = F.max_pool3d(paddle.to_tensor(x), k, s, p,
+                              return_mask=True)
+    tv, ti = torch.nn.functional.max_pool3d(
+        torch.tensor(x), k, s, p, return_indices=True)
+    np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), ti.numpy())
+    un = F.max_unpool3d(vals, mask, k, s, p, output_size=[8, 10, 6])
+    tun = torch.nn.functional.max_unpool3d(tv, ti, k, s, p,
+                                           output_size=[8, 10, 6])
+    np.testing.assert_allclose(un.numpy(), tun.numpy(), rtol=1e-6)
+
+
+def test_max_pool2d_mask_still_matches_torch():
+    x = np.random.RandomState(2).randn(2, 3, 10, 8).astype(np.float32)
+    vals, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, 0,
+                              return_mask=True)
+    tv, ti = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, 2, 0, return_indices=True)
+    np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), ti.numpy())
+
+
+def test_nn_maxunpool_layers():
+    import paddle_tpu.nn as nn
+    x = np.random.RandomState(3).randn(1, 2, 8).astype(np.float32)
+    vals, mask = F.max_pool1d(paddle.to_tensor(x), 2, 2,
+                              return_mask=True)
+    out = nn.MaxUnPool1D(2, 2)(vals, mask)
+    assert out.shape == [1, 2, 8]
+    x3 = np.random.RandomState(4).randn(1, 2, 4, 4, 4).astype(np.float32)
+    v3, m3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2, return_mask=True)
+    out3 = nn.MaxUnPool3D(2, 2)(v3, m3)
+    assert out3.shape == [1, 2, 4, 4, 4]
+
+
+def _yolo_box_ref(x, img_size, anchors, class_num, conf_thresh,
+                  downsample, clip_bbox=True, scale_x_y=1.0):
+    """Direct numpy transcription of the documented upstream formula."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+    N, C, H, W = x.shape
+    an = np.asarray(anchors).reshape(-1, 2)
+    A = len(an)
+    p = x.reshape(N, A, 5 + class_num, H, W)
+    boxes = np.zeros((N, A, H, W, 4), np.float32)
+    scores = np.zeros((N, A, H, W, class_num), np.float32)
+    bias = 0.5 * (scale_x_y - 1.0)
+    for n in range(N):
+        ih, iw = img_size[n]
+        for a in range(A):
+            for i in range(H):
+                for j in range(W):
+                    tx, ty, tw, th, to = p[n, a, :5, i, j]
+                    conf = sig(to)
+                    if conf < conf_thresh:
+                        continue
+                    cx = (sig(tx) * scale_x_y - bias + j) / W
+                    cy = (sig(ty) * scale_x_y - bias + i) / H
+                    bw = np.exp(tw) * an[a, 0] / (downsample * W)
+                    bh = np.exp(th) * an[a, 1] / (downsample * H)
+                    x1 = (cx - bw / 2) * iw
+                    y1 = (cy - bh / 2) * ih
+                    x2 = (cx + bw / 2) * iw
+                    y2 = (cy + bh / 2) * ih
+                    if clip_bbox:
+                        x1, y1 = max(x1, 0), max(y1, 0)
+                        x2 = min(x2, iw - 1)
+                        y2 = min(y2, ih - 1)
+                    boxes[n, a, i, j] = [x1, y1, x2, y2]
+                    scores[n, a, i, j] = sig(p[n, a, 5:, i, j]) * conf
+    return (boxes.reshape(N, -1, 4),
+            scores.reshape(N, -1, class_num))
+
+
+def test_yolo_box_matches_reference():
+    from paddle_tpu.vision.ops import yolo_box
+    rng = np.random.RandomState(0)
+    N, A, cls, H, W = 2, 3, 4, 5, 6
+    x = rng.randn(N, A * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[320, 480], [416, 416]], np.int32)
+    boxes, scores = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                             anchors=[10, 13, 16, 30, 33, 23],
+                             class_num=cls, conf_thresh=0.3,
+                             downsample_ratio=32)
+    rb, rs = _yolo_box_ref(x, img, [10, 13, 16, 30, 33, 23], cls, 0.3,
+                           32)
+    np.testing.assert_allclose(boxes.numpy(), rb, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scores.numpy(), rs, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_box_scale_xy_no_clip():
+    from paddle_tpu.vision.ops import yolo_box
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2 * 6, 3, 3).astype(np.float32)
+    img = np.array([[100, 100]], np.int32)
+    boxes, scores = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                             anchors=[10, 13, 16, 30], class_num=1,
+                             conf_thresh=0.1, downsample_ratio=16,
+                             clip_bbox=False, scale_x_y=1.2)
+    rb, rs = _yolo_box_ref(x, img, [10, 13, 16, 30], 1, 0.1, 16,
+                           clip_bbox=False, scale_x_y=1.2)
+    np.testing.assert_allclose(boxes.numpy(), rb, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scores.numpy(), rs, rtol=1e-4, atol=1e-5)
