@@ -29,20 +29,113 @@ __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
            "LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "PipelineParallel", "ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy",
-           "get_rng_state_tracker"]
+           "get_rng_state_tracker", "PaddleCloudRoleMaker", "is_server",
+           "is_worker", "run_server", "init_worker", "stop_worker",
+           "server_num", "server_index", "ps_client"]
 
 _fleet_initialized = False
 _strategy: Optional[DistributedStrategy] = None
+_role_maker = None
+
+
+class PaddleCloudRoleMaker:
+    """Env-driven role maker (reference
+    ``fleet/base/role_maker.py::PaddleCloudRoleMaker``): PS mode reads
+    ``TRAINING_ROLE`` (TRAINER|PSERVER), ``PADDLE_PSERVERS_IP_PORT_LIST``,
+    ``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ID`` /
+    ``PADDLE_PSERVER_ID``."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self.role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self.server_endpoints = [e for e in eps.split(",") if e]
+        self.n_trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        self.trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self.server_id = int(os.environ.get("PADDLE_PSERVER_ID", 0))
+
+    def _is_server(self):
+        return self.role == "PSERVER"
+
+    def _server_num(self):
+        return max(len(self.server_endpoints), 1)
 
 
 def init(role_maker=None, is_collective=False, strategy=None, log_level=2):
-    global _fleet_initialized, _strategy
+    global _fleet_initialized, _strategy, _role_maker
     _strategy = strategy or DistributedStrategy()
-    _env.init_parallel_env()
-    hcg = HybridCommunicateGroup(strategy=_strategy)
-    set_hcg(hcg)
+    _role_maker = role_maker
+    ps_mode = (role_maker is not None
+               and getattr(role_maker, "server_endpoints", None)
+               and not getattr(role_maker, "_is_collective", False))
+    if not ps_mode:
+        # collective mode: build the hybrid device mesh
+        _env.init_parallel_env()
+        hcg = HybridCommunicateGroup(strategy=_strategy)
+        set_hcg(hcg)
     _fleet_initialized = True
     return None
+
+
+# -- parameter-server role flow (reference fleet PS mode) ----------------
+
+def is_server():
+    return _role_maker is not None and _role_maker._is_server()
+
+
+def is_worker():
+    return _role_maker is None or not _role_maker._is_server()
+
+
+def server_num():
+    return _role_maker._server_num() if _role_maker else 0
+
+
+def server_index():
+    return _role_maker.server_id if _role_maker else 0
+
+
+def _ps_world():
+    n_s = _role_maker._server_num()
+    return n_s, n_s + _role_maker.n_trainers
+
+
+def run_server(drain_timeout=86400):
+    """Host PS tables in this process and genuinely BLOCK until every
+    trainer announces shutdown (reference ``fleet.run_server()``) —
+    ``drain_timeout`` (default 24h) bounds the wait so a wedged job
+    still terminates."""
+    from ..ps import run_server as _run
+    from .. import rpc
+    n_s, world = _ps_world()
+    _run(f"ps{_role_maker.server_id}", rank=_role_maker.server_id,
+         world_size=world)
+    rpc.shutdown(timeout=drain_timeout)
+
+
+def init_worker():
+    """Join the PS world as a trainer; returns the PSClient."""
+    from .. import rpc
+    from ..ps import PSClient
+    n_s, world = _ps_world()
+    rpc.init_rpc(f"trainer{_role_maker.trainer_id}",
+                 rank=n_s + _role_maker.trainer_id, world_size=world)
+    global _ps_client
+    _ps_client = PSClient([f"ps{i}" for i in range(n_s)])
+    return _ps_client
+
+
+_ps_client = None
+
+
+def ps_client():
+    return _ps_client
+
+
+def stop_worker():
+    from .. import rpc
+    rpc.shutdown()
 
 
 def is_initialized():

@@ -236,10 +236,11 @@ def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
     h = (jax.nn.silu(g.astype(jnp.float32)).astype(xs.dtype) * u)
     ys = jax.lax.ragged_dot(h, down.astype(xs.dtype), group_sizes)
 
-    # unsort back to (token, slot) order and combine
-    y_sorted = jnp.zeros_like(ys)
-    y_sorted = y_sorted.at[order].set(ys)
-    picked = y_sorted.reshape(s, top_k, -1)                 # [s, k, d]
+    # unsort back to (token, slot) order and combine — inverse-permute
+    # by GATHER (argsort of the sort order), not scatter: TPU gathers
+    # are cheaper than .at[].set scatters
+    inv = jnp.argsort(order)
+    picked = ys[inv].reshape(s, top_k, -1)                  # [s, k, d]
 
     if normalize_gates:
         gates = topk_prob / jnp.maximum(

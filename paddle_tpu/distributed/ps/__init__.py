@@ -23,6 +23,7 @@ Pieces:
 """
 from __future__ import annotations
 
+import builtins
 import threading
 from typing import Dict, List, Optional
 
@@ -227,7 +228,10 @@ class PSClient:
                   np.asarray(grad))
 
     def stat(self, name) -> dict:
-        return self._rpc(self.servers[0], _ps_stat, name)
+        stats = [self._rpc(s, _ps_stat, name) for s in self.servers]
+        if "n_rows" in stats[0]:
+            return {"n_rows": builtins.sum(s["n_rows"] for s in stats)}
+        return stats[0]
 
 
 class DistributedEmbedding:
@@ -257,8 +261,12 @@ class DistributedEmbedding:
         uniq, inverse = np.unique(flat, return_inverse=True)
         rows = self.client.pull_sparse(self.name, uniq)
         local = Tensor(jnp.asarray(rows))
-        local.stop_gradient = False
-        self._pending.append((uniq, local))
+        from ...framework.core import is_grad_enabled
+        if is_grad_enabled():
+            # training: remember the pulled rows until push_grads();
+            # eval/no_grad pulls are not recorded (unbounded growth)
+            local.stop_gradient = False
+            self._pending.append((uniq, local))
         from ...ops.manipulation import gather, reshape
         out = gather(local, Tensor(jnp.asarray(inverse)))
         return reshape(out, list(ids_np.shape) + [self.dim])

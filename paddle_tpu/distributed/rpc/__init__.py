@@ -203,11 +203,15 @@ def get_all_worker_infos():
     return list(_state["infos"].values())
 
 
-def shutdown():
+def shutdown(timeout=60):
     """Graceful two-phase barrier then stop (reference: shutdown
     synchronizes). Workers announce, the master (who HOSTS the store)
     waits for every announcement, publishes the all-clear, and only
-    then tears the store down — so no peer polls a dead store."""
+    then tears the store down — so no peer polls a dead store.
+
+    ``timeout`` bounds the per-peer wait; long-lived servers (fleet PS
+    ``run_server``) pass a large value so they genuinely block until
+    the trainers drain instead of tearing down mid-training."""
     if not _state:
         return
     import time
@@ -217,11 +221,11 @@ def shutdown():
     try:
         if rank == 0:
             for r in range(_state["world_size"]):
-                store.wait(f"rpc/shutdown/{r}", timeout=60)
+                store.wait(f"rpc/shutdown/{r}", timeout=timeout)
             store.set("rpc/shutdown/all", "1")
             time.sleep(0.3)  # let peers read the all-clear
         else:
-            store.wait("rpc/shutdown/all", timeout=60)
+            store.wait("rpc/shutdown/all", timeout=timeout)
     except Exception:
         pass  # a vanished peer/store must not block teardown
     _state["server"].close()

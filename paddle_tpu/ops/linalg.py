@@ -391,13 +391,23 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
     return apply_jax("ormqr", f, _wrap_out(qa), y)
 
 
+def _minkowski(diff, p):
+    """Shared distance kernel for cdist/pdist. The +1e-30 inside the
+    p=2 sqrt keeps gradients finite at coincident points
+    (d/dx sqrt(0) = NaN otherwise)."""
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    if p == 1.0:
+        return jnp.sum(jnp.abs(diff), axis=-1)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
           name=None):
     def f(a, b):
-        diff = a[..., :, None, :] - b[..., None, :, :]
-        if p == 2.0:
-            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
-        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+        return _minkowski(a[..., :, None, :] - b[..., None, :, :], p)
     return apply_jax("cdist", f, x, y)
 
 
@@ -406,18 +416,7 @@ def pdist(x, p=2.0, name=None):
     2-D tensor — the upper triangle of cdist(x, x), row-major."""
     def f(a):
         n = a.shape[0]
-        diff = a[:, None, :] - a[None, :, :]
-        if p == 2.0:
-            # +1e-30 inside the sqrt (same guard as cdist above):
-            # duplicate rows would otherwise give d/dx sqrt(0) = NaN
-            d = jnp.sqrt(jnp.sum(diff * diff, -1) + 1e-30)
-        elif p == 1.0:
-            d = jnp.sum(jnp.abs(diff), -1)
-        elif p == float("inf"):
-            d = jnp.max(jnp.abs(diff), -1)
-        else:
-            d = jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), -1),
-                          1.0 / p)
+        d = _minkowski(a[:, None, :] - a[None, :, :], p)
         iu = jnp.triu_indices(n, k=1)
         return d[iu]
     return apply_jax("pdist", f, x)

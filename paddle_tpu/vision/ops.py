@@ -217,12 +217,15 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
     def f(pred, imgs):
         N, C, H, W = pred.shape
-        attrs = C // an_num - (1 if iou_aware else 0)
-        # [N, A, attrs(+iou), H, W]
-        p = pred.reshape(N, an_num, C // an_num, H, W)
         if iou_aware:
-            iou = jax.nn.sigmoid(p[:, :, 0])           # [N, A, H, W]
-            p = p[:, :, 1:]
+            # reference layout (PPYOLO head): the A iou channels come
+            # FIRST, then the A*(5+cls) conv channels — not interleaved
+            iou = jax.nn.sigmoid(
+                pred[:, :an_num].reshape(N, an_num, H, W))
+            pred = pred[:, an_num:]
+            C = C - an_num
+        attrs = C // an_num
+        p = pred.reshape(N, an_num, attrs, H, W)
         assert attrs == 5 + class_num, (attrs, class_num)
         tx, ty, tw, th = p[:, :, 0], p[:, :, 1], p[:, :, 2], p[:, :, 3]
         obj = jax.nn.sigmoid(p[:, :, 4])

@@ -61,7 +61,7 @@ from paddle_tpu.distributed.ps import (DistributedEmbedding, PSClient,
 
 if rank == 0:                        # the PS server
     run_server('ps0')
-    rpc.shutdown()                   # serves until the world drains
+    rpc.shutdown(timeout=600)        # serves until the world drains
 else:                                # async workers
     rpc.init_rpc(f'trainer{rank}')
     import paddle_tpu as paddle
@@ -115,3 +115,66 @@ else:                                # async workers
     assert all(p.returncode == 0 for p in procs), outs
     assert "PS-OK rank=1" in outs[1], outs[1]
     assert "PS-OK rank=2" in outs[2], outs[2]
+
+
+@pytest.mark.slow
+def test_fleet_ps_role_flow(tmp_path):
+    """The reference's fleet PS user flow: PaddleCloudRoleMaker from
+    env, fleet.run_server() on PSERVER nodes, fleet.init_worker() on
+    trainers, DistributedEmbedding training through the ps_client."""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    port = _free_port()
+    script = tmp_path / "fleet_node.py"
+    script.write_text("""
+import os
+import numpy as np
+import paddle_tpu.distributed.fleet as fleet
+
+rm = fleet.PaddleCloudRoleMaker()
+fleet.init(role_maker=rm)
+if fleet.is_server():
+    fleet.run_server()
+else:
+    client = fleet.init_worker()
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.ps import DistributedEmbedding
+    emb = DistributedEmbedding(client, 'emb', dim=4, lr=0.5)
+    score = np.random.RandomState(0).randn(32).astype(np.float32)
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(20):
+        ids = rng.randint(0, 32, (8, 2))
+        y = paddle.to_tensor((score[ids].sum(1) > 0)
+                             .astype(np.float32))
+        e = emb(paddle.to_tensor(ids.astype(np.int64)))
+        logit = e.sum(axis=[1, 2])
+        loss = paddle.nn.functional \\
+            .binary_cross_entropy_with_logits(logit, y)
+        loss.backward()
+        emb.push_grads()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+    print(f'FLEET-PS-OK {losses[0]:.4f}->{losses[-1]:.4f}')
+    fleet.stop_worker()
+""")
+    specs = [("PSERVER", {"PADDLE_PSERVER_ID": "0"}),
+             ("TRAINER", {"PADDLE_TRAINER_ID": "0"})]
+    procs = []
+    for role, extra in specs:
+        env = dict(os.environ)
+        env.update({"TRAINING_ROLE": role,
+                    "PADDLE_PSERVERS_IP_PORT_LIST": "127.0.0.1:0",
+                    "PADDLE_TRAINERS_NUM": "1",
+                    "PADDLE_MASTER": f"127.0.0.1:{port}",
+                    "JAX_PLATFORMS": "cpu",
+                    "PYTHONPATH": repo_root, **extra})
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    assert all(p.returncode == 0 for p in procs), outs
+    assert "FLEET-PS-OK" in outs[1], outs[1]

@@ -132,3 +132,43 @@ def test_yolo_box_scale_xy_no_clip():
                            clip_bbox=False, scale_x_y=1.2)
     np.testing.assert_allclose(boxes.numpy(), rb, rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(scores.numpy(), rs, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_box_iou_aware_layout():
+    """iou_aware: the A iou channels come FIRST (PPYOLO layout), then
+    the A*(5+cls) conv channels; conf = obj^(1-f) * iou^f."""
+    from paddle_tpu.vision.ops import yolo_box
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    rng = np.random.RandomState(2)
+    N, A, cls, H, W = 1, 2, 3, 2, 2
+    f_factor = 0.4
+    ioup = rng.randn(N, A, H, W).astype(np.float32)
+    conv = rng.randn(N, A * (5 + cls), H, W).astype(np.float32)
+    x = np.concatenate([ioup.reshape(N, A, H, W), conv], axis=1)
+    img = np.array([[64, 64]], np.int32)
+    anchors = [10, 13, 16, 30]
+    boxes, scores = yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img), anchors=anchors,
+        class_num=cls, conf_thresh=0.0, downsample_ratio=32,
+        iou_aware=True, iou_aware_factor=f_factor)
+    # oracle: decode anchor a, cell (i,j) by hand from the conv block
+    p = conv.reshape(N, A, 5 + cls, H, W)
+    for a in range(A):
+        for i in range(H):
+            for j in range(W):
+                obj = sig(p[0, a, 4, i, j])
+                conf = obj ** (1 - f_factor) * \
+                    sig(ioup[0, a, i, j]) ** f_factor
+                want_scores = sig(p[0, a, 5:, i, j]) * conf
+                flat = a * H * W + i * W + j
+                np.testing.assert_allclose(scores.numpy()[0, flat],
+                                           want_scores, rtol=1e-4,
+                                           atol=1e-5)
+                cx = (sig(p[0, a, 0, i, j]) + j) / W
+                bw = np.exp(p[0, a, 2, i, j]) * anchors[2 * a] / (32 * W)
+                x1 = max((cx - bw / 2) * 64, 0)
+                np.testing.assert_allclose(boxes.numpy()[0, flat, 0],
+                                           x1, rtol=1e-4, atol=1e-4)
