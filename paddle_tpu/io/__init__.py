@@ -29,7 +29,8 @@ __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "BatchSampler", "Sampler", "SequenceSampler", "RandomSampler",
-    "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
+    "SubsetRandomSampler", "WeightedRandomSampler",
+    "DistributedBatchSampler", "DataLoader",
     "get_worker_info", "default_collate_fn",
 ]
 
@@ -169,6 +170,22 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self.num_samples
+
+
+class SubsetRandomSampler(Sampler):
+    """``paddle.io.SubsetRandomSampler``: random order over a fixed
+    index subset."""
+
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        return iter(np.random.permutation(
+            np.asarray(self.indices)).tolist())
+
+    def __len__(self):
+        return len(self.indices)
 
 
 class WeightedRandomSampler(Sampler):

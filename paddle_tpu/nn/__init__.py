@@ -21,6 +21,7 @@ from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose,
                          Conv3D, Conv3DTranspose)
 from .layer.layers import Layer
 from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
+                         AdaptiveLogSoftmaxWithLoss,
                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
                          HingeEmbeddingLoss, KLDivLoss,
                          L1Loss, MarginRankingLoss, MSELoss,

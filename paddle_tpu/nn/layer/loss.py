@@ -282,3 +282,74 @@ class CTCLoss(Layer):
             return nll
         return apply_jax("ctc_loss", f, logits, labels, input_lengths,
                          label_lengths)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """``paddle.nn.AdaptiveLogSoftmaxWithLoss`` (efficient softmax
+    approximation): frequent classes in a head shortlist, the rest in
+    per-cluster tails with ``div_value``-shrinking projections.
+
+    TPU note: log-probs are computed per cluster and concatenated (the
+    head/tail structure — the parameter savings — is preserved; the
+    [N, n_classes] log-prob materialization is fine at the class counts
+    adaptive softmax targets on-chip)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .common import Linear
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError(
+                "cutoffs must be a sorted list of unique ints in "
+                f"(0, n_classes - 1], got {cutoffs}")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=head_bias or False)
+        from .container import LayerList, Sequential
+        self.tail = LayerList()
+        for i in range(self.n_clusters):
+            hsz = max(int(in_features // (div_value ** (i + 1))), 1)
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            self.tail.append(Sequential(
+                Linear(in_features, hsz, bias_attr=False),
+                Linear(hsz, osz, bias_attr=False)))
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities."""
+        import jax
+        head = self.head(input)
+        tails = [t(input) for t in self.tail]
+
+        def f(h, *ts):
+            head_lp = jax.nn.log_softmax(h.astype(jnp.float32), -1)
+            parts = [head_lp[:, : self.shortlist_size]]
+            for i, t in enumerate(ts):
+                tail_lp = jax.nn.log_softmax(t.astype(jnp.float32), -1)
+                parts.append(tail_lp
+                             + head_lp[:, self.shortlist_size + i:
+                                       self.shortlist_size + i + 1])
+            return jnp.concatenate(parts, axis=-1)
+        return apply_jax("adaptive_log_softmax", f, head, *tails)
+
+    def forward(self, input, label):
+        lp = self.log_prob(input)
+
+        def f(full, lb):
+            picked = jnp.take_along_axis(
+                full, lb.astype(jnp.int32)[:, None], axis=-1)[:, 0]
+            return picked, -jnp.mean(picked)
+        out, loss = apply_jax("adaptive_nll", f, lp, label, n_outputs=2)
+        return out, loss
+
+    def predict(self, input):
+        from ...ops.search import argmax
+        return argmax(self.log_prob(input), axis=-1)

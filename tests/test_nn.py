@@ -472,3 +472,48 @@ def test_margin_cross_entropy_reduces_to_ce_without_margins():
                            paddle.to_tensor(y))
     np.testing.assert_allclose(float(got.numpy()),
                                float(want.numpy()), rtol=1e-4)
+
+
+def test_adaptive_log_softmax_with_loss():
+    """Full log-prob normalization + head/tail routing + trainability."""
+    paddle.seed(0)
+    m = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 12],
+                                      div_value=2.0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 20, (8,)).astype(np.int64))
+    lp = m.log_prob(x)
+    assert lp.shape == [8, 20]
+    # rows are proper log-distributions
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1),
+                               np.ones(8), rtol=1e-5)
+    out, loss = m(x, y)
+    np.testing.assert_allclose(
+        out.numpy(),
+        np.take_along_axis(lp.numpy(), y.numpy()[:, None], -1)[:, 0],
+        rtol=1e-5)
+    np.testing.assert_allclose(loss.numpy(), -out.numpy().mean(),
+                               rtol=1e-5)
+    pred = m.predict(x)
+    np.testing.assert_array_equal(pred.numpy(),
+                                  lp.numpy().argmax(-1))
+    # trains: NLL on a fixed batch decreases
+    opt = paddle.optimizer.Adam(1e-2, parameters=m.parameters())
+    losses = []
+    for _ in range(25):
+        _, l = m(x, y)
+        opt.clear_grad()
+        l.backward()
+        opt.step()
+        losses.append(float(l.numpy()))
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError):
+        nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[5, 5])
+
+
+def test_subset_random_sampler():
+    from paddle_tpu.io import SubsetRandomSampler
+    s = SubsetRandomSampler([3, 7, 11, 2])
+    got = sorted(list(iter(s)))
+    assert got == [2, 3, 7, 11] and len(s) == 4
