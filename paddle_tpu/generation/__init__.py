@@ -98,27 +98,47 @@ class GenerationMixin:
         return strategy == "sampling"
 
     def _build_model_step(self, binder, buffers):
-        def model_step(params_a, tok_ids, caches, off):
+        def model_step(params_a, tok_ids, caches, off, mask=None,
+                      pos=None):
             t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
+            kwargs = {"caches": t_caches, "offset": _wrap_out(off)}
+            if mask is not None:
+                kwargs["attention_mask"] = _wrap_out(mask)
+            if pos is not None:
+                kwargs["position_ids"] = _wrap_out(pos)
             out, _ = binder.call(
-                params_a, buffers, (_wrap_out(tok_ids),),
-                {"caches": t_caches, "offset": _wrap_out(off)})
+                params_a, buffers, (_wrap_out(tok_ids),), kwargs)
             logits, new_caches = out
             return as_jax(logits), [(as_jax(k), as_jax(v))
                                     for k, v in new_caches]
         return model_step
 
     def _build_run(self, binder, buffers, b, prompt_len, max_new,
-                   select, eos, pad, with_scores):
-        """run(params, ids, key) -> out ids [, scores]: prefill + one
-        lax.while_loop with in-loop EOS early exit."""
+                   select, eos, pad, with_scores, with_mask=False):
+        """run(params, ids[, mask], key) -> out ids [, scores]: prefill
+        + one lax.while_loop with in-loop EOS early exit. With
+        ``with_mask`` (LEFT-padded batches): the [B, prompt] pad mask
+        masks pad cache slots and re-bases each row's rope positions at
+        its first real token (reference: PaddleNLP padded generation)."""
 
         model_step = self._build_model_step(binder, buffers)
 
-        def run(params_a, ids_a, key):
+        def run(params_a, ids_a, *rest):
+            if with_mask:
+                pad_mask, key = rest
+                pad_mask = pad_mask.astype(jnp.int32)
+                full_mask = jnp.concatenate(
+                    [pad_mask, jnp.ones((b, max_new), jnp.int32)], 1)
+                n_real = jnp.sum(pad_mask, axis=1)          # [B]
+                pos0 = jnp.maximum(
+                    jnp.cumsum(pad_mask, axis=1) - 1, 0)    # [B, prompt]
+            else:
+                (key,) = rest
+                full_mask, pos0, n_real = None, None, None
             caches = self.init_caches(b, prompt_len + max_new)
             logits, caches = model_step(params_a, ids_a, caches,
-                                        jnp.zeros((), jnp.int32))
+                                        jnp.zeros((), jnp.int32),
+                                        mask=full_mask, pos=pos0)
             key, sub = jax.random.split(key)
             tok, logp = select(logits[:, -1, :], sub)
             done = tok == eos
@@ -132,8 +152,11 @@ class GenerationMixin:
             def body(c):
                 i, tok, caches, out, done, score, key = c
                 off = jnp.asarray(prompt_len - 1, jnp.int32) + i
+                pos_i = None if not with_mask else \
+                    (n_real + i - 1)[:, None].astype(jnp.int32)
                 logits, caches = model_step(params_a, tok[:, None],
-                                            caches, off)
+                                            caches, off,
+                                            mask=full_mask, pos=pos_i)
                 key, sub = jax.random.split(key)
                 ntok, logp = select(logits[:, -1, :], sub)
                 ntok = jnp.where(done, jnp.int32(pad), ntok)
@@ -157,7 +180,8 @@ class GenerationMixin:
                  top_p=None, num_beams=None, num_beam_groups=None,
                  diversity_rate=None, length_penalty=None,
                  early_stopping=None, eos_token_id=None,
-                 pad_token_id=None, seed=None, **kwargs):
+                 pad_token_id=None, seed=None, attention_mask=None,
+                 **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
         log-probability of the chosen tokens per sequence (for beam
@@ -212,6 +236,37 @@ class GenerationMixin:
         buffers = binder.buffer_arrays()
 
         is_beam = strategy in ("beam_search", "group_beam_search")
+        if attention_mask is not None:
+            if is_beam:
+                raise NotImplementedError(
+                    "beam search with left-padded prompts "
+                    "(attention_mask) — pad to equal length instead")
+            import inspect
+            params_sig = inspect.signature(type(self).forward).parameters
+            if "position_ids" not in params_sig or \
+                    "attention_mask" not in params_sig:
+                raise NotImplementedError(
+                    f"{type(self).__name__} does not support "
+                    "left-padded generation (its forward lacks "
+                    "attention_mask/position_ids kwargs)")
+            mask_np = np.asarray(
+                attention_mask.numpy()
+                if hasattr(attention_mask, "numpy") else attention_mask)
+            ids_shape = tuple(as_jax(input_ids).shape)
+            if ids_shape and mask_np.ndim == 1:
+                mask_np = mask_np[None]
+            if tuple(mask_np.shape) != ids_shape:
+                raise ValueError(
+                    f"attention_mask shape {tuple(mask_np.shape)} must "
+                    f"match input_ids shape {ids_shape}")
+            if (np.diff(mask_np, axis=1) < 0).any() or \
+                    (mask_np[:, -1] != 1).any():
+                # right padding would put pad-token queries at the
+                # position the decode loop reads logits from — silently
+                # wrong continuations, so reject loudly
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (each row: 0s "
+                    "then 1s, last column 1)")
         # inapplicable-option guard (same policy as the unknown-kwargs
         # guard above: dropping a requested option silently is worse
         # than failing)
@@ -249,9 +304,11 @@ class GenerationMixin:
                 lg, k, do_sample=do_sample, temperature=temperature,
                 top_k=top_k, top_p=top_p)
             run = self._build_run(binder, buffers, b, prompt_len, max_new,
-                                  select, eos, pad, with_scores=True)
+                                  select, eos, pad, with_scores=True,
+                                  with_mask=attention_mask is not None)
             jit_key = (b, prompt_len, max_new, do_sample, temperature,
-                       top_k, top_p, eos, pad)
+                       top_k, top_p, eos, pad,
+                       attention_mask is not None)
 
         if not hasattr(self, "_generate_jit_cache"):
             self._generate_jit_cache = {}
@@ -259,7 +316,12 @@ class GenerationMixin:
         if jitted is None:
             jitted = jax.jit(run)
             self._generate_jit_cache[jit_key] = jitted
-        out, score = jitted(params, ids, jax.random.PRNGKey(seed))
+        if attention_mask is not None:
+            mask_arr = as_jax(attention_mask).astype(jnp.int32)
+            out, score = jitted(params, ids, mask_arr,
+                                jax.random.PRNGKey(seed))
+        else:
+            out, score = jitted(params, ids, jax.random.PRNGKey(seed))
         return (_wrap_out(out.astype(jnp.int64)),
                 _wrap_out(score))
 

@@ -129,13 +129,15 @@ class DeepseekMoeDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None, kv_cache=None, offset=None):
+                attention_mask=None, kv_cache=None, offset=None,
+                position_ids=None):
         h = self.input_layernorm(hidden_states)
         new_cache = None
         if kv_cache is not None:
             a, new_cache = self.self_attn(h, rope_cos, rope_sin,
                                           attention_mask, kv_cache,
-                                          offset)
+                                          offset,
+                                          position_ids=position_ids)
         else:
             a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
         h = hidden_states + a
@@ -169,7 +171,7 @@ class DeepseekMoeModel(Layer):
         self._rope_sin = Tensor(sin)
 
     def forward(self, input_ids, attention_mask=None, caches=None,
-                offset=None):
+                offset=None, position_ids=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
         if caches is not None:
@@ -177,7 +179,8 @@ class DeepseekMoeModel(Layer):
             for layer, kv in zip(self.layers, caches):
                 h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
                                      attention_mask, kv_cache=kv,
-                                     offset=offset)
+                                     offset=offset,
+                                     position_ids=position_ids)
                 new_caches.append(kv2)
             return self.norm(h), None, new_caches
         l = h.shape[1]
@@ -227,10 +230,11 @@ class DeepseekMoeForCausalLM(Layer, GenerationMixin):
         ]
 
     def forward(self, input_ids, labels=None, attention_mask=None,
-                caches=None, offset=None):
+                caches=None, offset=None, position_ids=None):
         if caches is not None:
             h, _, new_caches = self.deepseek(input_ids, attention_mask,
-                                             caches=caches, offset=offset)
+                                             caches=caches, offset=offset,
+                                             position_ids=position_ids)
             return self._logits(h), new_caches
         h, aux_total = self.deepseek(input_ids, attention_mask)
         logits = self._logits(h)

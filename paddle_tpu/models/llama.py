@@ -142,17 +142,30 @@ def cached_attention(qh, kh, vh, kc, vc, off, head_dim,
     return out.reshape(b, l, h, d), kc2, vc2
 
 
-def _apply_rope(x, cos, sin):
-    # x: [B, L, H, D]; neox style halves. Tables stay fp32 for precision;
-    # output is cast back so bf16 activations remain bf16.
+def _rope_rotate(x, c, s):
+    """Shared neox-halves rotation; c/s arrive pre-broadcast against
+    [B, L, H, D/2]. Tables stay fp32 for precision; output is cast back
+    so bf16 activations remain bf16."""
     d = x.shape[-1]
     xf = x.astype(jnp.float32)
     x1 = xf[..., : d // 2]
     x2 = xf[..., d // 2:]
-    c = cos[None, :, None, :].astype(jnp.float32)
-    s = sin[None, :, None, :].astype(jnp.float32)
+    c = c.astype(jnp.float32)
+    s = s.astype(jnp.float32)
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
+
+
+def _apply_rope(x, cos, sin):
+    # x: [B, L, H, D]; cos/sin: [L, D/2] (shared positions)
+    return _rope_rotate(x, cos[None, :, None, :], sin[None, :, None, :])
+
+
+def _apply_rope_rows(x, cos, sin):
+    """Rope with PER-ROW position tables (left-padded batches: each row
+    starts counting positions at its first real token). x: [B, L, H, D];
+    cos/sin: [B, L, D/2]."""
+    return _rope_rotate(x, cos[:, :, None, :], sin[:, :, None, :])
 
 
 class LlamaAttention(Layer):
@@ -183,19 +196,21 @@ class LlamaAttention(Layer):
             has_bias=False, input_is_parallel=True)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None, kv_cache=None, offset=None):
+                attention_mask=None, kv_cache=None, offset=None,
+                position_ids=None):
         b, l, _ = hidden_states.shape
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
 
         if kv_cache is not None:
-            if attention_mask is not None:
-                raise NotImplementedError(
-                    "KV-cache decode does not support attention_mask "
-                    "(padded batches); generate prompts of equal length")
+            # attention_mask here is the [B, S] cache-length pad mask
+            # (left-padded batches); position_ids [B, L] give each row
+            # its own rope positions
             return self._forward_cached(q, k, v, rope_cos, rope_sin,
-                                        kv_cache, offset, b, l)
+                                        kv_cache, offset, b, l,
+                                        attention_mask=attention_mask,
+                                        position_ids=position_ids)
 
         def attn(q_a, k_a, v_a, cos, sin):
             qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
@@ -226,32 +241,55 @@ class LlamaAttention(Layer):
         return self.o_proj(ctx)
 
     def _forward_cached(self, q, k, v, rope_cos, rope_sin, kv_cache,
-                        offset, b, l):
+                        offset, b, l, attention_mask=None,
+                        position_ids=None):
         """Incremental-decode attention: write this chunk's K/V into the
         static-shape cache at ``offset`` and attend against the full
         cache under a causal-with-offset mask (KV-cache decode path —
         reference: PaddleNLP generation with ``cache_kvs``). rope tables
         arrive un-sliced; ``offset`` is a traced int32 scalar so one
-        compiled program serves every decode step."""
+        compiled program serves every decode step. Left-padded batches:
+        ``attention_mask`` [B, S] masks pad cache slots and
+        ``position_ids`` [B, L] give per-row rope positions."""
+        with_rows = position_ids is not None
+        with_mask = attention_mask is not None
 
-        def attn_c(q_a, k_a, v_a, cos_t, sin_t, kc, vc, off):
+        def attn_c(q_a, k_a, v_a, cos_t, sin_t, kc, vc, off, *rest):
             qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
             kh = k_a.reshape(b, l, self.num_kv_heads, self.head_dim)
             vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
             off32 = off.astype(jnp.int32) if hasattr(off, "astype") \
                 else off
-            cos = jax.lax.dynamic_slice_in_dim(cos_t, off32, l, 0)
-            sin = jax.lax.dynamic_slice_in_dim(sin_t, off32, l, 0)
-            qh = _apply_rope(qh, cos, sin)
-            kh = _apply_rope(kh, cos, sin)
+            rest = list(rest)
+            if with_rows:
+                pos = rest.pop(0).astype(jnp.int32)     # [B, L]
+                cos = cos_t[pos]                        # [B, L, D/2]
+                sin = sin_t[pos]
+                qh = _apply_rope_rows(qh, cos, sin)
+                kh = _apply_rope_rows(kh, cos, sin)
+            else:
+                cos = jax.lax.dynamic_slice_in_dim(cos_t, off32, l, 0)
+                sin = jax.lax.dynamic_slice_in_dim(sin_t, off32, l, 0)
+                qh = _apply_rope(qh, cos, sin)
+                kh = _apply_rope(kh, cos, sin)
+            extra = None
+            if with_mask:
+                m = rest.pop(0)                         # [B, S]
+                extra = jnp.where(m > 0, 0.0, -1e9)[:, None, None, :]
             out, kc2, vc2 = cached_attention(qh, kh, vh, kc, vc, off32,
-                                             self.head_dim)
+                                             self.head_dim,
+                                             extra_bias=extra)
             return (out.reshape(b, l, self.num_heads * self.head_dim),
                     kc2, vc2)
 
+        extras = []
+        if with_rows:
+            extras.append(position_ids)
+        if with_mask:
+            extras.append(attention_mask)
         ctx, kc2, vc2 = apply_jax(
             "llama_attention_cached", attn_c, q, k, v, rope_cos, rope_sin,
-            kv_cache[0], kv_cache[1], offset, n_outputs=3)
+            kv_cache[0], kv_cache[1], offset, *extras, n_outputs=3)
         ctx = constraint(ctx, None, None, "mp")
         return self.o_proj(ctx), (kc2, vc2)
 
@@ -285,13 +323,15 @@ class LlamaDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None, kv_cache=None, offset=None):
+                attention_mask=None, kv_cache=None, offset=None,
+                position_ids=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         new_cache = None
         if kv_cache is not None:
             h, new_cache = self.self_attn(h, rope_cos, rope_sin,
-                                          attention_mask, kv_cache, offset)
+                                          attention_mask, kv_cache, offset,
+                                          position_ids=position_ids)
         else:
             h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
             # tag for the "save_attn" selective remat policy: keep the
@@ -336,7 +376,8 @@ class LlamaModel(Layer):
             new_caches = []
             for layer, kv in zip(self.layers, caches):
                 h, kv2 = layer(h, cos, sin, attention_mask,
-                               kv_cache=kv, offset=offset)
+                               kv_cache=kv, offset=offset,
+                               position_ids=position_ids)
                 new_caches.append(kv2)
             return self.norm(h), new_caches
         l = h.shape[1]

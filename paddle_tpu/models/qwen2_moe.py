@@ -219,7 +219,8 @@ class Qwen2MoeDecoderLayer(Layer):
                                                 config.rms_norm_eps)
 
     def forward(self, hidden_states, rope_cos, rope_sin,
-                attention_mask=None, kv_cache=None, offset=None):
+                attention_mask=None, kv_cache=None, offset=None,
+                position_ids=None):
         """Returns ``(h, aux_loss)`` uniformly (zero aux for dense
         layers) so the remat and non-remat paths carry the router loss
         identically; with ``kv_cache``, ``(h, aux_loss, new_cache)``."""
@@ -228,7 +229,8 @@ class Qwen2MoeDecoderLayer(Layer):
         if kv_cache is not None:
             a, new_cache = self.self_attn(h, rope_cos, rope_sin,
                                           attention_mask, kv_cache,
-                                          offset)
+                                          offset,
+                                          position_ids=position_ids)
         else:
             a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
         h = hidden_states + a
@@ -261,7 +263,7 @@ class Qwen2MoeModel(Layer):
         self._rope_sin = Tensor(sin)
 
     def forward(self, input_ids, attention_mask=None, caches=None,
-                offset=None):
+                offset=None, position_ids=None):
         """Returns ``(h, total_aux_loss)``; with ``caches``,
         ``(h, total_aux_loss, new_caches)``."""
         input_ids = batch_shard(input_ids)
@@ -271,7 +273,8 @@ class Qwen2MoeModel(Layer):
             for layer, kv in zip(self.layers, caches):
                 h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
                                      attention_mask, kv_cache=kv,
-                                     offset=offset)
+                                     offset=offset,
+                                     position_ids=position_ids)
                 new_caches.append(kv2)
             return self.norm(h), None, new_caches
         l = h.shape[1]
@@ -320,10 +323,11 @@ class Qwen2MoeForCausalLM(Layer, GenerationMixin):
         ]
 
     def forward(self, input_ids, labels=None, attention_mask=None,
-                caches=None, offset=None):
+                caches=None, offset=None, position_ids=None):
         if caches is not None:
             h, _, new_caches = self.qwen2_moe(input_ids, attention_mask,
-                                              caches=caches, offset=offset)
+                                              caches=caches, offset=offset,
+                                              position_ids=position_ids)
             return self._logits(h), new_caches
         h, aux_total = self.qwen2_moe(input_ids, attention_mask)
         logits = self._logits(h)

@@ -132,14 +132,33 @@ def test_generate_rejects_overlong(llama_tiny):
         llama_tiny.generate(paddle.to_tensor(ids), max_new_tokens=8)
 
 
-def test_cached_decode_rejects_attention_mask(llama_tiny):
-    import jax.numpy as jnp
-    caches = llama_tiny.init_caches(1, 16)
-    ids = paddle.to_tensor(np.zeros((1, 4), np.int64))
-    mask = paddle.to_tensor(np.ones((1, 4), np.float32))
-    with pytest.raises(NotImplementedError, match="attention_mask"):
-        llama_tiny(ids, attention_mask=mask, caches=caches,
-                   offset=paddle.to_tensor(np.int32(0)))
+def test_left_padded_generate_matches_unpadded(llama_tiny):
+    """Left-padded batched decode (attention_mask + per-row rope
+    positions) must produce the SAME tokens as each prompt generated
+    alone unpadded (r4: the decode-with-mask gap closed)."""
+    rng = np.random.RandomState(3)
+    p_short = rng.randint(1, 128, (3,)).tolist()
+    p_long = rng.randint(1, 128, (5,)).tolist()
+    padded = np.asarray([[0, 0] + p_short, p_long], np.int64)
+    mask = np.asarray([[0, 0, 1, 1, 1], [1, 1, 1, 1, 1]], np.int64)
+    got, _ = llama_tiny.generate(
+        paddle.to_tensor(padded), max_new_tokens=6,
+        decode_strategy="greedy_search",
+        attention_mask=paddle.to_tensor(mask))
+    one_s, _ = llama_tiny.generate(
+        paddle.to_tensor(np.asarray([p_short], np.int64)),
+        max_new_tokens=6, decode_strategy="greedy_search")
+    one_l, _ = llama_tiny.generate(
+        paddle.to_tensor(np.asarray([p_long], np.int64)),
+        max_new_tokens=6, decode_strategy="greedy_search")
+    assert got.numpy()[0].tolist() == one_s.numpy()[0].tolist()
+    assert got.numpy()[1].tolist() == one_l.numpy()[0].tolist()
+    # beam + padding is a documented explicit gate
+    with pytest.raises(NotImplementedError, match="left-padded"):
+        llama_tiny.generate(paddle.to_tensor(padded), num_beams=2,
+                            decode_strategy="beam_search",
+                            max_new_tokens=2,
+                            attention_mask=paddle.to_tensor(mask))
 
 
 def test_export_generation_roundtrip(tmp_path, llama_tiny):
@@ -169,3 +188,49 @@ def test_export_generation_validates(tmp_path, llama_tiny):
             str(tmp_path / "y"), 1, 4, 4,
             generation_config=GenerationConfig(
                 decode_strategy="contrastive_search"))
+
+
+def test_left_padded_generate_validates_mask(llama_tiny):
+    ids = paddle.to_tensor(np.asarray([[1, 2, 3]], np.int64))
+    with pytest.raises(ValueError, match="LEFT-padded"):
+        llama_tiny.generate(ids, max_new_tokens=2,
+                            attention_mask=paddle.to_tensor(
+                                np.asarray([[1, 1, 0]], np.int64)))
+    with pytest.raises(ValueError, match="shape"):
+        llama_tiny.generate(ids, max_new_tokens=2,
+                            attention_mask=paddle.to_tensor(
+                                np.asarray([[1, 1]], np.int64)))
+
+
+def test_left_padded_generate_qwen2_moe():
+    """The MoE families share LlamaAttention — padded decode must work
+    (and match unpadded) there too."""
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+    paddle.seed(5)
+    cfg = Qwen2MoeConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                              kv_heads=2, moe_ffn=32, shared_ffn=64,
+                              experts=4, topk=2)
+    m = Qwen2MoeForCausalLM(cfg)
+    m.eval()
+    p_short = [7, 9]
+    p_long = [3, 5, 8, 11]
+    padded = np.asarray([[0, 0] + p_short, p_long], np.int64)
+    mask = np.asarray([[0, 0, 1, 1], [1, 1, 1, 1]], np.int64)
+    got, _ = m.generate(paddle.to_tensor(padded), max_new_tokens=5,
+                        decode_strategy="greedy_search",
+                        attention_mask=paddle.to_tensor(mask))
+    one, _ = m.generate(paddle.to_tensor(np.asarray([p_short], np.int64)),
+                        max_new_tokens=5, decode_strategy="greedy_search")
+    assert got.numpy()[0].tolist() == one.numpy()[0].tolist()
+
+
+def test_gpt_rejects_attention_mask_generate():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    m = GPTForCausalLM(GPTConfig.tiny(vocab=64, hidden=32, layers=1,
+                                      heads=2))
+    ids = paddle.to_tensor(np.asarray([[1, 2]], np.int64))
+    with pytest.raises(NotImplementedError, match="left-padded"):
+        m.generate(ids, max_new_tokens=2,
+                   attention_mask=paddle.to_tensor(
+                       np.asarray([[1, 1]], np.int64)))
