@@ -88,6 +88,13 @@ def test_vit_classification_script():
 
 
 @pytest.mark.slow
+def test_llm_serving_script():
+    acc, losses = _load("llm_serving").main(["--tiny", "--steps", "120"])
+    assert acc > 0.8
+    assert losses[-1] < losses[0] * 0.1
+
+
+@pytest.mark.slow
 def test_wgan_gp_script():
     d_losses, g_losses, margin = _load("wgan_gp").main(
         ["--tiny", "--steps", "40"])
