@@ -49,6 +49,7 @@ from .framework import errors
 # paddle.log math op with the logging module
 from .framework.log import get_logger, logger, vlog
 from . import profiler
+from . import regularizer
 from . import sparse
 from . import audio
 from . import quantization

@@ -1,6 +1,7 @@
 """``paddle.nn`` namespace (``python/paddle/nn/__init__.py`` parity)."""
 from . import functional
 from . import initializer
+from . import utils
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_, clip_grad_value_)
 from .layer.activation import (CELU, ELU, GELU, GLU, SELU, Hardshrink,

@@ -78,29 +78,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
-    params = [parameters] if isinstance(parameters, Tensor) else \
-        list(parameters)
-    grads = [p.grad for p in params if p.grad is not None]
-    if not grads:
-        return _wrap_out(jnp.zeros(()))
-    if norm_type == float("inf"):
-        total = jnp.max(jnp.stack(
-            [jnp.max(jnp.abs(as_jax(g))) for g in grads]))
-    else:
-        total = jnp.sum(jnp.stack(
-            [jnp.sum(jnp.abs(as_jax(g)) ** norm_type) for g in grads])
-        ) ** (1.0 / norm_type)
-    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
-    for p in params:
-        if p.grad is not None:
-            p._grad = _wrap_out(as_jax(p.grad) * scale)
-    return _wrap_out(total)
+    # single implementation lives in nn.utils (reference layout keeps
+    # both entry points)
+    from .utils import clip_grad_norm_ as _impl
+    return _impl(parameters, max_norm, norm_type, error_if_nonfinite)
 
 
 def clip_grad_value_(parameters, clip_value):
-    params = [parameters] if isinstance(parameters, Tensor) else \
-        list(parameters)
-    for p in params:
-        if p.grad is not None:
-            p._grad = _wrap_out(jnp.clip(as_jax(p.grad), -clip_value,
-                                         clip_value))
+    from .utils import clip_grad_value_ as _impl
+    return _impl(parameters, clip_value)

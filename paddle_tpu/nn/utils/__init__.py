@@ -1,0 +1,161 @@
+"""``paddle.nn.utils`` (reference ``python/paddle/nn/utils/``):
+weight/spectral-norm reparameterizations via pre-forward hooks, grad
+clipping helpers, and parameter<->vector flattening."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, as_jax, _wrap_out, apply_jax
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "clip_grad_value_",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as ``g * v / ||v||`` (per ``dim``
+    slice; ``dim=None`` uses the global norm). g and v become the
+    trainable parameters; the effective weight is recomputed in a
+    pre-forward hook — the reference's WeightNorm wrapper. May be
+    applied independently to several parameters of one layer."""
+    w = getattr(layer, name)
+    arr = as_jax(w)
+    if dim is None:
+        axes = None
+    else:
+        dim = dim % arr.ndim
+        axes = tuple(i for i in range(arr.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=True))
+    from ...framework.core import Parameter
+    setattr(layer, name + "_g", Parameter(norm))
+    setattr(layer, name + "_v", Parameter(arr))
+    # the original slot becomes a derived (hook-computed) attribute
+    del layer._parameters[name]
+
+    def _compute(lay, ipt=None):
+        def f(g_a, v_a):
+            n = jnp.sqrt(jnp.maximum(
+                jnp.sum(jnp.square(v_a), axis=axes, keepdims=True),
+                1e-24))
+            return g_a * v_a / n
+        object.__setattr__(lay, name, apply_jax("weight_norm", f,
+                                                getattr(lay, name + "_g"),
+                                                getattr(lay, name + "_v")))
+        return None
+
+    handle = layer.register_forward_pre_hook(_compute)
+    # name-keyed state: several reparameterized params per layer
+    hooks = layer.__dict__.setdefault("_weight_norm_hooks", {})
+    hooks[name] = (handle, _compute)
+    _compute(layer)   # materialize immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| (recomputed from the CURRENT g/v — optimizer
+    updates since the last forward are kept) back into a plain
+    parameter and drop the hook."""
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"{type(layer).__name__} has no weight_norm "
+                         f"on {name!r}")
+    handle, compute = hooks.pop(name)
+    handle.remove()
+    compute(layer)                      # fold the LATEST g/v values
+    from ...framework.core import Parameter
+    w = Parameter(as_jax(getattr(layer, name)))
+    for extra in (name + "_g", name + "_v"):
+        del layer._parameters[extra]
+    # purge the hook-computed instance attribute so it cannot shadow
+    # the restored Parameter
+    layer.__dict__.pop(name, None)
+    setattr(layer, name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide ``layer.<name>`` by its largest singular value (power
+    iteration) in a pre-forward hook (reference
+    ``nn.utils.spectral_norm`` over the SpectralNorm layer). The
+    power-iteration u/v live OUTSIDE the layer's parameter/state_dict
+    namespace (the reference persists u as a buffer; here it is
+    process-local state, re-estimated after a reload)."""
+    from ..layer.norm import SpectralNorm
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = SpectralNorm(list(w.shape), dim=dim,
+                      power_iters=n_power_iterations, epsilon=eps)
+    # plain-dict storage: NOT a sublayer, so u/v never leak into
+    # named_parameters()/state_dict; name-keyed for multiple params
+    sns = layer.__dict__.setdefault("_spectral_norms", {})
+    sns[name] = sn
+    # the original weight stays THE trainable parameter, renamed
+    from ...framework.core import Parameter
+    layer._parameters[name + "_orig"] = Parameter(as_jax(w))
+    del layer._parameters[name]
+
+    def _compute(lay, ipt=None):
+        normed = lay.__dict__["_spectral_norms"][name](
+            lay._parameters[name + "_orig"])
+        object.__setattr__(lay, name, normed)
+        return None
+
+    handle = layer.register_forward_pre_hook(_compute)
+    hooks = layer.__dict__.setdefault("_spectral_norm_hooks", {})
+    hooks[name] = (handle, _compute)
+    _compute(layer)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip; returns the total norm
+    (reference ``nn.utils.clip_grad_norm_``)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return _wrap_out(jnp.zeros(()))
+    grads = [as_jax(p.grad) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"gradient norm is non-finite ({float(total)}); set "
+            "error_if_nonfinite=False to clip anyway")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-12), 1.0)
+    for p, g in zip(params, grads):
+        p._grad = _wrap_out((g * scale).astype(g.dtype))
+    return _wrap_out(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p._grad = _wrap_out(jnp.clip(as_jax(p.grad), -cv, cv))
+
+
+def parameters_to_vector(parameters, name=None):
+    arrs = [as_jax(p).reshape(-1) for p in parameters]
+    return _wrap_out(jnp.concatenate(arrs))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    arr = as_jax(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        p._data = arr[off:off + n].reshape(tuple(p.shape)) \
+            .astype(as_jax(p).dtype)
+        off += n

@@ -1,0 +1,8 @@
+"""``paddle.regularizer`` namespace (reference
+``python/paddle/regularizer.py``): re-exports the weight-decay
+regularizers the optimizers consume (pass as ``weight_decay=`` or on a
+``ParamAttr``)."""
+from .optimizer.regularizer import (L1Decay, L2Decay,  # noqa: F401
+                                    WeightDecayRegularizer)
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]

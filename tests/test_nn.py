@@ -517,3 +517,125 @@ def test_subset_random_sampler():
     s = SubsetRandomSampler([3, 7, 11, 2])
     got = sorted(list(iter(s)))
     assert got == [2, 3, 7, 11] and len(s) == 4
+
+
+def test_nn_utils_weight_and_spectral_norm():
+    from paddle_tpu.nn.utils import (remove_weight_norm, spectral_norm,
+                                     weight_norm)
+    paddle.seed(0)
+    lin = nn.Linear(4, 6)
+    w0 = lin.weight.numpy().copy()
+    weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names \
+        and "weight" not in names
+    # reparameterized weight reproduces the original
+    np.testing.assert_allclose(np.asarray(lin.weight.numpy()), w0,
+                               rtol=1e-5)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    y = lin(x)
+    # g/v receive gradients through the forward
+    y.sum().backward()
+    assert lin.weight_g.grad is not None
+    assert lin.weight_v.grad is not None
+    remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+
+    sn_lin = nn.Linear(4, 6)
+    spectral_norm(sn_lin)
+    out = sn_lin(x)
+    # spectral norm of the effective weight ~ 1
+    sigma = np.linalg.svd(np.asarray(sn_lin.weight.numpy()),
+                          compute_uv=False)[0]
+    assert sigma < 1.5
+
+
+def test_nn_utils_grad_clip_and_vector():
+    from paddle_tpu.nn.utils import (clip_grad_norm_, clip_grad_value_,
+                                     parameters_to_vector,
+                                     vector_to_parameters)
+    paddle.seed(1)
+    lin = nn.Linear(3, 3)
+    x = paddle.to_tensor(np.ones((2, 3), np.float32) * 10)
+    (lin(x) ** 2).sum().backward()
+    total = clip_grad_norm_(lin.parameters(), max_norm=1.0)
+    norms = np.sqrt(sum(float((p.grad.numpy() ** 2).sum())
+                        for p in lin.parameters()))
+    assert float(total.numpy()) > 1.0       # pre-clip norm returned
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+    clip_grad_value_(lin.parameters(), 0.01)
+    for p in lin.parameters():
+        assert np.abs(p.grad.numpy()).max() <= 0.01 + 1e-7
+
+    vec = parameters_to_vector(lin.parameters())
+    assert vec.shape[0] == 3 * 3 + 3
+    vector_to_parameters(vec * 0 + 5.0, lin.parameters())
+    for p in lin.parameters():
+        assert (p.numpy() == 5.0).all()
+
+
+def test_paddle_regularizer_namespace():
+    import paddle_tpu.regularizer as reg
+    paddle.seed(2)
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.Momentum(
+        0.1, parameters=lin.parameters(),
+        weight_decay=reg.L2Decay(0.5))
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    lin(x).sum().backward()
+    w_before = lin.weight.numpy().copy()
+    g = lin.weight.grad.numpy().copy()
+    opt.step()
+    # coupled L2: effective grad = g + coeff * w
+    want = w_before - 0.1 * (g + 0.5 * w_before)
+    np.testing.assert_allclose(lin.weight.numpy(), want, rtol=1e-5)
+
+
+def test_weight_norm_remove_folds_latest_and_trains():
+    """r4 review regressions: remove_weight_norm must fold the CURRENT
+    g/v (post-optimizer), purge the shadow attr so training resumes,
+    and name-keyed state must survive two reparameterized params."""
+    from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+    paddle.seed(4)
+    lin = nn.Linear(4, 4)
+    weight_norm(lin, "weight")
+    weight_norm(lin, "bias", dim=None)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    lin(x).sum().backward()
+    opt.step()                       # g/v updated AFTER the forward
+    g_now = lin.weight_g.numpy().copy()
+    v_now = lin.weight_v.numpy().copy()
+    remove_weight_norm(lin, "weight")
+    want = g_now * v_now / np.sqrt(
+        (v_now ** 2).sum(axis=1, keepdims=True))
+    np.testing.assert_allclose(lin.weight.numpy(), want, rtol=1e-5)
+    # bias reparameterization is still live and independent
+    assert "bias_g" in dict(lin.named_parameters())
+    remove_weight_norm(lin, "bias")
+    # the layer TRAINS again through the restored parameter
+    opt2 = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    before = lin.weight.numpy().copy()
+    lin(x).sum().backward()
+    opt2.step()
+    assert np.abs(lin.weight.numpy() - before).max() > 0
+
+
+def test_spectral_norm_keeps_state_dict_clean():
+    from paddle_tpu.nn.utils import spectral_norm
+    paddle.seed(5)
+    lin = nn.Linear(4, 6)
+    spectral_norm(lin)
+    names = set(dict(lin.named_parameters()))
+    assert names == {"weight_orig", "bias"}, names
+    assert not any("weight_u" in k or "_spectral_norm" in k
+                   for k in lin.state_dict())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    lin(x)
+    sigma = np.linalg.svd(np.asarray(lin.weight.numpy()),
+                          compute_uv=False)[0]
+    assert sigma < 1.5
