@@ -342,12 +342,24 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 
 
+def _adaptive_out(arr, output_size, nsp):
+    """Resolve an adaptive-pool output_size spec (int/tuple, None dims
+    keep the input size) against the input's spatial dims."""
+    in_spatial = arr.shape[-nsp:]
+    if isinstance(output_size, (list, tuple)):
+        spec = list(output_size)
+        if len(spec) == 1:
+            spec = spec * nsp
+    else:
+        spec = [output_size] * nsp
+    return in_spatial, tuple(
+        in_spatial[i] if spec[i] is None else int(spec[i])
+        for i in range(nsp))
+
+
 def _adaptive_pool(x, output_size, nsp, op, op_name):
     arr = as_jax(x)
-    in_spatial = arr.shape[-nsp:]
-    out_spatial = _tuplify(output_size, nsp)
-    out_spatial = tuple(in_spatial[i] if out_spatial[i] is None
-                        else out_spatial[i] for i in range(nsp))
+    in_spatial, out_spatial = _adaptive_out(arr, output_size, nsp)
     # adaptive pooling with uniform bins when divisible, else gather-based
     if all(i % o == 0 for i, o in zip(in_spatial, out_spatial)):
         ks = tuple(i // o for i, o in zip(in_spatial, out_spatial))
@@ -383,26 +395,43 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive_pool(x, output_size, 3, "avg", "adaptive_avg_pool3d")
 
 
+def _adaptive_max_mask(x, output_size, nsp, op_name):
+    """return_mask path: when every spatial dim divides evenly the
+    adaptive pool IS a strided max pool — reuse the argmax-mask
+    machinery; non-uniform bins keep an explicit gate."""
+    arr = as_jax(x)
+    in_spatial, out_spatial = _adaptive_out(arr, output_size, nsp)
+    if any(i % o != 0 for i, o in zip(in_spatial, out_spatial)):
+        raise NotImplementedError(
+            f"{op_name} return_mask needs evenly dividing bins "
+            f"(input {in_spatial} -> output {out_spatial})")
+    ks = tuple(i // o for i, o in zip(in_spatial, out_spatial))
+
+    def f(a):
+        return _max_pool_nd_with_mask(a, ks, ks, (0,) * nsp, nsp)
+    return apply_jax(op_name + "_mask", f, x, n_outputs=2)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError(
-            "adaptive_max_pool1d return_mask not implemented")
+        return _adaptive_max_mask(x, output_size, 1,
+                                  "adaptive_max_pool1d")
     return _adaptive_pool(x, output_size, 1, "max",
                           "adaptive_max_pool1d")
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError(
-            "adaptive_max_pool2d return_mask not implemented")
+        return _adaptive_max_mask(x, output_size, 2,
+                                  "adaptive_max_pool2d")
     return _adaptive_pool(x, output_size, 2, "max",
                           "adaptive_max_pool2d")
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     if return_mask:
-        raise NotImplementedError(
-            "adaptive_max_pool3d return_mask not implemented")
+        return _adaptive_max_mask(x, output_size, 3,
+                                  "adaptive_max_pool3d")
     return _adaptive_pool(x, output_size, 3, "max",
                           "adaptive_max_pool3d")
 

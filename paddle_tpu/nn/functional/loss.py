@@ -336,11 +336,31 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
                   path_table=None, path_code=None, is_sparse=False,
                   name=None):
     """Hierarchical sigmoid loss (``F.hsigmoid_loss`` /
-    ``paddle/phi/kernels/cpu/hsigmoid_loss_kernel.cc``) for the default
-    complete binary tree (custom path_table/path_code not supported)."""
-    if path_table is not None or path_code is not None:
-        raise NotImplementedError(
-            "hsigmoid_loss custom trees (path_table/path_code)")
+    ``paddle/phi/kernels/cpu/hsigmoid_loss_kernel.cc``): default
+    complete binary tree, or a CUSTOM tree via per-class
+    ``path_table`` (node-weight row ids, -1 padded) + ``path_code``
+    (0/1 branch bits)."""
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid_loss: pass path_table and path_code together")
+    if path_table is not None:
+        def fc(x, y, w, tbl, code, *maybe_b):
+            y32 = y.reshape(-1).astype(jnp.int32)
+            rows = tbl[y32].astype(jnp.int32)       # [N, L]
+            bits = code[y32].astype(jnp.float32)    # [N, L]
+            live = rows >= 0                        # -1 = path padding
+            idx = jnp.clip(rows, 0, w.shape[0] - 1)
+            logit = jnp.einsum("bd,bld->bl", x, w[idx])
+            if maybe_b:
+                bvec = maybe_b[0].reshape(-1)
+                logit = logit + bvec[idx]
+            ce = jnp.maximum(logit, 0.0) - logit * bits \
+                + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+            return jnp.sum(jnp.where(live, ce, 0.0), axis=1)[:, None]
+
+        args = [input, label, weight, path_table, path_code] + \
+            ([bias] if bias is not None else [])
+        return apply_jax("hsigmoid_loss_custom", fc, *args)
     import numpy as _np
     code_len = max(int(_np.ceil(_np.log2(max(num_classes, 2)))), 1)
 

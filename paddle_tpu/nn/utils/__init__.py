@@ -20,16 +20,25 @@ def weight_norm(layer, name="weight", dim=0):
     trainable parameters; the effective weight is recomputed in a
     pre-forward hook — the reference's WeightNorm wrapper. May be
     applied independently to several parameters of one layer."""
+    if name in layer.__dict__.get("_weight_norm_hooks", {}):
+        raise RuntimeError(
+            f"weight_norm is already applied to {name!r} of "
+            f"{type(layer).__name__}")
     w = getattr(layer, name)
     arr = as_jax(w)
     if dim is None:
         axes = None
+        g_shape = (1,)               # reference: scalar-shaped g
+        bshape = (1,) * arr.ndim
     else:
         dim = dim % arr.ndim
         axes = tuple(i for i in range(arr.ndim) if i != dim)
-    norm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes, keepdims=True))
+        g_shape = (arr.shape[dim],)  # reference norm_except_dim: 1-D
+        bshape = tuple(arr.shape[dim] if i == dim else 1
+                       for i in range(arr.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(arr), axis=axes))
     from ...framework.core import Parameter
-    setattr(layer, name + "_g", Parameter(norm))
+    setattr(layer, name + "_g", Parameter(norm.reshape(g_shape)))
     setattr(layer, name + "_v", Parameter(arr))
     # the original slot becomes a derived (hook-computed) attribute
     del layer._parameters[name]
@@ -39,7 +48,7 @@ def weight_norm(layer, name="weight", dim=0):
             n = jnp.sqrt(jnp.maximum(
                 jnp.sum(jnp.square(v_a), axis=axes, keepdims=True),
                 1e-24))
-            return g_a * v_a / n
+            return g_a.reshape(bshape) * v_a / n
         object.__setattr__(lay, name, apply_jax("weight_norm", f,
                                                 getattr(lay, name + "_g"),
                                                 getattr(lay, name + "_v")))
@@ -86,7 +95,14 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
     from ..layer.norm import SpectralNorm
     w = getattr(layer, name)
     if dim is None:
-        dim = 0
+        # reference dim resolution: Linear and transposed convs store
+        # the OUTPUT dim second — matricize over dim 1 for those
+        from ..layer.common import Linear
+        from ..layer.conv import (Conv1DTranspose, Conv2DTranspose,
+                                  Conv3DTranspose)
+        dim = 1 if isinstance(layer, (Linear, Conv1DTranspose,
+                                      Conv2DTranspose,
+                                      Conv3DTranspose)) else 0
     sn = SpectralNorm(list(w.shape), dim=dim,
                       power_iters=n_power_iterations, epsilon=eps)
     # plain-dict storage: NOT a sublayer, so u/v never leak into

@@ -609,8 +609,9 @@ def test_weight_norm_remove_folds_latest_and_trains():
     opt.step()                       # g/v updated AFTER the forward
     g_now = lin.weight_g.numpy().copy()
     v_now = lin.weight_v.numpy().copy()
+    assert g_now.shape == (4,)          # reference 1-D g (norm_except_dim)
     remove_weight_norm(lin, "weight")
-    want = g_now * v_now / np.sqrt(
+    want = g_now[:, None] * v_now / np.sqrt(
         (v_now ** 2).sum(axis=1, keepdims=True))
     np.testing.assert_allclose(lin.weight.numpy(), want, rtol=1e-5)
     # bias reparameterization is still live and independent
@@ -638,4 +639,28 @@ def test_spectral_norm_keeps_state_dict_clean():
     lin(x)
     sigma = np.linalg.svd(np.asarray(lin.weight.numpy()),
                           compute_uv=False)[0]
+    assert sigma < 1.5
+
+
+def test_weight_norm_double_apply_raises():
+    from paddle_tpu.nn.utils import weight_norm
+    lin = nn.Linear(3, 3)
+    weight_norm(lin)
+    with pytest.raises(RuntimeError, match="already applied"):
+        weight_norm(lin)
+
+
+def test_spectral_norm_dim_resolution_transpose_conv():
+    """dim=None resolves to 1 for Linear/transposed convs (reference
+    norm-except-output-dim semantics)."""
+    from paddle_tpu.nn.utils import spectral_norm
+    paddle.seed(6)
+    ct = nn.Conv2DTranspose(4, 8, 3)
+    spectral_norm(ct)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(1, 4, 6, 6)
+                         .astype(np.float32))
+    ct(x)
+    w = np.asarray(ct.weight.numpy())       # [in, out, kh, kw]
+    mat = np.moveaxis(w, 1, 0).reshape(w.shape[1], -1)
+    sigma = np.linalg.svd(mat, compute_uv=False)[0]
     assert sigma < 1.5

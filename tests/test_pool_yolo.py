@@ -172,3 +172,58 @@ def test_yolo_box_iou_aware_layout():
                 x1 = max((cx - bw / 2) * 64, 0)
                 np.testing.assert_allclose(boxes.numpy()[0, flat, 0],
                                            x1, rtol=1e-4, atol=1e-4)
+
+
+def test_adaptive_max_pool_mask_matches_torch():
+    x = np.random.RandomState(5).randn(2, 3, 12, 8).astype(np.float32)
+    vals, mask = F.adaptive_max_pool2d(paddle.to_tensor(x), [3, 4],
+                                       return_mask=True)
+    tv, ti = torch.nn.functional.adaptive_max_pool2d(
+        torch.tensor(x), (3, 4), return_indices=True)
+    np.testing.assert_allclose(vals.numpy(), tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(mask.numpy(), ti.numpy())
+    x1 = np.random.RandomState(6).randn(1, 2, 10).astype(np.float32)
+    v1, m1 = F.adaptive_max_pool1d(paddle.to_tensor(x1), 5,
+                                   return_mask=True)
+    t1v, t1i = torch.nn.functional.adaptive_max_pool1d(
+        torch.tensor(x1), 5, return_indices=True)
+    np.testing.assert_allclose(v1.numpy(), t1v.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(m1.numpy(), t1i.numpy())
+    with pytest.raises(NotImplementedError, match="evenly"):
+        F.adaptive_max_pool1d(paddle.to_tensor(x1), 3, return_mask=True)
+
+
+def test_hsigmoid_custom_tree():
+    """Custom path_table/path_code tree vs a hand-computed oracle."""
+    rng = np.random.RandomState(0)
+    N, D, n_nodes = 4, 6, 5
+    x = rng.randn(N, D).astype(np.float32)
+    w = rng.randn(n_nodes, D).astype(np.float32)
+    b = rng.randn(n_nodes).astype(np.float32)
+    # 4 classes, variable-depth paths (-1 padded)
+    tbl = np.asarray([[0, 1, -1], [0, 2, 4], [3, -1, -1], [0, 2, -1]],
+                     np.int64)
+    code = np.asarray([[0, 1, 0], [1, 0, 1], [1, 0, 0], [1, 1, 0]],
+                      np.float32)
+    y = rng.randint(0, 4, (N,)).astype(np.int64)
+    got = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), 4,
+                          paddle.to_tensor(w), paddle.to_tensor(b),
+                          path_table=paddle.to_tensor(tbl),
+                          path_code=paddle.to_tensor(code)).numpy()
+
+    def sigmoid_ce(logit, bit):
+        return max(logit, 0) - logit * bit + np.log1p(np.exp(-abs(logit)))
+
+    for n in range(N):
+        want = 0.0
+        for l in range(3):
+            node = tbl[y[n], l]
+            if node < 0:
+                continue
+            logit = float(x[n] @ w[node] + b[node])
+            want += sigmoid_ce(logit, float(code[y[n], l]))
+        np.testing.assert_allclose(got[n, 0], want, rtol=1e-4)
+    with pytest.raises(ValueError, match="together"):
+        F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(y), 4,
+                        paddle.to_tensor(w),
+                        path_table=paddle.to_tensor(tbl))
