@@ -34,7 +34,7 @@ def _peak_flops_per_chip() -> float:
 
 def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
                   seq, batch, steps, multi_precision=True,
-                  remat="none", remat_interval=1):
+                  remat="none", remat_interval=1, windows=1):
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
@@ -72,11 +72,16 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     loss = step(x, y)           # warmup/compile
     _ = float(loss.numpy())
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y)
-    val = float(loss.numpy())   # forces completion
-    dt = time.perf_counter() - t0
+    # tunnel/session noise is ±5%: time `windows` independent windows
+    # and report the MEDIAN one (the headline config uses 3)
+    times = []
+    for _ in range(max(int(windows), 1)):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y)
+        val = float(loss.numpy())   # forces completion
+        times.append(time.perf_counter() - t0)
+    dt = sorted(times)[len(times) // 2]
 
     tokens = batch * seq * steps
     tok_per_sec = tokens / dt
@@ -241,7 +246,8 @@ def main():
         seq=int(os.environ.get("BENCH_L_SEQ", 4096)),
         batch=int(os.environ.get("BENCH_L_BATCH", 2)),
         steps=max(steps // 2, 3),
-        remat=os.environ.get("BENCH_L_REMAT", "none"))
+        remat=os.environ.get("BENCH_L_REMAT", "none"),
+        windows=int(os.environ.get("BENCH_L_WINDOWS", 3)))
     remat_regime = _train_config(
         "llama8b_shaped_remat",
         hidden=int(os.environ.get("BENCH_L_HIDDEN", 4096)),
