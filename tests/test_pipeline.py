@@ -403,3 +403,71 @@ def test_interleaved_1f1b_pp4_v2_matches_sequential_grads():
     worst = max(float(np.abs(got[n] - ref_grads[n]).max())
                 for n in ref_grads)
     assert worst < 1e-4, f"worst pp4-v2 interleaved grad diff {worst}"
+
+
+def test_interleaved_ring_depth_no_collision_property():
+    """Brute-force simulate all three m%ring-slotted buffers across a
+    GRID of (pp, n_micro, v) shapes: with the table-derived ring size,
+    no write may land on a slot whose pending value is still unread
+    (r3 advisor finding, generalized beyond the tested pp=2/pp=4)."""
+    from paddle_tpu.distributed.pipeline_1f1b import (
+        _IDLE, _B, _F, _ring_depth, make_interleaved_schedule)
+
+    def simulate(pp, nm, v):
+        op, mi, ci = make_interleaved_schedule(pp, nm, v)
+        ring = _ring_depth(op, mi, ci, pp, v)
+        T = op.shape[1]
+        # buffers[stage] maps (buf, chunk, slot) -> pending micro id
+        pend = {}
+
+        def write(key, m, read_ok_same_slot):
+            if key in pend and pend[key] is not None:
+                raise AssertionError(
+                    f"overwrite of pending {key} (pp={pp}, nm={nm}, "
+                    f"v={v}, ring={ring})")
+            pend[key] = m
+
+        for t in range(T):
+            # 1. bodies run first: F reads fbuf + writes in_ring;
+            #    B reads in_ring + gbuf (consuming them)
+            for s in range(pp):
+                c, m = int(ci[s, t]), int(mi[s, t])
+                if op[s, t] == _F:
+                    first_part = (s == 0 and c == 0)
+                    if not first_part:
+                        key = ("f", s, c, m % ring)
+                        assert pend.get(key) == m, (
+                            f"F reads missing/wrong activation {key} "
+                            f"(pp={pp}, nm={nm}, v={v}, ring={ring})")
+                        pend[key] = None
+                    write(("in", s, c, m % ring), m, False)
+                elif op[s, t] == _B:
+                    key = ("in", s, c, m % ring)
+                    assert pend.get(key) == m
+                    pend[key] = None
+                    last_part = (s == pp - 1 and c == v - 1)
+                    if not last_part:
+                        gkey = ("g", s, c, m % ring)
+                        assert pend.get(gkey) == m
+                        pend[gkey] = None
+            # 2. ring recv lands at END of slot (after the reads)
+            for s in range(pp):
+                prev, nxt = (s - 1) % pp, (s + 1) % pp
+                p_op, p_mi, p_ci = op[prev, t], int(mi[prev, t]), \
+                    int(ci[prev, t])
+                if p_op == _F and (s > 0 or p_ci < v - 1):
+                    dst = min(p_ci + 1, v - 1) if s == 0 else p_ci
+                    write(("f", s, dst, p_mi % ring), p_mi, True)
+                n_op, n_mi, n_ci = op[nxt, t], int(mi[nxt, t]), \
+                    int(ci[nxt, t])
+                if n_op == _B and (s < pp - 1 or n_ci > 0):
+                    dst = max(n_ci - 1, 0) if s == pp - 1 else n_ci
+                    write(("g", s, dst, n_mi % ring), n_mi, True)
+        # every pending entry consumed
+        left = {k: m for k, m in pend.items() if m is not None}
+        assert not left, f"unconsumed entries {left}"
+
+    for pp in (2, 3, 4):
+        for v in (2, 3):
+            for nm in (pp, 2 * pp, 3 * pp, 4 * pp):
+                simulate(pp, nm, v)
