@@ -6,7 +6,17 @@ usage; ``paddle_tpu.compat.install()`` also registers it as ``paddle``.
 """
 from __future__ import annotations
 
+import sys as _sys
+
 __version__ = "0.1.0"
+
+# Deep traces (dy2static-converted models inside a whole-step jit with
+# custom-VJP Pallas kernels) exceed CPython's default 1000-frame limit;
+# jax's own docs recommend raising it for large traced programs. Only
+# the UNTOUCHED default is raised — an application that deliberately set
+# its own limit keeps it.
+if _sys.getrecursionlimit() == 1000:
+    _sys.setrecursionlimit(10000)
 
 from .framework import (
     Tensor, Parameter, to_tensor, no_grad, enable_grad, set_grad_enabled,

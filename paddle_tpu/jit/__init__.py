@@ -405,9 +405,14 @@ class TrainStep:
         fwd_fn = self._layer_caller()
         trainable = [not p.stop_gradient for _, p in binder.param_items]
 
-        def step(param_arrays, opt_states, buffer_arrays, lr, rng_key,
-                 batch):
+        def step(param_arrays, opt_states, buffer_arrays, lr, base_key,
+                 step_idx, batch):
             from ..framework.random import set_functional_key
+            # fold the step counter in HERE (inside the compiled step):
+            # a host-side jax.random.fold_in is a separate tiny device
+            # program whose dispatch costs ~4 ms/step through the axon
+            # tunnel; inside the jit it fuses to nothing
+            rng_key = jax.random.fold_in(base_key, step_idx)
 
             def loss_of(train_params):
                 set_functional_key(rng_key)
@@ -484,11 +489,12 @@ class TrainStep:
         params = self.binder.param_arrays()
         buffers = self.binder.buffer_arrays()
         lr = self.optimizer.get_lr()
-        rng_key = jax.random.fold_in(self._base_key, self._step_idx)
+        step_idx = np.uint32(self._step_idx)
         self._step_idx += 1
         batch = (_tree_to_arrays(args), _tree_to_arrays(kwargs))
         loss, new_params, new_states, new_buffers = self._jitted(
-            params, self._opt_states, buffers, lr, rng_key, batch)
+            params, self._opt_states, buffers, lr, self._base_key,
+            step_idx, batch)
         for (_, p), arr in zip(self.binder.param_items, new_params):
             p._data = arr
         for (_, b), arr in zip(self.binder.buffer_items, new_buffers):

@@ -228,10 +228,9 @@ class LlamaAttention(Layer):
             else:
                 from ..ops.pallas.flash_attention import \
                     flash_attention_core
-                rep = self.num_heads // self.num_kv_heads
-                if rep > 1:
-                    kh = jnp.repeat(kh, rep, axis=2)
-                    vh = jnp.repeat(vh, rep, axis=2)
+                # grouped kv heads pass through unexpanded — the Pallas
+                # kernel routes each query head to its kv group via the
+                # BlockSpec index map (the XLA fallback repeats inside)
                 out = flash_attention_core(qh, kh, vh, is_causal=True)
             return out.reshape(b, l, self.num_heads * self.head_dim)
 
@@ -407,12 +406,24 @@ class LlamaPretrainingCriterion(Layer):
 
     def forward(self, logits, labels):
         def f(lg, lb):
-            logp = jax.nn.log_softmax(lg.astype(jnp.float32), axis=-1)
+            # CE via explicit row logsumexp instead of log_softmax: a
+            # full log_softmax materializes TWO [B, L, V] f32 arrays
+            # (~1 GB each at the bench shapes) where only [B, L] row
+            # stats are needed. The max runs on the input dtype and the
+            # f32 upcast happens on (lg - m), whose ONLY consumer is the
+            # exp-sum reduction — XLA fuses it into the reduce, so no
+            # vocab-size f32 array ever reaches HBM.
+            m = jax.lax.stop_gradient(
+                jnp.max(lg, axis=-1, keepdims=True))
+            zs = (lg - m).astype(jnp.float32)
+            lse = m[..., 0].astype(jnp.float32) + jnp.log(
+                jnp.sum(jnp.exp(zs), axis=-1))
             lb_i = lb.astype(jnp.int32)
             picked = jnp.take_along_axis(
-                logp, jnp.clip(lb_i, 0)[..., None], axis=-1)[..., 0]
+                lg, jnp.clip(lb_i, 0)[..., None],
+                axis=-1)[..., 0].astype(jnp.float32)
             valid = lb_i != self.ignore_index
-            loss = -jnp.where(valid, picked, 0.0)
+            loss = jnp.where(valid, lse - picked, 0.0)
             return jnp.sum(loss) / jnp.maximum(
                 jnp.sum(valid.astype(jnp.float32)), 1.0)
         return apply_jax("llama_ce", f, logits, labels)

@@ -27,7 +27,14 @@ except Exception as _e:  # pallas/tpu lowering unavailable on this build
 
 def _xla_attention(q, k, v, bias, is_causal, scale):
     """Fallback path: jax.nn.dot_product_attention (XLA fuses the softmax
-    chain; fine for short sequences / biased attention)."""
+    chain; fine for short sequences / biased attention). Grouped kv
+    heads pass straight through — jax handles GQA natively when kv heads
+    divide query heads, with no materialized repeat."""
+    if bias is not None and bias.ndim == 4 \
+            and bias.shape[1] not in (1, q.shape[2]):
+        # bias per kv-head group (FlashMask dense lowering): expand to
+        # the query head count, which dot_product_attention requires
+        bias = jnp.repeat(bias, q.shape[2] // bias.shape[1], axis=1)
     return jax.nn.dot_product_attention(
         q, k, v, bias=bias, is_causal=is_causal, scale=scale)
 
@@ -51,18 +58,21 @@ def _pallas_available():
 
 def _kernel_eligible(q, k, bias):
     # q and kv seq divisible into >=128 lanes, head_dim tile-friendly,
-    # no dense bias (FlashMask lowers its compact form separately)
+    # no dense bias (FlashMask lowers its compact form separately);
+    # grouped kv heads are handled natively by the kernel
     return (bias is None and q.shape[1] % 128 == 0 and q.shape[1] >= 256
             and k.shape[1] % 128 == 0
-            and q.shape[-1] in (64, 128, 256))
+            and q.shape[-1] in (64, 128, 256)
+            and q.shape[2] % k.shape[2] == 0)
 
 
 _fallback_logged = False
 
 
 def flash_attention_core(q, k, v, bias=None, is_causal=False, scale=None):
-    """Pure-array flash attention; q/k/v: [B, L, H, D]. K/V already
-    repeated to the query head count (GQA expansion at call site)."""
+    """Pure-array flash attention; q/k/v: [B, L, H, D]. K/V may carry
+    fewer (grouped) heads — the Pallas kernel consumes them natively and
+    the XLA fallback repeats them internally."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if _pallas_available():

@@ -31,8 +31,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# measured on v5e at [64, 2048, 64] fwd+bwd: (512, 1024) 5.08 ms vs
+# (512, 512) 6.35 / (1024, 1024) 5.70 / jax stock flash kernel 21.2
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_K = 1024
 import contextlib
 
 
@@ -92,10 +94,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
+        # FA-2 dtype recipe: dots take the INPUT dtype (bf16 hits the
+        # MXU at full rate; an fp32 upcast before the dot runs the MXU
+        # ~8x slower on v5e) and accumulate f32 via
+        # preferred_element_type
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
         if causal:
@@ -127,8 +131,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, 0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse[:, 0])
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """q/k/v: [BH, L, D] → (o [BH, L, D], lse [BH, L])."""
+def _kv_row(b, h, h_kv):
+    """Grid row over [B*H] -> row in the [B*Hkv] folded K/V array (GQA:
+    query head h maps to kv head h // (H / Hkv))."""
+    group = h // h_kv
+    return (b // h) * h_kv + (b % h) // group
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, h, h_kv):
+    """q: [B*H, L, D], k/v: [B*Hkv, L, D] (GQA-native: kv heads are NOT
+    pre-repeated; the BlockSpec index map routes each query head to its
+    kv group, so grouped K/V are fetched once per group instead of once
+    per query head) → (o [B*H, L, D], lse [B*H, L])."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     bq, bk = _block_sizes(lq, block_q, block_k)
@@ -145,8 +159,10 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (_kv_row(b, h, h_kv), j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (_kv_row(b, h, h_kv), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
@@ -192,15 +208,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
 
+        # bf16 dot inputs, f32 accumulation (see forward kernel note)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -210,11 +223,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k_ref.dtype)
         dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == n_kv - 1)
@@ -224,11 +237,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                block_q, block_k, n_q):
+                block_q, block_k, n_q, n_t):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    ti = pl.program_id(2)       # flattened (query-head-in-group, qi)
+    qi = ti % n_q
 
-    @pl.when(qi == 0)
+    @pl.when(ti == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -239,15 +253,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
 
+        # bf16 dot inputs, f32 accumulation (see forward kernel note)
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
@@ -256,33 +267,36 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse)
+        pb = p.astype(do_ref.dtype)
         # dv += p^T @ dO
         dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pb, do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q_ref.dtype)
         # dk += ds^T @ q
         dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == n_q - 1)
+    @pl.when(ti == n_t - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do):
+def _bwd(scale, causal, block_q, block_k, h, h_kv, res, do):
     q, k, v, o, lse = res
     bh, lq, d = q.shape
+    bhkv = k.shape[0]
     lk = k.shape[1]
     bq, bk = _block_sizes(lq, block_q, block_k)
     bk = _block_sizes(lk, block_q, bk)[1]
     n_q = lq // bq
     n_kv = lk // bk
+    group = h // h_kv
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)[:, None, :]  # [BH, 1, L] (tile rule)
@@ -293,8 +307,10 @@ def _bwd(scale, causal, block_q, block_k, res, do):
         grid=(bh, n_q, n_kv),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (_kv_row(b, h, h_kv), j, 0)),
+            pl.BlockSpec((1, bk, d),
+                         lambda b, i, j: (_kv_row(b, h, h_kv), j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
@@ -309,25 +325,37 @@ def _bwd(scale, causal, block_q, block_k, res, do):
     with disable_x64():
         dq = dq_call(q, k, v, do, lse, delta)
 
+    # dk/dv grid rides the [B*Hkv] kv rows; the innermost dim flattens
+    # (query-head-in-group, q_block) so one scratch accumulates the
+    # whole group's contribution before writing dk/dv once
+    n_t = group * n_q
+
+    def _q_row(b, t):
+        return (b // h_kv) * h + (b % h_kv) * group + t // n_q
+
     dkv_call = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, block_k=bk, n_q=n_q),
-        grid=(bh, n_kv, n_q),
+                          block_q=bq, block_k=bk, n_q=n_q, n_t=n_t),
+        grid=(bhkv, n_kv, n_t),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, t: (_q_row(b, t), t % n_q, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, t: (_q_row(b, t), t % n_q, 0)),
+            pl.BlockSpec((1, 1, bq),
+                         lambda b, j, t: (_q_row(b, t), 0, t % n_q)),
+            pl.BlockSpec((1, 1, bq),
+                         lambda b, j, t: (_q_row(b, t), 0, t % n_q)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+            jax.ShapeDtypeStruct((bhkv, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhkv, lk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -358,19 +386,19 @@ def _interpret() -> bool:
         return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhld(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_bhld(q, k, v, scale, causal, block_q, block_k, h, h_kv):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, h, h_kv)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, h, h_kv):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, h, h_kv)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
-    return _bwd(scale, causal, block_q, block_k, res, do)
+def _flash_bwd_rule(scale, causal, block_q, block_k, h, h_kv, res, do):
+    return _bwd(scale, causal, block_q, block_k, h, h_kv, res, do)
 
 
 _flash_bhld.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -380,16 +408,22 @@ def pallas_flash_attention(q, k, v, causal=False, sm_scale=None,
                            block_q=DEFAULT_BLOCK_Q,
                            block_k=DEFAULT_BLOCK_K):
     """Flash attention over Paddle's flash-attn layout [B, L, H, D].
-    K/V must already be expanded to the query head count (GQA repeat is
-    the caller's concern). Differentiable (custom VJP above)."""
+    GQA-native: K/V may carry fewer heads (H % H_kv == 0); each query
+    head reads its kv group's blocks directly via the BlockSpec index
+    map, so grouped K/V are never materialized at the query head count.
+    Differentiable (custom VJP above)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})")
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(d)
     # [B, L, H, D] -> [B*H, L, D]
-    def fold(x, l):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, l, x.shape[-1])
-    o = _flash_bhld(fold(q, lq), fold(k, lk), fold(v, lk),
+    def fold(x, l, heads):
+        return x.transpose(0, 2, 1, 3).reshape(b * heads, l, x.shape[-1])
+    o = _flash_bhld(fold(q, lq, h), fold(k, lk, h_kv), fold(v, lk, h_kv),
                     float(sm_scale), bool(causal), int(block_q),
-                    int(block_k))
+                    int(block_k), int(h), int(h_kv))
     return o.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
