@@ -129,17 +129,43 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def _flashmask_kernel_eligible(q, idx):
+    """Compact-form kernel: TPU, lane-aligned seq, supported head dim,
+    bounds in {1, 2}, and mask heads dividing query heads."""
+    return (_pallas_available()
+            and q.shape[1] % 128 == 0 and q.shape[1] >= 256
+            and q.shape[-1] in (64, 128, 256)
+            and idx.shape[-1] in (1, 2)
+            and q.shape[2] % idx.shape[1] == 0)
+
+
 def flashmask_attention(query, key, value, startend_row_indices=None,
                         dropout=0.0, causal=False, name=None):
     """FlashMask sparse-mask attention parity
-    (``paddle.nn.functional.flashmask_attention``): mask given as start/end
-    row indices per column block, materialized here as a bias (the Pallas
-    kernel consumes the compact form directly in a later milestone)."""
+    (``paddle.nn.functional.flashmask_attention``): the mask arrives as
+    O(L) per-column row bounds. On TPU the Pallas compact-form kernel
+    (``flashmask_kernel.py``) consumes the bounds directly — no O(L²)
+    bias is ever materialized, and fully-masked blocks are skipped —
+    which is the long-context memory/flop profile FlashMask exists for.
+    Off-TPU (or for unsupported shapes) the bounds lower to a dense
+    bias."""
     if startend_row_indices is None:
         return scaled_dot_product_attention(query, key, value, None,
                                             dropout, causal, True)
     q = as_jax(query)
     idx = as_jax(startend_row_indices)  # [B, H_k, L, bounds]
+    if _flashmask_kernel_eligible(q, idx):
+        from .flashmask_kernel import pallas_flashmask_attention
+
+        def fk(q_a, k_a, v_a, idx_a):
+            return pallas_flashmask_attention(q_a, k_a, v_a, idx_a,
+                                              causal=causal)
+        out = apply_jax("flashmask_attention", fk, query, key, value,
+                        Tensor(idx))
+        if dropout > 0.0:
+            from ...nn.functional.common import dropout as _dropout
+            out = _dropout(out, dropout, training=True)
+        return out
     L = q.shape[1]
     rows = jnp.arange(L)[:, None]  # query index
     cols = jnp.arange(L)[None, :]  # key index

@@ -1,0 +1,165 @@
+"""FlashMask compact-form kernel tests.
+
+Reference: ``paddle.nn.functional.flashmask_attention`` backed by the
+FlashMask extension of the bundled flashattn (SURVEY.md §5.7.4,
+``paddle/phi/kernels/gpu/flash_attn_kernel.cu``). The dense-bias
+lowering is the semantic spec; the Pallas compact-form kernel
+(``ops/pallas/flashmask_kernel.py``) must match it exactly while never
+materializing an O(L²) bias.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas import flash_attention_kernel as fak
+from paddle_tpu.ops.pallas.flashmask_kernel import \
+    pallas_flashmask_attention
+
+
+def dense_ref(q, k, v, idx, causal):
+    """The dense-bias lowering (the original flashmask_attention path)."""
+    L = q.shape[1]
+    rows = jnp.arange(L)[:, None]
+    cols = jnp.arange(L)[None, :]
+    start = idx[..., 0]
+    end = idx[..., 1] if idx.shape[-1] >= 2 else jnp.full_like(start, L)
+    masked = (rows[None, None] >= start[:, :, None, :]) & \
+             (rows[None, None] < end[:, :, None, :])
+    if causal:
+        masked = masked | (cols[None, None] > rows[None, None])
+    bias = jnp.where(masked, -1e9, 0.0).astype(jnp.float32)
+    if bias.shape[1] != q.shape[2]:
+        bias = jnp.repeat(bias, q.shape[2] // bias.shape[1], axis=1)
+    kk, vv = k, v
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        kk = jnp.repeat(k, rep, axis=2)
+        vv = jnp.repeat(v, rep, axis=2)
+    return jax.nn.dot_product_attention(
+        q, kk, vv, bias=bias, is_causal=False,
+        scale=1 / np.sqrt(q.shape[-1]))
+
+
+def _document_bounds(rng, L, n_docs, bounds):
+    """Document-causal style start/end rows (the FlashMask headline
+    use case: tokens attend only within their document)."""
+    cuts = np.sort(rng.choice(np.arange(16, L - 16), n_docs - 1,
+                              replace=False))
+    bnds = np.concatenate([[0], cuts, [L]])
+    start = np.zeros(L, np.int64)
+    end = np.full(L, L, np.int64)
+    for a, b in zip(bnds[:-1], bnds[1:]):
+        start[a:b] = b
+    return np.stack([start, end], -1)[..., :bounds]
+
+
+@pytest.mark.parametrize(
+    "B,H,Hkv,Hm,bounds,causal",
+    [(2, 4, 2, 2, 1, True),     # GQA + 1-bound causal (LTS)
+     (2, 4, 2, 1, 2, True),     # broadcast mask head + 2 bounds
+     (1, 4, 4, 4, 2, False),    # full heads, non-causal interval
+     (2, 8, 4, 2, 2, True),     # mask heads != kv heads
+     (1, 8, 4, 8, 2, True),     # per-QUERY-head masks (Hm > Hkv)
+     (2, 4, 2, 4, 1, True)])    # per-query-head 1-bound
+def test_compact_kernel_matches_dense(B, H, Hkv, Hm, bounds, causal,
+                                      monkeypatch):
+    monkeypatch.setattr(fak, "_FORCE_INTERPRET", True)
+    L, D = 256, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, Hkv, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, Hkv, D), jnp.float32)
+    # DISTINCT bounds per (batch, mask head): identical broadcast masks
+    # would let a wrong-but-in-bounds head routing pass unnoticed
+    idx = np.stack([np.stack([_document_bounds(rng, L, 4, bounds)
+                              for _ in range(Hm)])
+                    for _ in range(B)])
+    idx = jnp.asarray(idx, jnp.int32)
+
+    o_k = pallas_flashmask_attention(q, k, v, idx, causal=causal)
+    o_d = dense_ref(q, k, v, idx, causal)
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_d),
+                               atol=2e-5)
+
+    def lk(q, k, v):
+        return pallas_flashmask_attention(q, k, v, idx,
+                                          causal=causal).sum()
+
+    def ld(q, k, v):
+        return dense_ref(q, k, v, idx, causal).sum()
+
+    gk = jax.grad(lk, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(ld, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5)
+
+
+def test_fully_masked_rows_zero_output_and_grads(monkeypatch):
+    """Rows whose every column is masked must produce o=0 and propagate
+    zero gradient (the -inf logsumexp guard), not exp(-inf - -inf)=1."""
+    monkeypatch.setattr(fak, "_FORCE_INTERPRET", True)
+    B, H, L, D = 1, 2, 256, 64
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, L, H, D), jnp.float32)
+    # mask EVERYTHING for rows >= 128: start=0 end=L on every column
+    # would mask all rows; instead mask rows [128, L) on all columns
+    idx = np.zeros((B, 1, L, 2), np.int32)
+    idx[..., 0] = 128
+    idx[..., 1] = L
+    idx = jnp.asarray(idx)
+    o = pallas_flashmask_attention(q, k, v, idx, causal=False)
+    o_np = np.asarray(o)
+    assert np.all(o_np[:, 128:] == 0.0), "fully-masked rows must be 0"
+    assert np.any(o_np[:, :128] != 0.0)
+
+    g = jax.grad(lambda q: pallas_flashmask_attention(
+        q, k, v, idx, causal=False).sum())(q)
+    assert np.all(np.asarray(g)[:, 128:] == 0.0)
+
+
+def test_functional_entry_point_dense_fallback():
+    """nn.functional.flashmask_attention lowers through the dense path
+    off-TPU and matches the reference semantics."""
+    B, H, L, D = 1, 2, 128, 32          # ineligible shape -> dense
+    rng = np.random.RandomState(2)
+    q = paddle.to_tensor(rng.randn(B, L, H, D).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(B, L, H, D).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(B, L, H, D).astype(np.float32))
+    idx_np = _document_bounds(rng, L, 2, 1)
+    idx = paddle.to_tensor(
+        np.broadcast_to(idx_np[None, None], (B, 1, L, 1))
+        .astype(np.int32).copy())
+    from paddle_tpu.nn import functional as F
+    out = F.flashmask_attention(q, k, v, startend_row_indices=idx,
+                                causal=True)
+    ref = dense_ref(jnp.asarray(q.numpy()), jnp.asarray(k.numpy()),
+                    jnp.asarray(v.numpy()),
+                    jnp.asarray(idx.numpy()), True)
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="16k compact-form run needs the real kernel")
+def test_16k_document_mask_runs_without_dense_bias():
+    """At L=16384 the dense bias would be [B, Hm, L, L] f32 = 16 GB —
+    strictly impossible on one chip; the compact kernel must run."""
+    L, H, Hkv, D = 16384, 8, 4, 64
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, L, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(1, L, Hkv, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, L, Hkv, D), jnp.bfloat16)
+    docs = np.linspace(0, L, 9).astype(np.int32)
+    start = np.zeros(L, np.int32)
+    for a, b in zip(docs[:-1], docs[1:]):
+        start[a:b] = b
+    idx = jnp.asarray(start)[None, None, :, None]
+    o = jax.jit(lambda q, k, v: pallas_flashmask_attention(
+        q, k, v, idx, causal=True))(q, k, v)
+    assert o.shape == (1, L, H, D)
+    assert bool(jnp.all(jnp.isfinite(o.astype(jnp.float32))))
