@@ -61,6 +61,7 @@ from .framework.log import get_logger, logger, vlog
 from . import profiler
 from . import regularizer
 from . import sparse
+from . import geometric
 from . import audio
 from . import quantization
 from . import fft

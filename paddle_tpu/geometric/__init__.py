@@ -1,0 +1,117 @@
+"""``paddle.geometric`` — graph message-passing ops.
+
+Reference parity: ``python/paddle/geometric/`` (message_passing/
+send_recv, segment ops backed by ``paddle/phi/kernels/gpu/
+graph_send_recv_kernel.cu`` + ``segment_pool_kernel.cu``). TPU-first:
+every op lowers to ``jax.ops.segment_*`` — one gather plus one sorted
+segment reduction, which XLA turns into efficient batched
+gather/scatter on TPU; gradients come from jax's vjp rules for the
+same primitives (the reference hand-writes CUDA backward kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    ids = np.asarray(as_jax(segment_ids))
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+_REDUCERS = {"sum": jax.ops.segment_sum,
+             "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def _segment_reduce(data, ids, n, reduce_op):
+    """ONE home for every segment reduction in this module (segment_*
+    ops and the send_*_recv message reducers): sum/mean/max/min with
+    paddle's empty-segment convention (0, never +-inf or NaN)."""
+    ids = ids.astype(jnp.int32)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids,
+                                num_segments=n)
+        shape = [n] + [1] * (data.ndim - 1)
+        return s / jnp.maximum(c.reshape(shape), 1)
+    out = _REDUCERS[reduce_op](data, ids, num_segments=n)
+    if reduce_op in ("max", "min"):
+        counts = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32),
+                                     ids, num_segments=n)
+        shape = [n] + [1] * (data.ndim - 1)
+        out = jnp.where(counts.reshape(shape) > 0, out,
+                        jnp.zeros_like(out))
+    return out
+
+
+def _segment(name, reduce_op):
+    def op(data, segment_ids, name_arg=None, out_size=None):
+        n = _num_segments(segment_ids, out_size)
+        return apply_jax(
+            name, lambda d, ids: _segment_reduce(d, ids, n, reduce_op),
+            data, segment_ids)
+    op.__name__ = name
+    return op
+
+
+segment_sum = _segment("segment_sum", "sum")
+segment_mean = _segment("segment_mean", "mean")
+segment_max = _segment("segment_max", "max")
+segment_min = _segment("segment_min", "min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather ``x`` rows at ``src_index``, reduce them at ``dst_index``
+    (``graph_send_recv`` parity)."""
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size) if out_size is not None \
+        else int(as_jax(x).shape[0])
+
+    def f(x_a, src, dst):
+        msg = jnp.take(x_a, src.astype(jnp.int32), axis=0)
+        return _segment_reduce(msg, dst, n, reduce_op)
+    return apply_jax("send_u_recv", f, x, src_index, dst_index)
+
+
+_MSG_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Messages combine node features ``x[src]`` with edge features
+    ``y`` via ``message_op``, then reduce at ``dst_index``."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    if reduce_op not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    n = _num_segments(dst_index, out_size) if out_size is not None \
+        else int(as_jax(x).shape[0])
+
+    def f(x_a, y_a, src, dst):
+        msg = _MSG_OPS[message_op](
+            jnp.take(x_a, src.astype(jnp.int32), axis=0), y_a)
+        return _segment_reduce(msg, dst, n, reduce_op)
+    return apply_jax("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge messages ``message_op(x[src], y[dst])`` (no reduce)."""
+    if message_op not in _MSG_OPS:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+
+    def f(x_a, y_a, src, dst):
+        return _MSG_OPS[message_op](
+            jnp.take(x_a, src.astype(jnp.int32), axis=0),
+            jnp.take(y_a, dst.astype(jnp.int32), axis=0))
+    return apply_jax("send_uv", f, x, y, src_index, dst_index)

@@ -1,6 +1,7 @@
 """``paddle.nn`` namespace (``python/paddle/nn/__init__.py`` parity)."""
 from . import functional
 from . import initializer
+from . import quant
 from . import utils
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_, clip_grad_value_)
@@ -24,7 +25,8 @@ from .layer.layers import Layer
 from .layer.loss import (BCELoss, BCEWithLogitsLoss, CosineEmbeddingLoss,
                          AdaptiveLogSoftmaxWithLoss,
                          CrossEntropyLoss, CTCLoss, GaussianNLLLoss,
-                         HingeEmbeddingLoss, KLDivLoss,
+                         HingeEmbeddingLoss, HSigmoidLoss, KLDivLoss,
+                         RNNTLoss,
                          L1Loss, MarginRankingLoss, MSELoss,
                          MultiLabelSoftMarginLoss, NLLLoss, PoissonNLLLoss,
                          SmoothL1Loss, SoftMarginLoss, TripletMarginLoss)
@@ -40,7 +42,8 @@ from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,
                             MaxPool3D, MaxUnPool1D, MaxUnPool2D,
                             MaxUnPool3D)
 from .layer.rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell,
-                        RNNCellBase, SimpleRNN, SimpleRNNCell)
+                        RNNCellBase, SimpleRNN, SimpleRNNCell,
+                        BeamSearchDecoder, dynamic_decode)
 from .layer.transformer import (MultiHeadAttention, Transformer,
                                 TransformerDecoder, TransformerDecoderLayer,
                                 TransformerEncoder, TransformerEncoderLayer)

@@ -353,3 +353,56 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
     def predict(self, input):
         from ...ops.search import argmax
         return argmax(self.log_prob(input), axis=-1)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (``paddle.nn.HSigmoidLoss``):
+    owns the tree node weights/bias and defers to
+    ``F.hsigmoid_loss`` (default complete binary tree or a custom
+    tree via per-sample path_table/path_code inputs)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2 and not is_custom:
+            raise ValueError("num_classes must be >= 2")
+        self.feature_size = feature_size
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        from ..initializer import Normal
+        rows = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0 / np.sqrt(feature_size)))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [rows, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise ValueError(
+                "is_custom HSigmoidLoss needs path_table and path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (``paddle.nn.RNNTLoss``)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -323,3 +323,145 @@ class GRU(_RNNBase):
         super().__init__(input_size, hidden_size, num_layers, direction,
                          time_major, dropout, "tanh", weight_ih_attr,
                          weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell
+    (``paddle.nn.BeamSearchDecoder`` parity: seq2seq decoding where the
+    beam rides the batch dim as [batch * beam, ...]).
+
+    The decode loop itself lives in :func:`dynamic_decode`; this class
+    owns per-step beam bookkeeping (score accumulation, parent-beam
+    gather, end-token freezing)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B * beam, ...] by repeating each batch row."""
+        arr = as_jax(x)
+        tiled = jnp.repeat(arr, beam_size, axis=0)
+        return _wrap_out(tiled)
+
+    @staticmethod
+    def _map_arrays(fn, tree):
+        """tree_map that treats framework Tensors as LEAVES (Tensor is
+        pytree-registered, so a bare tree_map would descend into it and
+        re-wrap its data array)."""
+        from ...framework.core import Tensor as _T
+        return jax.tree_util.tree_map(
+            lambda a: fn(as_jax(a) if isinstance(a, _T) else a), tree,
+            is_leaf=lambda x: isinstance(x, _T))
+
+    def initialize(self, initial_cell_states):
+        """Returns (initial_inputs, initial_states, initial_finished)
+        with everything tiled to [B * beam, ...]; cell states are kept
+        as raw arrays between steps."""
+        if initial_cell_states is None or not jax.tree_util.tree_leaves(
+                initial_cell_states):
+            raise ValueError(
+                "BeamSearchDecoder needs initial cell states (pass the "
+                "encoder final states via dynamic_decode(inits=...))")
+        states = self._map_arrays(
+            lambda a: jnp.repeat(a, self.beam_size, axis=0),
+            initial_cell_states)
+        b = jax.tree_util.tree_leaves(states)[0].shape[0] \
+            // self.beam_size
+        ids = jnp.full((b * self.beam_size,), self.start_token,
+                       jnp.int64)
+        inputs = self.embedding_fn(_wrap_out(ids)) \
+            if self.embedding_fn else _wrap_out(ids)
+        # beam 0 live at 0.0, the rest at -inf so step one expands only
+        # the first beam of each batch row
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [-1e9] * (self.beam_size - 1),
+                      jnp.float32), (b,))
+        finished = jnp.zeros((b * self.beam_size,), bool)
+        return inputs, (states, log_probs, finished)
+
+    def step(self, time, inputs, states):
+        """One beam step. Returns (token_ids [B*K], next_inputs,
+        next_states, finished [B*K], parent_idx [B*K])."""
+        cell_states, log_probs, finished = states
+        out = self.cell(inputs,
+                        self._map_arrays(_wrap_out, cell_states))
+        outputs, new_cell_states = out
+        logits = self.output_fn(outputs) if self.output_fn else outputs
+        lp = jax.nn.log_softmax(as_jax(logits).astype(jnp.float32),
+                                axis=-1)                 # [B*K, V]
+        v = lp.shape[-1]
+        k = self.beam_size
+        b = lp.shape[0] // k
+        # frozen (finished) beams may only emit end_token at no cost
+        freeze = jnp.full((v,), -1e9).at[self.end_token].set(0.0)
+        lp = jnp.where(finished[:, None], freeze[None, :], lp)
+        total = log_probs[:, None] + lp                  # [B*K, V]
+        flat = total.reshape(b, k * v)
+        top_scores, top_idx = jax.lax.top_k(flat, k)     # [B, K]
+        parent = top_idx // v                            # beam within row
+        token = (top_idx % v).astype(jnp.int64)
+        parent_flat = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        token_flat = token.reshape(-1)
+        new_cell_states = self._map_arrays(
+            lambda a: jnp.take(a, parent_flat, axis=0),
+            new_cell_states)
+        new_finished = jnp.take(finished, parent_flat) \
+            | (token_flat == self.end_token)
+        next_inputs = self.embedding_fn(_wrap_out(token_flat)) \
+            if self.embedding_fn else _wrap_out(token_flat)
+        next_states = (new_cell_states, top_scores.reshape(-1),
+                       new_finished)
+        return (token_flat, next_inputs, next_states, new_finished,
+                parent_flat)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every beam emits its end token or
+    ``max_step_num`` is reached (``paddle.nn.dynamic_decode`` parity).
+    Returns ``(ids, final_states)`` where ids is
+    [B, beam, T] (or [T, B, beam] when ``output_time_major``); with
+    ``return_length`` a per-beam length tensor is appended."""
+    inputs, states = decoder.initialize(inits)
+    k = decoder.beam_size
+    steps = []
+    parents = []
+    finished = None
+    for t in range(int(max_step_num)):
+        token, inputs, states, finished, parent = decoder.step(
+            t, inputs, states)
+        steps.append(token)
+        parents.append(parent)
+        if bool(jnp.all(finished)):
+            break
+    # backtrack through parent pointers to recover each beam's sequence
+    n = len(steps)
+    bk = steps[0].shape[0]
+    seq = []
+    cursor = jnp.arange(bk)
+    for t in range(n - 1, -1, -1):
+        seq.append(jnp.take(steps[t], cursor))
+        cursor = jnp.take(parents[t], cursor)
+    seq = jnp.stack(seq[::-1], axis=1)                   # [B*K, T]
+    b = bk // k
+    ids = seq.reshape(b, k, n)
+    # length = position after the first end_token (inclusive)
+    is_end = ids == decoder.end_token
+    any_end = jnp.any(is_end, axis=-1)
+    first_end = jnp.argmax(is_end.astype(jnp.int32), axis=-1)
+    lengths = jnp.where(any_end, first_end + 1, n)
+    if output_time_major:
+        ids = jnp.moveaxis(ids, -1, 0)
+    out = (_wrap_out(ids), states)
+    if return_length:
+        out = out + (_wrap_out(lengths),)
+    return out

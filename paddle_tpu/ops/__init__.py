@@ -52,7 +52,7 @@ _METHODS = [
     "nan_to_num", "lerp", "inner", "outer", "kron", "trace", "scale",
     "increment", "addmm", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm",
     "diff", "angle", "conj", "real", "imag", "digamma", "lgamma", "neg",
-    "count_nonzero", "expm1", "exponential_", "gammaln", "isposinf",
+    "count_nonzero", "expm1", "exponential_", "gammaln", "isposinf", "igamma", "igammac",
     "isneginf", "isreal",
     # manipulation
     "reshape", "reshape_", "flatten", "flatten_", "transpose", "squeeze",

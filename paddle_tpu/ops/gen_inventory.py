@@ -51,6 +51,33 @@ def generate(out_path=None) -> str:
     for name, cat, sig, tm, ip in rows:
         sig = sig.replace("|", "\\|")
         lines.append(f"| `{name}` | {cat} | `{sig}` | {tm} | {ip} |")
+
+    # namespace ops: public callables living under paddle.<ns>.* rather
+    # than the flat tensor-op registry (the reference's ops.yaml count
+    # spans these too — fft, sparse, geometric, nn.functional, ...)
+    import importlib
+    ns_rows = []
+    for ns in ("fft", "signal", "sparse", "geometric", "linalg",
+               "nn.functional", "nn.quant", "incubate.nn.functional",
+               "vision.ops"):
+        try:
+            mod = importlib.import_module("paddle_tpu." + ns)
+        except Exception:
+            continue
+        names = [n for n in getattr(mod, "__all__", [])
+                 if callable(getattr(mod, n, None))]
+        for n in sorted(names):
+            ns_rows.append((ns, n))
+    lines += [
+        "",
+        f"**{len(ns_rows)} namespace ops** "
+        f"(total {len(rows) + len(ns_rows)})",
+        "",
+        "| namespace | op |",
+        "|---|---|",
+    ]
+    for ns, n in ns_rows:
+        lines.append(f"| {ns} | `{n}` |")
     text = "\n".join(lines) + "\n"
 
     if out_path is None:

@@ -33,7 +33,7 @@ __all__ = [
     "nextafter", "count_nonzero", "broadcast_shape", "log_normal",
     "trapezoid", "cumulative_trapezoid", "renorm", "signbit", "sinc",
     "nanquantile", "frexp", "polar", "logaddexp", "positive", "binomial",
-    "standard_gamma",
+    "standard_gamma", "igamma", "igammac",
 ]
 
 
@@ -136,6 +136,22 @@ def polygamma(x, n, name=None):
 def multigammaln(x, p, name=None):
     return apply_jax("multigammaln",
                      lambda a: jax.scipy.special.multigammaln(a, int(p)), x)
+
+
+def igamma(x, a, name=None):
+    """``paddle.igamma(x, a)`` — regularized UPPER incomplete gamma
+    Q(x, a) (paddle's convention: first arg is the shape parameter)."""
+    return apply_jax("igamma",
+                     lambda xx, aa: jax.scipy.special.gammaincc(xx, aa),
+                     x, a)
+
+
+def igammac(x, a, name=None):
+    """``paddle.igammac(x, a)`` — regularized LOWER incomplete gamma
+    P(x, a) (complement of ``igamma``)."""
+    return apply_jax("igammac",
+                     lambda xx, aa: jax.scipy.special.gammainc(xx, aa),
+                     x, a)
 
 
 def isnan(x, name=None):

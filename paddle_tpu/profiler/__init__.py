@@ -14,7 +14,8 @@ import time
 import jax
 
 __all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+           "SortedKeys", "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -87,6 +88,7 @@ class Profiler:
         self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
         self._log_dir = None
+        self._trace_dir = None      # survives stop() for summary/export
         self._running = False
         self._step = 0
         self._step_times = []
@@ -100,6 +102,7 @@ class Profiler:
                 "PADDLE_PROFILER_LOG_DIR", "./profiler_log")
             try:
                 jax.profiler.start_trace(self._log_dir)
+                self._trace_dir = self._log_dir
             except Exception:
                 self._log_dir = None
 
@@ -129,12 +132,77 @@ class Profiler:
         return (f"avg {ts.mean()*1000:.2f} ms/step, "
                 f"min {ts.min()*1000:.2f}, max {ts.max()*1000:.2f}")
 
+    # -- statistics (python/paddle/profiler/profiler_statistic.py) ----
+
+    def _op_records(self):
+        """Aggregate device-op durations from the captured xplane trace:
+        [(name, category, calls, total_ms)] sorted by total time."""
+        if self._trace_dir is None:
+            return []
+        return _parse_xplane_ops(self._trace_dir)
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        return self.step_info()
+        """Formatted statistic tables (``profiler_statistic`` parity):
+        step-time summary, device op summary (from the XLA trace), and
+        device memory summary."""
+        lines = []
+        # --- step summary
+        lines.append(_table(
+            "Step Summary",
+            ["stat", "value"],
+            [["steps", str(len(self._step_times))],
+             ["", self.step_info()]]))
+        # --- device op summary
+        ops = self._op_records() if op_detail else []
+        if ops:
+            total = sum(r[3] for r in ops) or 1.0
+            if sorted_by in (None, "total", SortedKeys.OpTotal):
+                ops = sorted(ops, key=lambda r: -r[3])
+            elif sorted_by in ("calls", SortedKeys.OpCalls):
+                ops = sorted(ops, key=lambda r: -r[2])
+            rows = [[name[:48], cat[:20], str(calls),
+                     f"{ms:.3f}", f"{ms / calls:.4f}",
+                     f"{100 * ms / total:.1f}%"]
+                    for name, cat, calls, ms in ops[:40]]
+            lines.append(_table(
+                "Device Op Summary (from XLA trace)",
+                ["name", "category", "calls", "total_ms", "avg_ms",
+                 "pct"], rows))
+        # --- memory summary
+        mem = _memory_stats()
+        if mem:
+            lines.append(_table(
+                "Device Memory Summary",
+                ["stat", "bytes"],
+                [[k, str(v)] for k, v in sorted(mem.items())]))
+        return "\n".join(lines)
 
     def export(self, path, format="json"):
-        pass
+        """Write the captured trace: ``format="json"`` emits a Chrome
+        trace (decompressed from the profiler's trace.json.gz);
+        ``format="summary"`` writes the summary tables; anything else
+        copies the raw TensorBoard trace directory path reference."""
+        if format == "summary":
+            with open(path, "w") as f:
+                f.write(self.summary())
+            return path
+        if self._trace_dir is None:
+            raise RuntimeError(
+                "no trace captured (timer_only profiler or start() "
+                "not called)")
+        src = _find_chrome_trace(self._trace_dir)
+        if src is None:
+            raise RuntimeError(
+                f"no chrome trace found under {self._trace_dir}")
+        import gzip
+        import shutil
+        if path.endswith(".gz"):
+            shutil.copyfile(src, path)
+        else:
+            with gzip.open(src, "rb") as fin, open(path, "wb") as fout:
+                shutil.copyfileobj(fin, fout)
+        return path
 
     def __enter__(self):
         self.start()
@@ -145,12 +213,116 @@ class Profiler:
         return False
 
 
+class SortedKeys(enum.Enum):
+    """``paddle.profiler.SortedKeys`` parity (subset)."""
+    OpTotal = 0
+    OpCalls = 1
+
+
+def _table(title, headers, rows):
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else
+              len(h) for i, h in enumerate(headers)]
+    sep = "-+-".join("-" * w for w in widths)
+    out = [title, sep,
+           " | ".join(h.ljust(w) for h, w in zip(headers, widths)),
+           sep]
+    for r in rows:
+        out.append(" | ".join(c.ljust(w) for c, w in zip(r, widths)))
+    out.append(sep)
+    return "\n".join(out)
+
+
+def _memory_stats():
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return {}
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+        return {k: v for k, v in stats.items() if k in keep}
+    except Exception:
+        return {}
+
+
+def _find_chrome_trace(log_dir):
+    import glob
+    hits = sorted(glob.glob(os.path.join(
+        log_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    return hits[-1] if hits else None
+
+
+def _parse_xplane_ops(log_dir):
+    """Aggregate the trace's device-op events into
+    [(name, category, calls, total_ms)]. Uses the xplane proto bundled
+    with tensorflow's tsl; returns [] when unavailable."""
+    import glob
+    import re
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception:
+        return []
+    paths = sorted(glob.glob(os.path.join(
+        log_dir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not paths:
+        return []
+    sp = xplane_pb2.XSpace()
+    try:
+        with open(paths[-1], "rb") as f:
+            sp.ParseFromString(f.read())
+    except Exception:
+        return []
+    agg = {}
+
+    def _consume(plane, line_filter):
+        em = plane.event_metadata
+        found = False
+        for line in plane.lines:
+            if line_filter and line.name != line_filter:
+                continue
+            for ev in line.events:
+                meta = em.get(ev.metadata_id)
+                name = meta.name if meta is not None else "?"
+                base = re.sub(r"\.\d+$", "",
+                              name.split(" ")[0].lstrip("%"))
+                cat = re.sub(r"\.\d+$", "", base.split("=")[0]).strip()
+                calls, ms = agg.get((name, cat), (0, 0.0))
+                agg[(name, cat)] = (calls + 1,
+                                    ms + ev.duration_ps / 1e9)
+                found = True
+        return found
+
+    got = False
+    for plane in sp.planes:
+        if "TPU" in plane.name or "GPU" in plane.name:
+            got |= _consume(plane, "XLA Ops")
+    if not got:                      # CPU backend: take host events
+        for plane in sp.planes:
+            if "CPU" in plane.name or "Host" in plane.name:
+                _consume(plane, None)
+    return [(name, cat, calls, ms)
+            for (name, cat), (calls, ms) in agg.items()]
+
+
 def export_chrome_tracing(dir_name, worker_name=None):
-    def handler(prof):
-        pass
+    """Trace-ready handler (``export_chrome_tracing`` parity): traces
+    land under ``dir_name`` and a decompressed Chrome trace json is
+    written there when the profiler stops."""
     os.environ["PADDLE_PROFILER_LOG_DIR"] = dir_name
+
+    def handler(prof):
+        try:
+            os.makedirs(dir_name, exist_ok=True)
+            name = worker_name or "worker"
+            prof.export(os.path.join(dir_name, f"{name}.json"))
+        except Exception:
+            pass
     return handler
 
 
 def load_profiler_result(path):
-    raise NotImplementedError("use TensorBoard to view TPU traces")
+    """Load an exported Chrome trace json back as a dict."""
+    import gzip
+    import json as _json
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        return _json.load(f)

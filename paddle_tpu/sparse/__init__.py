@@ -18,8 +18,8 @@ from jax.experimental import sparse as jsparse
 from ..framework.core import Tensor, as_jax, _wrap_out
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "add", "multiply", "matmul", "masked_matmul", "relu",
-           "is_same_shape", "nn"]
+           "SparseCsrTensor", "add", "multiply", "matmul",
+           "masked_matmul", "mask_as", "relu", "is_same_shape", "nn"]
 
 
 class SparseCooTensor:
@@ -84,15 +84,64 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
     return SparseCooTensor(bcoo)
 
 
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view (``paddle.sparse.sparse_csr_tensor`` parity): keeps the
+    crows/cols arrays for accessor parity while compute rides the same
+    BCOO representation as COO (XLA has one good sparse format; two
+    storage layouts with separate kernels would be the CUDA design)."""
+
+    def __init__(self, bcoo, crows, cols):
+        super().__init__(bcoo)
+        self._crows = crows
+        self._cols = cols
+
+    def crows(self):
+        return _wrap_out(self._crows)
+
+    def cols(self):
+        return _wrap_out(self._cols)
+
+    def is_sparse_csr(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, "
+                f"nnz={self.nnz()})")
+
+
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    crows_np = np.asarray(as_jax(crows) if isinstance(crows, Tensor)
-                          else crows)
-    cols_np = np.asarray(as_jax(cols) if isinstance(cols, Tensor)
-                         else cols)
+    crows_j = as_jax(crows) if isinstance(crows, Tensor) \
+        else jnp.asarray(np.asarray(crows))
+    cols_j = as_jax(cols) if isinstance(cols, Tensor) \
+        else jnp.asarray(np.asarray(cols))
+    crows_np = np.asarray(crows_j)
+    cols_np = np.asarray(cols_j)
     rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
     indices = np.stack([rows, cols_np])
-    return sparse_coo_tensor(indices, values, shape, dtype=dtype)
+    coo = sparse_coo_tensor(indices, values, shape, dtype=dtype)
+    return SparseCsrTensor(coo._bcoo, crows_j.astype(jnp.int64),
+                           cols_j.astype(jnp.int64))
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern, returning a
+    sparse tensor of the mask's format (``paddle.sparse.mask_as``)."""
+    xa = as_jax(x) if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = mask._bcoo.indices
+    vals = xa[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    bcoo = jsparse.BCOO((vals.astype(mask._bcoo.data.dtype), idx),
+                        shape=tuple(mask.shape))
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(bcoo, as_jax(mask._crows),
+                               as_jax(mask._cols))
+    return SparseCooTensor(bcoo)
 
 
 def is_same_shape(x, y):

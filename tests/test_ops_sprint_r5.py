@@ -1,0 +1,259 @@
+"""Round-5 op-surface sprint tests: paddle.geometric, igamma/igammac,
+sparse mask_as/CSR, HSigmoidLoss / RNNTLoss / BeamSearchDecoder layer
+classes, and nn.quant weight-only int8.
+
+References: ``python/paddle/geometric/``, ``paddle/phi/kernels/sparse/``,
+``python/paddle/nn/layer/loss.py``, ``python/paddle/nn/decode.py``,
+``python/paddle/nn/quant/quantized_linear.py``.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- geometric
+
+def test_segment_ops_oracle():
+    data = np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], np.float32)
+    ids = np.array([0, 0, 1, 3], np.int64)          # segment 2 empty
+    d = paddle.to_tensor(data)
+    i = paddle.to_tensor(ids)
+    s = paddle.geometric.segment_sum(d, i)
+    np.testing.assert_allclose(
+        s.numpy(), [[4., 6.], [5., 6.], [0., 0.], [7., 8.]])
+    m = paddle.geometric.segment_mean(d, i)
+    np.testing.assert_allclose(
+        m.numpy(), [[2., 3.], [5., 6.], [0., 0.], [7., 8.]])
+    mx = paddle.geometric.segment_max(d, i)
+    np.testing.assert_allclose(
+        mx.numpy(), [[3., 4.], [5., 6.], [0., 0.], [7., 8.]])
+    mn = paddle.geometric.segment_min(d, i)
+    np.testing.assert_allclose(
+        mn.numpy(), [[1., 2.], [5., 6.], [0., 0.], [7., 8.]])
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(
+        np.arange(8, dtype=np.float32).reshape(4, 2))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 1, 1, 2], np.int64))
+    out = paddle.geometric.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(data.grad.numpy(), np.ones((4, 2)))
+
+
+def test_send_u_recv_oracle():
+    x = np.array([[1.], [2.], [4.]], np.float32)
+    src = np.array([0, 1, 2, 0], np.int64)
+    dst = np.array([1, 2, 1, 0], np.int64)
+    out = paddle.geometric.send_u_recv(
+        paddle.to_tensor(x), paddle.to_tensor(src),
+        paddle.to_tensor(dst), reduce_op="sum")
+    # dst 0 <- x[0]=1; dst 1 <- x[0]+x[2]=5; dst 2 <- x[1]=2
+    np.testing.assert_allclose(out.numpy(), [[1.], [5.], [2.]])
+    out_max = paddle.geometric.send_u_recv(
+        paddle.to_tensor(x), paddle.to_tensor(src),
+        paddle.to_tensor(dst), reduce_op="max")
+    np.testing.assert_allclose(out_max.numpy(), [[1.], [4.], [2.]])
+
+
+def test_send_ue_recv_and_send_uv():
+    x = np.array([[1.], [2.], [3.]], np.float32)
+    e = np.array([[10.], [20.], [30.]], np.float32)
+    src = np.array([0, 1, 2], np.int64)
+    dst = np.array([1, 2, 0], np.int64)
+    out = paddle.geometric.send_ue_recv(
+        paddle.to_tensor(x), paddle.to_tensor(e),
+        paddle.to_tensor(src), paddle.to_tensor(dst),
+        message_op="add", reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[33.], [11.], [22.]])
+    uv = paddle.geometric.send_uv(
+        paddle.to_tensor(x), paddle.to_tensor(x),
+        paddle.to_tensor(src), paddle.to_tensor(dst),
+        message_op="mul")
+    np.testing.assert_allclose(uv.numpy(), [[2.], [6.], [3.]])
+
+
+# ------------------------------------------------------------------- igamma
+
+def test_igamma_igammac():
+    from scipy import special
+    x = np.array([0.5, 1.0, 2.0, 5.0], np.float32)
+    a = np.array([1.0, 2.0, 1.5, 3.0], np.float32)
+    up = paddle.igamma(paddle.to_tensor(x), paddle.to_tensor(a))
+    lo = paddle.igammac(paddle.to_tensor(x), paddle.to_tensor(a))
+    np.testing.assert_allclose(up.numpy(), special.gammaincc(x, a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(lo.numpy(), special.gammainc(x, a),
+                               rtol=1e-5)
+    np.testing.assert_allclose(up.numpy() + lo.numpy(),
+                               np.ones_like(x), rtol=1e-5)
+
+
+# ------------------------------------------------------------------- sparse
+
+def test_sparse_mask_as_coo_and_csr():
+    dense = paddle.to_tensor(
+        np.arange(12, dtype=np.float32).reshape(3, 4))
+    coo = paddle.sparse.sparse_coo_tensor(
+        [[0, 1, 2], [1, 2, 3]], [1., 1., 1.], (3, 4))
+    m = paddle.sparse.mask_as(dense, coo)
+    np.testing.assert_allclose(np.asarray(m.values().numpy()),
+                               [1., 6., 11.])
+    csr = paddle.sparse.sparse_csr_tensor(
+        [0, 1, 2, 3], [1, 2, 3], [1., 1., 1.], (3, 4))
+    assert csr.is_sparse_csr()
+    m2 = paddle.sparse.mask_as(dense, csr)
+    assert m2.is_sparse_csr()
+    np.testing.assert_allclose(np.asarray(m2.values().numpy()),
+                               [1., 6., 11.])
+    np.testing.assert_allclose(np.asarray(m2.crows().numpy()),
+                               [0, 1, 2, 3])
+    np.testing.assert_allclose(m2.to_dense().numpy(),
+                               dense.numpy() * coo.to_dense().numpy())
+
+
+# ----------------------------------------------------------- HSigmoidLoss
+
+def test_hsigmoid_loss_layer():
+    paddle.seed(0)
+    layer = paddle.nn.HSigmoidLoss(feature_size=8, num_classes=6)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 2, 4, 5], np.int64))
+    out = layer(x, y)
+    assert tuple(out.shape) == (4, 1)
+    assert np.all(np.isfinite(out.numpy())) and np.all(out.numpy() > 0)
+    # trainable: loss reduces under SGD on the layer params
+    x.stop_gradient = False
+    out.sum().backward()
+    assert layer.weight.grad is not None
+
+
+# -------------------------------------------------------------- RNNT loss
+
+def _rnnt_ref(logits, labels, t_len, u_len, blank=0, femit=0.0):
+    """Brute numpy forward-variable DP (log-space)."""
+    def lse(a, b):
+        m = max(a, b)
+        if m == -np.inf:
+            return -np.inf
+        return m + np.log(np.exp(a - m) + np.exp(b - m))
+    B = logits.shape[0]
+    out = []
+    for b in range(B):
+        T, U1 = t_len[b], u_len[b] + 1
+        lp = logits[b] - np.log(
+            np.exp(logits[b]).sum(-1, keepdims=True))
+        if femit:
+            lp = lp.copy()
+        alpha = np.full((T, U1), -np.inf)
+        alpha[0, 0] = 0.0
+        for t in range(T):
+            for u in range(U1):
+                if t > 0:
+                    alpha[t, u] = lse(alpha[t, u],
+                                      alpha[t - 1, u]
+                                      + lp[t - 1, u, blank])
+                if u > 0:
+                    em = lp[t, u - 1, labels[b, u - 1]] \
+                        + (np.log1p(femit) if femit else 0.0)
+                    alpha[t, u] = lse(alpha[t, u],
+                                      alpha[t, u - 1] + em)
+        out.append(-(alpha[T - 1, U1 - 1]
+                     + lp[T - 1, U1 - 1, blank]))
+    return np.array(out, np.float32)
+
+
+def test_rnnt_loss_matches_reference_dp():
+    rng = np.random.RandomState(0)
+    B, T, U, V = 3, 5, 3, 7
+    logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+    labels = rng.randint(1, V, (B, U)).astype(np.int64)
+    t_len = np.array([5, 4, 3], np.int64)
+    u_len = np.array([3, 2, 3], np.int64)
+    ref = _rnnt_ref(logits, labels, t_len, u_len)
+    out = paddle.nn.functional.rnnt_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(t_len), paddle.to_tensor(u_len),
+        fastemit_lambda=0.0, reduction="none")
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+    # layer wrapper + mean reduction + differentiability
+    layer = paddle.nn.RNNTLoss(fastemit_lambda=0.0)
+    lt = paddle.to_tensor(logits)
+    lt.stop_gradient = False
+    loss = layer(lt, paddle.to_tensor(labels), paddle.to_tensor(t_len),
+                 paddle.to_tensor(u_len))
+    np.testing.assert_allclose(float(loss.numpy()), ref.mean(),
+                               rtol=1e-4)
+    loss.backward()
+    assert lt.grad is not None
+    assert np.all(np.isfinite(lt.grad.numpy()))
+
+
+# ------------------------------------------------------ BeamSearchDecoder
+
+def test_beam_search_decoder_dynamic_decode():
+    paddle.seed(7)
+    V, H, B, K = 12, 16, 2, 3
+    cell = paddle.nn.LSTMCell(H, H)
+    emb = paddle.nn.Embedding(V, H)
+    proj = paddle.nn.Linear(H, V)
+    dec = paddle.nn.BeamSearchDecoder(
+        cell, start_token=1, end_token=2, beam_size=K,
+        embedding_fn=emb, output_fn=proj)
+    h0 = paddle.to_tensor(
+        np.random.RandomState(1).randn(B, H).astype(np.float32))
+    c0 = paddle.zeros([B, H])
+    ids, states, lengths = paddle.nn.dynamic_decode(
+        dec, inits=(h0, c0), max_step_num=8, return_length=True)
+    assert tuple(ids.shape)[0] == B and tuple(ids.shape)[1] == K
+    assert tuple(ids.shape)[2] <= 8
+    ln = lengths.numpy()
+    assert ln.shape == (B, K) and np.all(ln >= 1)
+    # every finished beam's sequence ends with the end token
+    arr = ids.numpy()
+    for b in range(B):
+        for k in range(K):
+            if ln[b, k] < arr.shape[-1]:
+                assert arr[b, k, ln[b, k] - 1] == 2
+
+
+# ---------------------------------------------------------------- nn.quant
+
+def test_weight_quantize_and_linear():
+    rng = np.random.RandomState(0)
+    W = paddle.to_tensor(rng.randn(64, 32).astype(np.float32))
+    x = paddle.to_tensor(rng.randn(4, 64).astype(np.float32))
+    qw, s = paddle.nn.quant.weight_quantize(W, "weight_only_int8")
+    assert qw.numpy().dtype == np.int8
+    y = paddle.nn.quant.weight_only_linear(x, qw, None, s)
+    ref = x.numpy() @ W.numpy()
+    rel = np.max(np.abs(y.numpy() - ref)) / np.max(np.abs(ref))
+    assert rel < 0.02
+    deq = paddle.nn.quant.weight_dequantize(qw, s, out_dtype="float32")
+    rel_w = np.max(np.abs(deq.numpy() - W.numpy())) \
+        / np.max(np.abs(W.numpy()))
+    assert rel_w < 0.01
+
+
+def test_quantize_for_inference_swaps_and_generates():
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64,
+                      intermediate_size=96, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (2, 8)).astype(np.int64))
+    out_ref, _ = m.generate(ids, max_new_tokens=4)   # warm the cache
+    n = paddle.nn.quant.quantize_for_inference(m)
+    assert n >= 10                                   # all proj layers
+    out_q, _ = m.generate(ids, max_new_tokens=4)     # stale cache purged
+    assert out_q.numpy().shape == out_ref.numpy().shape
