@@ -89,27 +89,39 @@ struct Server {
   // master preloads the file, so liveness/metadata survive rank-0 death.
   std::string snapshot_path;
 
+  // Snapshot I/O must NOT run under `mu`: an fsync there blocks every
+  // concurrent get/wait behind disk latency (heartbeat-heavy elastic
+  // jobs make that visible). Mutators serialize the map to a memory
+  // buffer under `mu` (cheap memcpy) and write the file under a
+  // dedicated `persist_mu` after releasing `mu`; `persist_mu` keeps
+  // whole snapshots ordered so a slow writer can't interleave with a
+  // later one.
+  std::mutex persist_mu;
+
   // Format: u64 count, then per entry u32 klen, key, u64 vlen, val.
-  void persist_locked() {
-    if (snapshot_path.empty()) return;
-    std::string tmp = snapshot_path + ".tmp";
-    FILE* f = std::fopen(tmp.c_str(), "wb");
-    if (!f) return;
-    bool ok = true;
-    auto w = [&](const void* p, size_t sz, size_t cnt) {
-      if (ok && std::fwrite(p, sz, cnt, f) != cnt) ok = false;
-    };
+  std::string serialize_locked() const {
+    std::string buf;
     uint64_t n = kv.size();
-    w(&n, 8, 1);
+    buf.append(reinterpret_cast<const char*>(&n), 8);
     for (const auto& it : kv) {
       uint32_t klen = static_cast<uint32_t>(it.first.size());
       uint64_t vlen = it.second.size();
-      w(&klen, 4, 1);
-      w(it.first.data(), 1, klen);
-      w(&vlen, 8, 1);
-      if (vlen) w(it.second.data(), 1, vlen);
+      buf.append(reinterpret_cast<const char*>(&klen), 4);
+      buf.append(it.first.data(), klen);
+      buf.append(reinterpret_cast<const char*>(&vlen), 8);
+      if (vlen) buf.append(it.second.data(), vlen);
     }
-    if (std::fflush(f) != 0) ok = false;
+    return buf;
+  }
+
+  void persist_buffer(const std::string& buf) {
+    if (snapshot_path.empty()) return;
+    std::lock_guard<std::mutex> pg(persist_mu);
+    std::string tmp = snapshot_path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return;
+    bool ok = std::fwrite(buf.data(), 1, buf.size(), f) == buf.size();
+    if (ok && std::fflush(f) != 0) ok = false;
     if (ok) ok = ::fsync(fileno(f)) == 0;
     if (std::fclose(f) != 0) ok = false;
     // only replace the last good snapshot with a fully written one —
@@ -175,12 +187,14 @@ struct Server {
         if (!recv_all(fd, &vlen, 8) || vlen > kMaxValLen) break;
         std::vector<char> val(vlen);
         if (vlen && !recv_all(fd, val.data(), vlen)) break;
+        std::string snap;
         {
           std::lock_guard<std::mutex> g(mu);
           kv[key] = std::move(val);
-          persist_locked();
+          if (!snapshot_path.empty()) snap = serialize_locked();
         }
         cv.notify_all();
+        if (!snap.empty()) persist_buffer(snap);
         uint8_t st = kOk;
         if (!send_all(fd, &st, 1)) break;
       } else if (cmd == kGet || cmd == kWait || cmd == kTryGet) {
@@ -218,6 +232,7 @@ struct Server {
         int64_t delta = 0;
         if (!recv_all(fd, &delta, 8)) break;
         int64_t result;
+        std::string snap_add;
         {
           std::lock_guard<std::mutex> g(mu);
           int64_t cur = 0;
@@ -228,19 +243,22 @@ struct Server {
           std::vector<char> v(8);
           memcpy(v.data(), &cur, 8);
           kv[key] = std::move(v);
-          persist_locked();
+          if (!snapshot_path.empty()) snap_add = serialize_locked();
           result = cur;
         }
         cv.notify_all();
+        if (!snap_add.empty()) persist_buffer(snap_add);
         uint8_t st = kOk;
         if (!send_all(fd, &st, 1) || !send_all(fd, &result, 8)) break;
       } else if (cmd == kDelete) {
         size_t n;
+        std::string snap_del;
         {
           std::lock_guard<std::mutex> g(mu);
           n = kv.erase(key);
-          if (n) persist_locked();
+          if (n && !snapshot_path.empty()) snap_del = serialize_locked();
         }
+        if (!snap_del.empty()) persist_buffer(snap_del);
         uint8_t st = n ? kOk : kMissing;
         if (!send_all(fd, &st, 1)) break;
       } else if (cmd == kNumKeys) {

@@ -267,6 +267,13 @@ class DistributedEmbedding:
             # eval/no_grad pulls are not recorded (unbounded growth)
             local.stop_gradient = False
             self._pending.append((uniq, local))
+            if len(self._pending) > 64:
+                import warnings
+                warnings.warn(
+                    "DistributedEmbedding: %d pulled batches pending — "
+                    "call push_grads() each step (dropping the oldest "
+                    "to bound memory)" % len(self._pending))
+                self._pending.pop(0)
         from ...ops.manipulation import gather, reshape
         out = gather(local, Tensor(jnp.asarray(inverse)))
         return reshape(out, list(ids_np.shape) + [self.dim])

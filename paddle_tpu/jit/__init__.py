@@ -31,6 +31,27 @@ _JIT_CACHE_WARN = 32    # warn once past this many live specializations
 _GUARD_MISS = object()  # sentinel: name absent (vs a None value)
 
 
+def _guarded_name_sets(code):
+    """(global_names, self_attr_names) actually loaded by ``code`` —
+    LOAD_GLOBAL targets, and LOAD_ATTR names whose receiver is the
+    frame's ``self``. Falls back to co_names for both when dis fails."""
+    import dis
+    g_names, a_names = set(), set()
+    try:
+        prev = None
+        for ins in dis.get_instructions(code):
+            if ins.opname == "LOAD_GLOBAL":
+                g_names.add(ins.argval)
+            elif ins.opname == "LOAD_ATTR" and prev is not None \
+                    and prev.opname == "LOAD_FAST" \
+                    and prev.argval == "self":
+                a_names.add(ins.argval)
+            prev = ins
+    except Exception:
+        g_names = a_names = set(code.co_names)
+    return g_names, a_names
+
+
 def enable_to_static(flag: bool):
     global _to_static_enabled
     _to_static_enabled = bool(flag)
@@ -137,13 +158,19 @@ class StaticFunction:
                             continue
                         if isinstance(v, self._GUARDABLE):
                             plan.append(("c", i, name))
+                # bytecode-accurate name sets: co_names also contains
+                # pure attribute names of OTHER objects; guarding on
+                # those would add spurious cache-key entries and
+                # avoidable retraces. Scan the actual LOAD_GLOBAL ops
+                # and LOAD_ATTRs whose receiver is `self`.
+                g_names, a_names = _guarded_name_sets(code)
                 g = getattr(fn, "__globals__", {})
-                for name in code.co_names:
+                for name in sorted(g_names):
                     if isinstance(g.get(name, _GUARD_MISS),
                                   self._GUARDABLE):
                         plan.append(("g", 0, name))
                 if self._layer is not None:
-                    for name in code.co_names:
+                    for name in sorted(a_names):
                         try:
                             v = getattr(self._layer, name, _GUARD_MISS)
                         except Exception:
