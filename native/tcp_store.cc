@@ -97,6 +97,8 @@ struct Server {
   // whole snapshots ordered so a slow writer can't interleave with a
   // later one.
   std::mutex persist_mu;
+  uint64_t snap_seq = 0;              // stamped under mu
+  uint64_t last_persisted_seq = 0;    // guarded by persist_mu
 
   // Format: u64 count, then per entry u32 klen, key, u64 vlen, val.
   std::string serialize_locked() const {
@@ -114,9 +116,13 @@ struct Server {
     return buf;
   }
 
-  void persist_buffer(const std::string& buf) {
+  void persist_buffer(uint64_t seq, const std::string& buf) {
     if (snapshot_path.empty()) return;
     std::lock_guard<std::mutex> pg(persist_mu);
+    // a later mutation's snapshot may have won the race for persist_mu
+    // already; writing this OLDER one over it would resurrect stale
+    // state after an acked newer write (lost durability) — skip it
+    if (seq <= last_persisted_seq) return;
     std::string tmp = snapshot_path + ".tmp";
     FILE* f = std::fopen(tmp.c_str(), "wb");
     if (!f) return;
@@ -126,10 +132,12 @@ struct Server {
     if (std::fclose(f) != 0) ok = false;
     // only replace the last good snapshot with a fully written one —
     // a short write (ENOSPC, I/O error) must not destroy prior state
-    if (ok)
+    if (ok) {
       std::rename(tmp.c_str(), snapshot_path.c_str());
-    else
+      last_persisted_seq = seq;
+    } else {
       std::remove(tmp.c_str());
+    }
   }
 
   void preload() {
@@ -188,13 +196,17 @@ struct Server {
         std::vector<char> val(vlen);
         if (vlen && !recv_all(fd, val.data(), vlen)) break;
         std::string snap;
+        uint64_t seq = 0;
         {
           std::lock_guard<std::mutex> g(mu);
           kv[key] = std::move(val);
-          if (!snapshot_path.empty()) snap = serialize_locked();
+          if (!snapshot_path.empty()) {
+            snap = serialize_locked();
+            seq = ++snap_seq;
+          }
         }
         cv.notify_all();
-        if (!snap.empty()) persist_buffer(snap);
+        if (!snap.empty()) persist_buffer(seq, snap);
         uint8_t st = kOk;
         if (!send_all(fd, &st, 1)) break;
       } else if (cmd == kGet || cmd == kWait || cmd == kTryGet) {
@@ -233,6 +245,7 @@ struct Server {
         if (!recv_all(fd, &delta, 8)) break;
         int64_t result;
         std::string snap_add;
+        uint64_t seq_add = 0;
         {
           std::lock_guard<std::mutex> g(mu);
           int64_t cur = 0;
@@ -243,22 +256,29 @@ struct Server {
           std::vector<char> v(8);
           memcpy(v.data(), &cur, 8);
           kv[key] = std::move(v);
-          if (!snapshot_path.empty()) snap_add = serialize_locked();
+          if (!snapshot_path.empty()) {
+            snap_add = serialize_locked();
+            seq_add = ++snap_seq;
+          }
           result = cur;
         }
         cv.notify_all();
-        if (!snap_add.empty()) persist_buffer(snap_add);
+        if (!snap_add.empty()) persist_buffer(seq_add, snap_add);
         uint8_t st = kOk;
         if (!send_all(fd, &st, 1) || !send_all(fd, &result, 8)) break;
       } else if (cmd == kDelete) {
         size_t n;
         std::string snap_del;
+        uint64_t seq_del = 0;
         {
           std::lock_guard<std::mutex> g(mu);
           n = kv.erase(key);
-          if (n && !snapshot_path.empty()) snap_del = serialize_locked();
+          if (n && !snapshot_path.empty()) {
+            snap_del = serialize_locked();
+            seq_del = ++snap_seq;
+          }
         }
-        if (!snap_del.empty()) persist_buffer(snap_del);
+        if (!snap_del.empty()) persist_buffer(seq_del, snap_del);
         uint8_t st = n ? kOk : kMissing;
         if (!send_all(fd, &st, 1)) break;
       } else if (cmd == kNumKeys) {

@@ -45,15 +45,17 @@ def build_beam_run(model_step, init_caches, b, prompt_len, max_new, *,
             f"num_beam_groups ({G})")
     BGK = b * G * K
     alpha = float(length_penalty)
-    if alpha < 0:
+    if alpha < 0 and not early_stopping:
         # the non-early-stopping exit bound divides the best live score
         # by lp(max_new) as an optimistic ceiling; with a DECREASING lp
         # (negative alpha) that bound inverts and the loop could stop
-        # on a suboptimal hypothesis — refuse, like the other
-        # inapplicable-option guards in this builder
+        # on a suboptimal hypothesis. early_stopping=True never uses
+        # this bound, so negative penalties stay allowed there
+        # (PaddleNLP/HF accept them to favor short outputs)
         raise ValueError(
-            f"length_penalty must be >= 0 (got {alpha}): the early-exit "
-            "bound assumes a non-decreasing length penalty")
+            f"length_penalty must be >= 0 (got {alpha}) unless "
+            "early_stopping=True: the early-exit bound assumes a "
+            "non-decreasing length penalty")
     div = float(diversity_rate)
 
     def lp(length):

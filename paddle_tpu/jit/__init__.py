@@ -354,10 +354,27 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True, **kwargs):
-    """``paddle.jit.to_static`` — wrap a Layer or function for XLA compile."""
+    """``paddle.jit.to_static`` — wrap a Layer or function for XLA
+    compile. ``full_graph=True`` (default) uses the AST/dy2static tier
+    (whole-function jit with converted control flow);
+    ``full_graph=False`` uses the SOT bytecode-capture tier
+    (``jit/sot/``): sub-graph compilation with graph-break fallback
+    mid-function, matching the reference's default mode."""
 
     def decorate(obj):
         from ..nn.layer.layers import Layer
+        if not full_graph:
+            from .sot import symbolic_translate
+            if input_spec is not None:
+                import warnings
+                warnings.warn(
+                    "to_static(full_graph=False): input_spec is an "
+                    "AOT-export concept and is ignored by the SOT "
+                    "bytecode tier (shapes are guarded per call)")
+            if isinstance(obj, Layer):
+                obj.forward = symbolic_translate(obj.forward)
+                return obj
+            return symbolic_translate(obj)
         if isinstance(obj, Layer):
             static_fwd = StaticFunction(obj.forward, layer=obj,
                                         input_spec=input_spec)

@@ -1,0 +1,214 @@
+"""``paddle.jit.sot`` — symbolic opcode translation.
+
+Reference parity: ``python/paddle/jit/sot/`` (``symbolic_translate``,
+``BreakGraphError``/fallback semantics, guard-invalidation retracing)
+with the frame-eval hook of ``paddle/fluid/pybind/jit.cc`` replaced by
+a pure-Python bytecode VM (see ``opcode_translator.py`` for the
+design).
+
+Execution tiers per call:
+1. FAST PATH — a previous simulation captured the whole function as
+   one sub-graph whose inputs are all function arguments: re-bind the
+   arguments, run the cached ``jax.jit`` program. Taken only while the
+   guard tuple (closure/global/layer scalars read by the bytecode) and
+   the input signature both match; a guard change invalidates it and
+   re-simulates (observable via ``stats()["simulations"]``).
+2. SIMULATION — run the VM: tensor ops record onto segment tapes,
+   data-dependent branches flush (compile+run) the pending sub-graph
+   and continue, so one function can span several compiled sub-graphs
+   with eager glue between them.
+3. EAGER FALLBACK — :class:`SotUnsupported` constructs (generators,
+   try/except, with-blocks, ...) mark the function and every later
+   call runs plain Python (the clean whole-frame graph break).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from .opcode_translator import (SotUnsupported, TensorVar, _Simulator,
+                                _bind_args)
+from ...framework.core import Tensor, as_jax, _wrap_out
+
+__all__ = ["symbolic_translate", "SotUnsupported", "sot_report"]
+
+
+_TRANSLATORS = []
+
+
+def _guard_values(fn):
+    """(name, value) pairs for guardable scalars the bytecode reads —
+    shares the LOAD_GLOBAL/closure scan with the jit guard plan."""
+    from .. import _guarded_name_sets
+    guardable = (int, float, bool, str, type(None))
+    out = []
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return ()
+    if getattr(fn, "__closure__", None):
+        for name, cell in zip(code.co_freevars, fn.__closure__):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if isinstance(v, guardable):
+                out.append(("c:" + name, v))
+    g_names, _ = _guarded_name_sets(code)
+    g = getattr(fn, "__globals__", {})
+    for name in sorted(g_names):
+        v = g.get(name, _MISS)
+        if isinstance(v, guardable):
+            out.append(("g:" + name, v))
+    return tuple(out)
+
+
+_MISS = object()
+
+
+class SymbolicTranslator:
+    def __init__(self, fn):
+        functools.update_wrapper(self, fn)
+        self.fn = fn
+        self.segment_cache: Dict[Any, Any] = {}
+        self._stats = {"simulations": 0, "segments_compiled": 0,
+                       "segments_executed": 0, "graph_breaks": 0,
+                       "eager_calls": 0, "fast_hits": 0,
+                       "fallback_calls": 0}
+        self._unsupported: Optional[str] = None
+        self._fast_plan = None      # (guards, sig, key, sources, tmpl)
+        _TRANSLATORS.append(self)
+
+    def stats(self):
+        return dict(self._stats)
+
+    # ------------------------------------------------------ fast path
+
+    def _arg_tensors(self, args, kwargs):
+        bound = _bind_args(self.fn, args, kwargs)
+        tensors = {k: v for k, v in bound.items()
+                   if isinstance(v, Tensor)}
+        # the signature covers EVERY argument: non-tensor values are
+        # baked into the captured program as constants (loop bounds,
+        # flags, strings), so a changed scalar must miss the fast path
+        sig_items = []
+        for k, v in sorted(bound.items()):
+            if isinstance(v, Tensor):
+                sig_items.append((k, "t", tuple(v.shape),
+                                  str(v.dtype)))
+            else:
+                try:
+                    sig_items.append((k, "v", repr(v)))
+                except Exception:
+                    sig_items.append((k, "v", object()))  # never match
+        return bound, tensors, tuple(sig_items)
+
+    def _try_fast(self, args, kwargs):
+        if self._fast_plan is None:
+            return _MISS
+        guards, sig, key, sources, template = self._fast_plan
+        if _guard_values(self.fn) != guards:
+            self._fast_plan = None      # guard invalidation -> retrace
+            return _MISS
+        bound, tensors, cur_sig = self._arg_tensors(args, kwargs)
+        if cur_sig != sig:
+            return _MISS
+        compiled = self.segment_cache.get(key)
+        if compiled is None:
+            return _MISS
+        try:
+            arrays = compiled([as_jax(tensors[name])
+                               for name in sources])
+        except Exception:
+            return _MISS
+        self._stats["fast_hits"] += 1
+
+        def build(t):
+            if isinstance(t, tuple) and len(t) == 2 and t[0] == "__o__":
+                return _wrap_out(arrays[t[1]])
+            if isinstance(t, list):
+                return [build(e) for e in t]
+            if isinstance(t, tuple):
+                return tuple(build(e) for e in t)
+            return t
+        return build(template)
+
+    def _record_fast_plan(self, sim, result, guards, sig):
+        """After a clean single-segment simulation whose inputs were
+        all function arguments, remember how to replay it directly."""
+        recs = getattr(sim, "flush_records", [])
+        if (len(recs) != 1 or sim.stats_run["graph_breaks"]
+                or sim.stats_run["eager_calls"]
+                or sim.stats_run.get("py_effects")):
+            # py_effects: the simulation performed Python-visible side
+            # effects (attribute stores, calls into non-whitelisted
+            # python) — replaying only the tensor segment would skip
+            # them, so such functions re-simulate every call
+            return
+        key, sources, out_ids = recs[0]
+        if any(s is None for s in sources):
+            return
+        # out_ids are id()s of the segment's materialized Tensors —
+        # match the returned structure's tensors against them
+        out_index = {cid: i for i, cid in enumerate(out_ids)}
+
+        def template(v):
+            if isinstance(v, Tensor):
+                i = out_index.get(id(v))
+                return ("__o__", i) if i is not None else None
+            if isinstance(v, list):
+                t = [template(e) for e in v]
+                return t if all(e is not None for e in t) else None
+            if isinstance(v, tuple):
+                t = tuple(template(e) for e in v)
+                return t if all(e is not None for e in t) else None
+            if isinstance(v, (int, float, bool, str, type(None))):
+                return v
+            return None
+        tmpl = template(result)
+        if tmpl is None:
+            return
+        self._fast_plan = (guards, sig, key, list(sources), tmpl)
+
+    # ----------------------------------------------------------- call
+
+    def __call__(self, *args, **kwargs):
+        if self._unsupported is not None:
+            self._stats["fallback_calls"] += 1
+            return self.fn(*args, **kwargs)
+        fast = self._try_fast(args, kwargs)
+        if fast is not _MISS:
+            return fast
+        guards = _guard_values(self.fn)
+        _, _, sig = self._arg_tensors(args, kwargs)
+        sim = _Simulator(self.fn, self.segment_cache, self._stats)
+        self._stats["simulations"] += 1
+        try:
+            result = sim.run(args, kwargs)
+        except SotUnsupported as exc:
+            self._unsupported = str(exc)
+            self._stats["fallback_calls"] += 1
+            from .. import dy2static as _d2s
+            _d2s.record_break(
+                getattr(self.fn, "__qualname__", "?"),
+                getattr(self.fn.__code__, "co_firstlineno", 0),
+                f"SotUnsupported: {exc}")
+            return self.fn(*args, **kwargs)
+        self._record_fast_plan(sim, result, guards, sig)
+        return result
+
+
+def symbolic_translate(fn):
+    """Wrap ``fn`` with the SOT bytecode capture tier
+    (``paddle.jit.sot.symbolic_translate`` parity)."""
+    if isinstance(fn, SymbolicTranslator):
+        return fn
+    return SymbolicTranslator(fn)
+
+
+def sot_report():
+    """Per-function capture statistics for every translated function."""
+    return [
+        {"function": getattr(t.fn, "__qualname__", "?"),
+         "unsupported": t._unsupported, **t.stats()}
+        for t in _TRANSLATORS
+    ]

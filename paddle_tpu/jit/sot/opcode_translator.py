@@ -1,0 +1,701 @@
+"""SOT opcode-level bytecode capture for CPython 3.12.
+
+Reference parity: ``python/paddle/jit/sot/opcode_translator/`` +
+``function_graph.py`` + the frame-eval hook ``paddle/fluid/pybind/
+jit.cc``. The reference simulates a frame's bytecode, builds sub-graphs
+of tensor ops, and falls back ("graph break") around untraceable
+constructs instead of abandoning the whole function.
+
+TPU-first design: instead of reconstructing Python frames with a C
+eval hook, the simulator IS the frame — a Python VM over
+``dis.get_instructions`` whose value stack holds either concrete
+Python objects or LAZY tensor variables. Tensor ops append nodes to the
+current segment tape; nothing executes on device until a FLUSH point:
+
+- a data-dependent branch (``if tensor:``) flushes the tape — the
+  pending segment compiles as ONE ``jax.jit`` program and executes to
+  materialize the condition — then simulation CONTINUES on the taken
+  branch with a fresh tape. A function with a tensor-dependent ``if``
+  therefore becomes two compiled sub-graphs around one eager branch
+  evaluation, exactly the reference's sub-graph semantics.
+- a call into opaque Python with tensor arguments flushes, runs the
+  call eagerly, and resumes capture with the result as a new input.
+- ``return`` flushes the final segment.
+
+Compiled segments are cached by (code identity, segment start, tape
+structure, input signature) so each unique sub-graph compiles once.
+Unsupported constructs (generators, try/except, with, closures being
+built) raise :class:`SotUnsupported` — the caller falls back to fully
+eager execution for the whole call, the clean break the reference's
+``BreakGraphError`` models.
+"""
+from __future__ import annotations
+
+import dis
+import operator
+import types
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, as_jax, _wrap_out
+
+
+class SotUnsupported(Exception):
+    """Construct the simulator does not model — caller must run the
+    whole frame eagerly (clean graph-break-to-eager semantics)."""
+
+
+class _GraphBreak(Exception):
+    """Internal: flush-and-continue signal (never escapes simulate)."""
+
+
+_NULL = object()          # CPython NULL stack slot
+_ITER_END = object()      # FOR_ITER exhaustion marker
+
+
+class TensorVar:
+    """Lazy tensor on the VM stack: either a segment input (concrete)
+    or the output of a recorded node (symbolic until flush)."""
+
+    __slots__ = ("concrete", "node", "out_pos", "arg_path")
+
+    def __init__(self, concrete=None, node=None, out_pos=0,
+                 arg_path=None):
+        self.concrete = concrete      # Tensor | None
+        self.node = node              # _Node | None
+        self.out_pos = out_pos
+        self.arg_path = arg_path      # function-arg name, for fast path
+
+    @property
+    def is_symbolic(self):
+        return self.concrete is None
+
+
+class _Node:
+    __slots__ = ("fn", "args", "kwargs", "n_out", "outs", "key")
+
+    def __init__(self, fn, args, kwargs, key):
+        self.fn = fn
+        self.args = args              # list of TensorVar | const
+        self.kwargs = kwargs
+        self.key = key                # structural identity for caching
+        self.outs: List[TensorVar] = []
+
+
+_BINOPS = {
+    "+": operator.add, "-": operator.sub, "*": operator.mul,
+    "/": operator.truediv, "//": operator.floordiv, "%": operator.mod,
+    "**": operator.pow, "@": operator.matmul, "&": operator.and_,
+    "|": operator.or_, "^": operator.xor, "<<": operator.lshift,
+    ">>": operator.rshift,
+}
+# in-place forms degrade to the plain operator (fine for our Tensors)
+_BINOPS.update({k + "=": v for k, v in list(_BINOPS.items())})
+
+_CMPOPS = {
+    "<": operator.lt, "<=": operator.le, "==": operator.eq,
+    "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
+}
+
+_UNSUPPORTED_OPS = {
+    "RETURN_GENERATOR", "YIELD_VALUE", "SEND",            # generators
+    "SETUP_FINALLY", "PUSH_EXC_INFO", "POP_EXCEPT",       # try/except
+    "RERAISE", "CHECK_EXC_MATCH", "BEFORE_WITH",          # with
+    "MAKE_CELL",                              # cellvars made HERE
+    "IMPORT_NAME", "IMPORT_FROM",
+}
+
+
+_PURE_BUILTINS = frozenset({
+    range, len, abs, min, max, sum, float, int, bool, str, tuple,
+    list, dict, set, zip, enumerate, reversed, sorted, divmod, round,
+    isinstance, repr})
+
+
+def _is_tensor(v):
+    return isinstance(v, Tensor)
+
+
+class _Simulator:
+    """One symbolic execution of one code object."""
+
+    MAX_STEPS = 200_000
+
+    def __init__(self, fn, segment_cache, stats):
+        self.fn = fn
+        self.code = fn.__code__
+        self.instructions = list(dis.get_instructions(self.code))
+        self.offset_index = {i.offset: k
+                            for k, i in enumerate(self.instructions)}
+        self.segment_cache = segment_cache
+        self.stats = stats
+        self.tape: List[_Node] = []
+        self.seg_start_offset = 0
+        self.flush_records = []       # (cache_key, sources, out ids)
+        self.stats_run = {"graph_breaks": 0, "eager_calls": 0,
+                          "py_effects": 0}
+
+    # ---------------------------------------------------------- tape
+
+    def record(self, fn, args, kwargs, key):
+        node = _Node(fn, list(args), dict(kwargs or {}), key)
+        self.tape.append(node)
+        out = TensorVar(node=node, out_pos=0)
+        node.outs.append(out)
+        return out
+
+    def _flush(self, live_vars):
+        """Compile+run the pending tape so every symbolic TensorVar in
+        ``live_vars`` becomes concrete. One jax.jit program per unique
+        (code, segment start, tape structure, input signature)."""
+        tape = self.tape
+        if not tape:
+            return
+        # collect segment inputs: concrete TensorVars referenced by tape
+        inputs: List[Tensor] = []
+        input_tvs: List[TensorVar] = []
+        input_ids: Dict[int, int] = {}
+
+        def _in_slot(tv):
+            if id(tv) not in input_ids:
+                input_ids[id(tv)] = len(inputs)
+                inputs.append(tv.concrete)
+                input_tvs.append(tv)
+            return input_ids[id(tv)]
+
+        plan = []            # per node: (fn, arg descriptors, kwargs)
+        node_index = {id(n): i for i, n in enumerate(tape)}
+        for n in tape:
+            adesc = []
+            for a in n.args:
+                if isinstance(a, TensorVar):
+                    if a.is_symbolic:
+                        adesc.append(("n", node_index[id(a.node)],
+                                      a.out_pos))
+                    else:
+                        adesc.append(("i", _in_slot(a)))
+                else:
+                    adesc.append(("c", a))
+            plan.append((n.fn, tuple(adesc), tuple(sorted(
+                (n.kwargs or {}).items())) if n.kwargs else ()))
+
+        # requested outputs: symbolic live vars
+        want = [v for v in live_vars
+                if isinstance(v, TensorVar) and v.is_symbolic]
+        outs_desc = tuple((node_index[id(v.node)], v.out_pos)
+                          for v in want)
+        sig = tuple((tuple(t.shape), str(t.dtype)) for t in inputs)
+        # structural identity via each node's stable key (method NAME,
+        # op identity) — the recorded callable itself can be a fresh
+        # closure per simulation, which would defeat the cache
+        def _const_key(d):
+            if d[0] != "c":
+                return d
+            try:
+                hash(d[1])
+                return d
+            except TypeError:
+                return ("c", repr(d[1]))
+        struct_key = (tuple(
+            (n.key, tuple(_const_key(d) for d in p[1]), p[2])
+            for n, p in zip(tape, plan)), outs_desc, sig)
+        cache_key = (id(self.code), self.seg_start_offset, struct_key)
+
+        compiled = self.segment_cache.get(cache_key)
+        if compiled is None:
+            def replay(in_arrays):
+                from ...framework.core import functional_mode
+                with functional_mode():
+                    vals: List[Any] = []
+                    ins = [_wrap_out(a) for a in in_arrays]
+                    for fn, adesc, kwit in plan:
+                        args = []
+                        for d in adesc:
+                            if d[0] == "n":
+                                v = vals[d[1]]
+                                args.append(v if not isinstance(
+                                    v, tuple) else v[d[2]])
+                            elif d[0] == "i":
+                                args.append(ins[d[1]])
+                            else:
+                                args.append(d[1])
+                        vals.append(fn(*args, **dict(kwit)))
+                    res = []
+                    for ni, pos in outs_desc:
+                        v = vals[ni]
+                        v = v if not isinstance(v, tuple) else v[pos]
+                        res.append(as_jax(v))
+                    return tuple(res)
+
+            compiled = jax.jit(replay)
+            self.segment_cache[cache_key] = compiled
+            self.stats["segments_compiled"] += 1
+
+        arrays = compiled([as_jax(t) for t in inputs])
+        self.stats["segments_executed"] += 1
+        for v, arr in zip(want, arrays):
+            v.concrete = _wrap_out(arr)
+            v.node = None
+        self.flush_records.append(
+            (cache_key, [tv.arg_path for tv in input_tvs],
+             [id(v.concrete) for v in want]))
+        self.tape = []
+
+    # ------------------------------------------------------ VM values
+
+    def _concrete(self, v):
+        """Materialize one stack value (flushing if symbolic)."""
+        if isinstance(v, TensorVar):
+            if v.is_symbolic:
+                self._flush(self._live_vars())
+            return v.concrete
+        return v
+
+    def _live_vars(self):
+        live = list(self.stack)
+        live += [v for v in self.locals_.values()
+                 if isinstance(v, TensorVar)]
+        return live
+
+    def _wrap(self, v):
+        return TensorVar(concrete=v) if _is_tensor(v) else v
+
+    # -------------------------------------------------------- tensor ops
+
+    def _tensor_call(self, fn, args, kwargs, key):
+        """Record a call whose result is a tensor; non-tensor results
+        force eager evaluation."""
+        return self.record(fn, args, kwargs or {}, key)
+
+    def _eager_call(self, fn, args, kwargs):
+        """Flush everything the call might touch, run it eagerly, and
+        continue capture with its (wrapped) result."""
+        self._flush(self._live_vars())
+        conc_args = [self._concrete(a) for a in args]
+        conc_kwargs = {k: self._concrete(v)
+                       for k, v in (kwargs or {}).items()}
+        self.stats["eager_calls"] += 1
+        self.stats_run["eager_calls"] += 1
+        out = fn(*conc_args, **conc_kwargs)
+        return self._wrap(out)
+
+    def _call(self, fn, args, kwargs):
+        # tensor-op leaf: framework ops and Tensor methods record onto
+        # the tape; everything else runs eagerly (with a flush when
+        # tensor arguments are involved)
+        any_tensor = any(isinstance(a, TensorVar) for a in args) or \
+            any(isinstance(v, TensorVar)
+                for v in (kwargs or {}).values())
+        mod = getattr(fn, "__module__", "") or ""
+        is_framework_op = mod.startswith("paddle_tpu.")
+        is_bound_tensor_method = _is_tensor(getattr(fn, "__self__",
+                                                    None))
+        if isinstance(fn, _BoundLazyMethod):
+            return fn.call(self, args, kwargs)
+        if any_tensor and (is_framework_op or is_bound_tensor_method):
+            return self._tensor_call(fn, args, kwargs, key=id(fn))
+        if not any_tensor:
+            # pure python: run it now (range, len, zip, constants...).
+            # Non-whitelisted callables may carry side effects the fast
+            # path would skip on replay — mark the run as effectful.
+            if fn not in _PURE_BUILTINS:
+                self.stats_run["py_effects"] += 1
+            try:
+                out = fn(*[a for a in args], **(kwargs or {}))
+            except SotUnsupported:
+                raise
+            return self._wrap(out)
+        return self._eager_call(fn, args, kwargs)
+
+    # ----------------------------------------------------------- run
+
+    def run(self, args, kwargs):
+        code = self.code
+        if code.co_flags & 0x20:          # generator/coroutine
+            raise SotUnsupported("generator or coroutine function")
+        if code.co_exceptiontable:
+            # 3.12 zero-cost exceptions keep handlers OFF the happy
+            # path, so the simulator would silently skip a user's
+            # except/finally clause the moment a captured op raised —
+            # frames with handlers must run eagerly
+            raise SotUnsupported(
+                "frame has exception handlers (try/except/with)")
+        names = code.co_varnames
+        import inspect
+        if inspect.ismethod(self.fn):
+            # bound method (e.g. a Layer.forward): rebind the receiver
+            # explicitly — co_varnames starts with `self` but the bound
+            # signature hides it
+            bound = _bind_args(self.fn.__func__,
+                               (self.fn.__self__,) + tuple(args),
+                               kwargs)
+        else:
+            bound = _bind_args(self.fn, args, kwargs)
+        self.locals_ = {}
+        for k, v in bound.items():
+            w = self._wrap(v)
+            if isinstance(w, TensorVar):
+                w.arg_path = k        # top-level tensor arg: fast-path
+            self.locals_[k] = w
+        self.stack: List[Any] = []
+        self.kw_names: Tuple[str, ...] = ()
+        globals_ = self.fn.__globals__
+        builtins_ = globals_.get("__builtins__", __builtins__)
+        if not isinstance(builtins_, dict):
+            builtins_ = vars(builtins_)
+
+        idx = 0
+        steps = 0
+        while True:
+            steps += 1
+            if steps > self.MAX_STEPS:
+                raise SotUnsupported("instruction budget exceeded "
+                                     "(runaway loop in simulation)")
+            ins = self.instructions[idx]
+            op = ins.opname
+            if op in _UNSUPPORTED_OPS:
+                raise SotUnsupported(f"opcode {op}")
+
+            if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                      "EXTENDED_ARG", "COPY_FREE_VARS"):
+                pass
+            elif op == "LOAD_DEREF":
+                name = ins.argval
+                code_fv = code.co_freevars
+                if name in code_fv and self.fn.__closure__:
+                    cell = self.fn.__closure__[code_fv.index(name)]
+                    try:
+                        self.stack.append(self._wrap(cell.cell_contents))
+                    except ValueError:
+                        raise SotUnsupported(f"empty cell {name!r}")
+                else:
+                    raise SotUnsupported(f"LOAD_DEREF cellvar {name!r}")
+            elif op == "LOAD_CONST":
+                self.stack.append(ins.argval)
+            elif op == "RETURN_CONST":
+                return self._finish(ins.argval)
+            elif op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
+                if ins.argval not in self.locals_:
+                    raise SotUnsupported(
+                        f"unbound local {ins.argval!r}")
+                self.stack.append(self.locals_[ins.argval])
+            elif op == "LOAD_FAST_AND_CLEAR":
+                self.stack.append(self.locals_.pop(ins.argval, _NULL))
+            elif op == "STORE_FAST":
+                self.locals_[ins.argval] = self.stack.pop()
+            elif op == "DELETE_FAST":
+                self.locals_.pop(ins.argval, None)
+            elif op == "LOAD_GLOBAL":
+                if ins.arg & 1:
+                    self.stack.append(_NULL)
+                name = ins.argval
+                if name in globals_:
+                    v = globals_[name]
+                elif name in builtins_:
+                    v = builtins_[name]
+                else:
+                    raise SotUnsupported(f"unknown global {name!r}")
+                self.stack.append(self._wrap(v))
+            elif op == "LOAD_ATTR":
+                obj = self.stack.pop()
+                name = ins.argval
+                method_form = bool(ins.arg & 1)
+                v = self._getattr(obj, name)
+                if method_form:
+                    self.stack.append(_NULL)
+                self.stack.append(v)
+            elif op == "STORE_ATTR":
+                obj = self.stack.pop()
+                val = self.stack.pop()
+                self.stats_run["py_effects"] += 1
+                setattr(self._concrete(obj), ins.argval,
+                        self._concrete(val))
+            elif op == "PUSH_NULL":
+                self.stack.append(_NULL)
+            elif op == "POP_TOP":
+                self.stack.pop()
+            elif op == "COPY":
+                self.stack.append(self.stack[-ins.arg])
+            elif op == "SWAP":
+                s = self.stack
+                s[-1], s[-ins.arg] = s[-ins.arg], s[-1]
+            elif op == "UNARY_NEGATIVE":
+                v = self.stack.pop()
+                self.stack.append(self._unary(operator.neg, v))
+            elif op == "UNARY_INVERT":
+                v = self.stack.pop()
+                self.stack.append(self._unary(operator.invert, v))
+            elif op == "UNARY_NOT":
+                v = self.stack.pop()
+                self.stack.append(not self._truth(v))
+            elif op == "TO_BOOL":
+                v = self.stack.pop()
+                self.stack.append(self._truth(v))
+            elif op == "BINARY_OP":
+                rhs = self.stack.pop()
+                lhs = self.stack.pop()
+                sym = ins.argrepr
+                f = _BINOPS.get(sym)
+                if f is None:
+                    raise SotUnsupported(f"BINARY_OP {sym!r}")
+                self.stack.append(self._binary(f, lhs, rhs))
+            elif op == "BINARY_SUBSCR":
+                k = self.stack.pop()
+                obj = self.stack.pop()
+                self.stack.append(self._binary(operator.getitem,
+                                               obj, k))
+            elif op == "BINARY_SLICE":
+                end = self.stack.pop()
+                start = self.stack.pop()
+                obj = self.stack.pop()
+                self.stack.append(self._binary(
+                    operator.getitem, obj, slice(start, end)))
+            elif op == "STORE_SUBSCR":
+                k = self.stack.pop()
+                obj = self.stack.pop()
+                val = self.stack.pop()
+                self.stats_run["py_effects"] += 1
+                self._concrete(obj)[self._concrete(k)] = \
+                    self._concrete(val)
+            elif op == "COMPARE_OP":
+                rhs = self.stack.pop()
+                lhs = self.stack.pop()
+                f = _CMPOPS.get(ins.argval.rstrip("="))
+                f = _CMPOPS.get(ins.argval, f)
+                if f is None:
+                    raise SotUnsupported(f"COMPARE_OP {ins.argval!r}")
+                self.stack.append(self._binary(f, lhs, rhs))
+            elif op == "IS_OP":
+                rhs = self._concrete(self.stack.pop())
+                lhs = self._concrete(self.stack.pop())
+                r = lhs is rhs
+                self.stack.append(r != bool(ins.arg))
+            elif op == "CONTAINS_OP":
+                container = self._concrete(self.stack.pop())
+                item = self._concrete(self.stack.pop())
+                r = item in container
+                self.stack.append(r != bool(ins.arg))
+            elif op == "BUILD_TUPLE":
+                vals = self._popn(ins.arg)
+                self.stack.append(tuple(vals))
+            elif op == "BUILD_LIST":
+                self.stack.append(self._popn(ins.arg))
+            elif op == "BUILD_MAP":
+                kv = self._popn(2 * ins.arg)
+                self.stack.append({self._concrete(kv[i]): kv[i + 1]
+                                   for i in range(0, len(kv), 2)})
+            elif op == "BUILD_SLICE":
+                vals = self._popn(ins.arg)
+                self.stack.append(slice(*[self._concrete(v)
+                                          for v in vals]))
+            elif op == "LIST_EXTEND":
+                seq = self.stack.pop()
+                self.stack[-ins.arg].extend(
+                    self._concrete(seq) if not isinstance(seq, list)
+                    else seq)
+            elif op == "LIST_APPEND":
+                v = self.stack.pop()
+                self.stack[-ins.arg].append(v)
+            elif op == "UNPACK_SEQUENCE":
+                seq = self.stack.pop()
+                if isinstance(seq, TensorVar):
+                    raise SotUnsupported("unpacking a tensor")
+                items = list(seq)
+                if len(items) != ins.arg:
+                    raise ValueError("unpack length mismatch")
+                for v in reversed(items):
+                    self.stack.append(self._wrap(v))
+            elif op == "GET_ITER":
+                v = self.stack.pop()
+                if isinstance(v, TensorVar):
+                    raise SotUnsupported("iterating a tensor")
+                self.stack.append(iter(v))
+            elif op == "FOR_ITER":
+                it = self.stack[-1]
+                try:
+                    self.stack.append(self._wrap(next(it)))
+                except StopIteration:
+                    self.stack.append(_ITER_END)
+                    idx = self.offset_index[ins.argval]
+                    continue
+            elif op == "END_FOR":
+                self.stack.pop()
+                self.stack.pop()
+            elif op == "KW_NAMES":
+                self.kw_names = ins.argval
+            elif op == "CALL":
+                argc = ins.arg
+                args_v = self._popn(argc)
+                kwn = self.kw_names
+                self.kw_names = ()
+                kwargs_v = {}
+                if kwn:
+                    for name, v in zip(kwn, args_v[-len(kwn):]):
+                        kwargs_v[name] = v
+                    args_v = args_v[:-len(kwn)]
+                b = self.stack.pop()
+                a = self.stack.pop()
+                if a is _NULL:
+                    fn = b
+                elif b is _NULL:
+                    fn = a
+                else:
+                    fn = a
+                    args_v = [b] + args_v
+                self.stack.append(self._call_dispatch(fn, args_v,
+                                                      kwargs_v))
+            elif op == "CALL_KW":
+                kwn = self._concrete(self.stack.pop())
+                argc = ins.arg
+                args_v = self._popn(argc)
+                kwargs_v = dict(zip(kwn, args_v[-len(kwn):]))
+                args_v = args_v[:-len(kwn)]
+                b = self.stack.pop()
+                a = self.stack.pop()
+                fn = b if a is _NULL else a
+                if a is not _NULL and b is not _NULL:
+                    args_v = [b] + args_v
+                self.stack.append(self._call_dispatch(fn, args_v,
+                                                      kwargs_v))
+            elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
+                        "JUMP_BACKWARD_NO_INTERRUPT"):
+                idx = self.offset_index[ins.argval]
+                continue
+            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                v = self.stack.pop()
+                t = self._truth(v)
+                if (op == "POP_JUMP_IF_TRUE") == bool(t):
+                    idx = self.offset_index[ins.argval]
+                    continue
+            elif op in ("POP_JUMP_IF_NONE", "POP_JUMP_IF_NOT_NONE"):
+                v = self.stack.pop()
+                conc = v if not isinstance(v, TensorVar) else True
+                is_none = conc is None
+                if (op == "POP_JUMP_IF_NONE") == is_none:
+                    idx = self.offset_index[ins.argval]
+                    continue
+            elif op == "RETURN_VALUE":
+                return self._finish(self.stack.pop())
+            elif op == "FORMAT_VALUE" or op == "BUILD_STRING" \
+                    or op == "CONVERT_VALUE" or op == "FORMAT_SIMPLE":
+                raise SotUnsupported(f"opcode {op} (f-string)")
+            else:
+                raise SotUnsupported(f"opcode {op}")
+            idx += 1
+
+    # ----------------------------------------------------- helpers
+
+    def _popn(self, n):
+        if n == 0:
+            return []
+        vals = self.stack[-n:]
+        del self.stack[-n:]
+        return vals
+
+    def _finish(self, ret):
+        def walk(v, out):
+            """Collect TensorVars at ANY nesting depth of the return
+            value (tuples, lists, dict values)."""
+            if isinstance(v, TensorVar):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                for e in v:
+                    walk(e, out)
+            elif isinstance(v, dict):
+                for e in v.values():
+                    walk(e, out)
+            return out
+
+        live = walk(ret, [])
+        self._flush(live + self._live_vars())
+
+        def conc(v):
+            if isinstance(v, TensorVar):
+                return v.concrete
+            if isinstance(v, tuple):
+                return tuple(conc(e) for e in v)
+            if isinstance(v, list):
+                return [conc(e) for e in v]
+            if isinstance(v, dict):
+                return {k: conc(e) for k, e in v.items()}
+            return v
+        return conc(ret)
+
+    def _truth(self, v):
+        if isinstance(v, TensorVar):
+            # the data-dependent branch: FLUSH (compile+run the pending
+            # sub-graph), evaluate the condition eagerly, and continue
+            # simulation — this is the graph break
+            self._flush(self._live_vars() + [v])
+            self.stats["graph_breaks"] += 1
+            self.stats_run["graph_breaks"] += 1
+            self.seg_start_offset += 1   # next segment gets a new key
+            return bool(np.asarray(as_jax(v.concrete)))
+        return bool(v)
+
+    def _unary(self, f, v):
+        if isinstance(v, TensorVar):
+            return self.record(f, [v], {}, key=id(f))
+        return f(v)
+
+    def _binary(self, f, lhs, rhs):
+        if isinstance(lhs, TensorVar) or isinstance(rhs, TensorVar):
+            return self.record(f, [lhs, rhs], {}, key=id(f))
+        return f(lhs, rhs)
+
+    def _getattr(self, obj, name):
+        if isinstance(obj, TensorVar):
+            # tensor attribute: methods become lazy-bound callables;
+            # plain data attributes (shape, dtype) need concreteness
+            t_attr = getattr(Tensor, name, None)
+            if callable(t_attr):
+                return _BoundLazyMethod(obj, name)
+            return getattr(self._concrete(obj), name)
+        return self._wrap(getattr(obj, name))
+
+    def _call_dispatch(self, fn, args, kwargs):
+        if isinstance(fn, _BoundLazyMethod):
+            return fn.call(self, args, kwargs)
+        if isinstance(fn, TensorVar):
+            raise SotUnsupported("calling a tensor")
+        return self._call(fn, args, kwargs)
+
+
+class _BoundLazyMethod:
+    """``tensor.method`` looked up on a lazy TensorVar: calling it
+    records a node that invokes the Tensor method at replay time."""
+
+    __slots__ = ("var", "name")
+
+    def __init__(self, var, name):
+        self.var = var
+        self.name = name
+
+    def call(self, sim, args, kwargs):
+        name = self.name
+
+        def invoke(recv, *a, **kw):
+            return getattr(recv, name)(*a, **kw)
+        invoke.__module__ = "paddle_tpu.sot.method"
+        return sim.record(invoke, [self.var] + list(args),
+                          kwargs or {}, key=("method", name))
+
+
+def _bind_args(fn, args, kwargs):
+    import inspect
+    sig = inspect.signature(fn)
+    bound = sig.bind(*args, **kwargs)
+    bound.apply_defaults()
+    flat = {}
+    for k, v in bound.arguments.items():
+        kind = sig.parameters[k].kind
+        if kind == inspect.Parameter.VAR_POSITIONAL:
+            flat[k] = tuple(v)
+        elif kind == inspect.Parameter.VAR_KEYWORD:
+            flat[k] = dict(v)
+        else:
+            flat[k] = v
+    return flat

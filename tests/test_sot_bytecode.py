@@ -1,0 +1,262 @@
+"""SOT bytecode-capture tests (reference:
+``python/paddle/jit/sot/opcode_translator/`` semantics — sub-graph
+splitting around graph breaks, clean whole-frame fallback for
+unsupported constructs, guard-invalidation retracing)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit.sot import symbolic_translate, SotUnsupported
+
+
+def _t(arr):
+    return paddle.to_tensor(np.asarray(arr, np.float32))
+
+
+def test_straight_line_capture_matches_eager():
+    def f(x, y):
+        a = x * 2.0 + y
+        b = a.exp()
+        return (b - y).sum()
+
+    st = symbolic_translate(f)
+    x, y = _t([[1.0, 2.0], [3.0, 4.0]]), _t([[0.5, 0.5], [0.5, 0.5]])
+    out = st(x, y)
+    ref = f(x, y)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    s = st.stats()
+    assert s["simulations"] == 1
+    assert s["segments_compiled"] == 1        # ONE sub-graph
+    assert s["graph_breaks"] == 0
+
+
+def test_data_dependent_if_splits_into_two_subgraphs():
+    """The headline semantics: `if tensor:` compiles the ops before the
+    branch as sub-graph 1, evaluates the condition eagerly, and
+    compiles the taken branch's ops as sub-graph 2."""
+    def f(x):
+        a = x * 3.0            # segment 1
+        if (a.sum() > 0.0):    # graph break: eager bool()
+            b = a + 10.0       # segment 2 (true arm)
+        else:
+            b = a - 10.0       # segment 2 (false arm)
+        return b.mean()
+
+    st = symbolic_translate(f)
+    xp = _t([1.0, 2.0])
+    out = st(xp)
+    np.testing.assert_allclose(out.numpy(), f(xp).numpy(), rtol=1e-6)
+    s = st.stats()
+    assert s["graph_breaks"] == 1
+    assert s["segments_compiled"] == 2        # TWO sub-graphs
+    assert s["segments_executed"] == 2
+
+    # other branch: ONE new sub-graph compiles (the false arm); the
+    # pre-branch segment is structurally identical and reuses its cache
+    xn = _t([-1.0, -2.0])
+    out2 = st(xn)
+    np.testing.assert_allclose(out2.numpy(), f(xn).numpy(), rtol=1e-6)
+    s2 = st.stats()
+    assert s2["graph_breaks"] == 2
+    assert s2["segments_compiled"] == 3
+    assert s2["segments_executed"] == 4
+
+
+def test_python_loop_unrolls_into_capture():
+    def f(x, n):
+        for i in range(n):
+            x = x + float(i)
+        return x.sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(st(x, 3).numpy(), f(x, 3).numpy(),
+                               rtol=1e-6)
+    assert st.stats()["segments_compiled"] == 1
+
+
+def test_generator_breaks_cleanly_to_eager():
+    def gen(x):
+        yield x * 2.0
+
+    def f(x):
+        return next(gen(x)).sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0])
+    out = st(x)                    # must not crash: whole-frame eager
+    np.testing.assert_allclose(out.numpy(), f(x).numpy(), rtol=1e-6)
+    s = st.stats()
+    assert s["fallback_calls"] >= 1 or s["eager_calls"] >= 1
+
+    # a DIRECT generator function is marked unsupported up front
+    st2 = symbolic_translate(gen)
+    g = st2(x)
+    assert hasattr(g, "__next__")
+    assert st2._unsupported is not None
+
+
+def test_try_except_breaks_cleanly_to_eager():
+    def f(x):
+        try:
+            y = x * 2.0
+        except ValueError:
+            y = x
+        return y.sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0])
+    out = st(x)
+    np.testing.assert_allclose(out.numpy(), f(x).numpy(), rtol=1e-6)
+    assert st._unsupported is not None         # clean break, recorded
+    assert st.stats()["fallback_calls"] >= 1
+    # subsequent calls keep working (stay eager)
+    np.testing.assert_allclose(st(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+
+def test_guard_invalidation_retraces():
+    scale = {"v": 2.0}
+
+    def make():
+        coef = 2.0
+
+        def f(x):
+            return (x * coef).sum()
+        return f
+
+    f = make()
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(st(x).numpy(), 12.0, rtol=1e-6)
+    assert st.stats()["simulations"] == 1
+    # warm call: fast path, no re-simulation
+    np.testing.assert_allclose(st(x).numpy(), 12.0, rtol=1e-6)
+    assert st.stats()["simulations"] == 1
+    assert st.stats()["fast_hits"] == 1
+    # mutate the guarded closure scalar -> retrace, new value honored
+    f.__closure__[0].cell_contents  # (read ok)
+    import ctypes
+    # rebuild the closure with a new coef by making a fresh function
+    def make3():
+        coef = 3.0
+
+        def f3(x):
+            return (x * coef).sum()
+        return f3
+    # simpler: translate a fn reading a GLOBAL scalar
+    global _SOT_COEF
+    _SOT_COEF = 2.0
+
+    def g(x):
+        return (x * _SOT_COEF).sum()
+
+    stg = symbolic_translate(g)
+    np.testing.assert_allclose(stg(x).numpy(), 12.0, rtol=1e-6)
+    np.testing.assert_allclose(stg(x).numpy(), 12.0, rtol=1e-6)
+    assert stg.stats()["simulations"] == 1
+    assert stg.stats()["fast_hits"] == 1
+    _SOT_COEF = 5.0                      # guard invalidation
+    np.testing.assert_allclose(stg(x).numpy(), 30.0, rtol=1e-6)
+    assert stg.stats()["simulations"] == 2    # re-traced
+
+
+def test_opaque_python_call_breaks_and_resumes():
+    def helper(t):
+        # numpy round-trip: untraceable, must run eagerly mid-function
+        return paddle.to_tensor(np.asarray(t.numpy()) * 3.0)
+
+    def f(x):
+        a = x + 1.0           # segment 1
+        b = helper(a)         # eager call break
+        return (b * 2.0).sum()  # segment 2
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0])
+    out = st(x)
+    np.testing.assert_allclose(out.numpy(), f(x).numpy(), rtol=1e-6)
+    s = st.stats()
+    assert s["eager_calls"] >= 1
+    assert s["segments_compiled"] >= 2
+
+
+def test_kwargs_and_methods():
+    def f(x, axis=None):
+        return x.sum(axis=axis) + x.mean()
+
+    st = symbolic_translate(f)
+    x = _t([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(st(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+
+def test_to_static_layer_sot_tier():
+    """full_graph=False on a Layer routes its forward through the SOT
+    bytecode tier (bound-method simulation)."""
+    paddle.seed(0)
+
+    class M(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            return (h * 2.0).sum()
+
+    m = M()
+    x = _t(np.random.RandomState(0).randn(2, 4))
+    ref = float(m(x).numpy())
+    m2 = paddle.jit.to_static(m, full_graph=False)
+    out = float(m2(x).numpy())
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    st = m2.forward
+    s = st.stats()
+    assert s["simulations"] >= 1
+    # either captured (segments compiled) or clean eager fallback —
+    # NEVER a crash; with the bound-method path it should capture
+    assert s["segments_compiled"] >= 1 or st._unsupported is not None
+
+
+def test_changed_scalar_arg_misses_fast_path():
+    """A changed non-tensor argument must not replay a cached segment
+    with the old value baked in."""
+    def f(x, n):
+        for i in range(n):
+            x = x + float(i)
+        return x.sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(st(x, 3).numpy(), f(x, 3).numpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(st(x, 5).numpy(), f(x, 5).numpy(),
+                               rtol=1e-6)
+
+
+def test_nested_container_return_materializes():
+    def f(x):
+        return (x + 1.0, [x * 2.0], {"k": x - 1.0})
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0])
+    a, blist, d = st(x)
+    np.testing.assert_allclose(a.numpy(), [2.0, 3.0])
+    np.testing.assert_allclose(blist[0].numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(d["k"].numpy(), [0.0, 1.0])
+
+
+def test_python_side_effects_not_skipped_by_fast_path():
+    class Cfg:
+        calls = 0
+
+    cfg = Cfg()
+
+    def f(x, cfg):
+        cfg.calls = cfg.calls + 1
+        return (x * 2.0).sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0])
+    st(x, cfg)
+    st(x, cfg)
+    st(x, cfg)
+    assert cfg.calls == 3          # effects replayed every call
