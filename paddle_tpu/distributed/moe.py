@@ -120,6 +120,32 @@ class SwitchGate(NaiveGate):
 import functools as _functools
 
 
+def _positions(onehot, flat_e):
+    """(pos_within_expert [N], counts [E]) from routing one-hots.
+
+    A plain ``jnp.cumsum`` over N=32k rows lowers to a long serial
+    scan on TPU (~1.4 ms at bench shapes); chunking into 128-row tiles
+    turns it into one batched triangular f32 matmul (MXU) plus a
+    256-step scan over chunk totals (0.93 ms, bit-exact — f32 is exact
+    for counts < 2^24)."""
+    n, e = onehot.shape
+    if n % 128 or n < 256:
+        cum = jnp.cumsum(onehot, axis=0) - onehot
+        pos = jnp.take_along_axis(cum, flat_e[:, None], axis=1)[:, 0]
+        return pos.astype(jnp.int32), jnp.sum(onehot, axis=0)
+    c = 128
+    nc = n // c
+    x = onehot.reshape(nc, c, e).astype(jnp.float32)
+    tri = jnp.tril(jnp.ones((c, c), jnp.float32), k=-1)  # exclusive
+    within = jnp.einsum("ij,nje->nie", tri, x)
+    chunk_tot = x.sum(axis=1)
+    offs = jnp.cumsum(chunk_tot, axis=0) - chunk_tot
+    pos = (within + offs[:, None, :]).reshape(n, e)
+    pos = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
+    return pos.astype(jnp.int32), chunk_tot.sum(axis=0).astype(
+        onehot.dtype)
+
+
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _moe_pack(x, src_row, filled, dest, top_k):
     """expert_in[e, c] = x[src_row[e, c]] * filled[e, c].
@@ -275,9 +301,10 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
         onehot = onehot * sel[..., None].astype(onehot.dtype)
 
     flat = onehot.reshape(s * top_k, e)
-    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(
-        s, top_k, e)  # [s, k, e]
-    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [s, k]
+    # chunked MXU scan (see _positions) instead of a serial cumsum
+    pos, _counts = _positions(flat, topk_idx.reshape(-1).astype(
+        jnp.int32))
+    pos = pos.reshape(s, top_k)
     slot_used = jnp.sum(onehot, axis=-1) > 0  # [s, k]
     keep = (pos < c) & slot_used
 
@@ -426,12 +453,11 @@ def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
 
     # group (token, slot) pairs by destination expert via cumsum-rank:
     # rank[i] = start of expert(i)'s segment + arrival position
+    # (chunked MXU scan — see _positions)
     flat_e = topk_idx.reshape(-1)                           # [s*k]
     onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)     # [s*k, e]
-    counts = jnp.sum(onehot, axis=0)
+    pos, counts = _positions(onehot, flat_e.astype(jnp.int32))
     starts = jnp.cumsum(counts) - counts
-    pos = jnp.take_along_axis(jnp.cumsum(onehot, axis=0) - onehot,
-                              flat_e[:, None], axis=1)[:, 0]
     rank = (starts[flat_e] + pos).astype(jnp.int32)         # inverse perm
     order = jnp.zeros(s * top_k, jnp.int32).at[rank].set(
         jnp.arange(s * top_k, dtype=jnp.int32))
