@@ -9,8 +9,10 @@ from .collective import (Group, P2POp, ReduceOp, all_gather,
                          all_gather_object, all_reduce, alltoall,
                          alltoall_single, barrier, batch_isend_irecv,
                          broadcast, broadcast_object_list, get_group,
-                         isend, irecv, new_group, recv, reduce_scatter,
+                         isend, irecv, new_group, recv, reduce, reduce_scatter,
                          scatter, send, wait, _all_reduce_eager_mean)
+from . import collective_ops
+from .collective_ops import *  # noqa: F401,F403
 from . import fleet
 from . import auto_parallel
 from . import checkpoint

@@ -138,7 +138,10 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     if _maybe_axis_active(axis):
         fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
                ReduceOp.MIN: jax.lax.pmin,
-               ReduceOp.AVG: jax.lax.pmean}
+               ReduceOp.AVG: jax.lax.pmean,
+               # no lax.pprod primitive: gather the axis and reduce
+               ReduceOp.PROD: lambda a, ax: jnp.prod(
+                   jax.lax.all_gather(a, ax), axis=0)}
         out = fns[op](arr, axis)
         if isinstance(tensor, Tensor):
             tensor._data = out
@@ -232,6 +235,15 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
             return tensor
         return _wrap_out(out)
     return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """``paddle.distributed.reduce``: reduce to rank ``dst``. The
+    reference leaves non-root values undefined; here every rank gets
+    the reduced value (an all-reduce) — a valid strengthening under
+    the identity/GSPMD regimes, and what the eager regime's backend
+    returns anyway."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):

@@ -1,0 +1,97 @@
+"""Static-graph collective operators (reference:
+``paddle/fluid/operators/collective/c_*_op.cc`` — the comm nodes a
+static ``Program`` holds explicitly: ``c_allreduce_sum``,
+``c_broadcast``, ``c_allgather``, ``c_reducescatter``, ...).
+
+TPU-first: each ``c_*`` op is the SAME collective verb the eager API
+uses (``distributed/collective.py``'s three-regime design); recorded on
+a ``SymbolicTensor`` it becomes a node of the static DAG and the
+Executor jits it with the rest of the program — inside a mesh the verb
+lowers to the ``lax`` collective, single-process it is the documented
+identity regime. The reference needs distinct C++ operator classes
+because its static IR is a separate universe from eager; here one
+implementation serves both, and these names exist so reference static
+scripts translate one-to-one.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+from .collective import ReduceOp
+
+__all__ = ["c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+           "c_allreduce_prod", "c_broadcast", "c_allgather",
+           "c_reducescatter", "c_reduce_sum", "c_identity", "c_concat",
+           "c_split", "c_sync_calc_stream", "c_sync_comm_stream"]
+
+
+def c_allreduce_sum(x, ring_id=0, use_calc_stream=True, group=None):
+    return _c.all_reduce(x, op=ReduceOp.SUM, group=group)
+
+
+def c_allreduce_max(x, ring_id=0, use_calc_stream=True, group=None):
+    return _c.all_reduce(x, op=ReduceOp.MAX, group=group)
+
+
+def c_allreduce_min(x, ring_id=0, use_calc_stream=True, group=None):
+    return _c.all_reduce(x, op=ReduceOp.MIN, group=group)
+
+
+def c_allreduce_prod(x, ring_id=0, use_calc_stream=True, group=None):
+    return _c.all_reduce(x, op=ReduceOp.PROD, group=group)
+
+
+def c_reduce_sum(x, root=0, ring_id=0, use_calc_stream=True,
+                 group=None):
+    return _c.reduce(x, dst=root, op=ReduceOp.SUM, group=group)
+
+
+def c_broadcast(x, root=0, ring_id=0, use_calc_stream=True,
+                group=None):
+    return _c.broadcast(x, src=root, group=group)
+
+
+def c_allgather(x, nranks=None, ring_id=0, use_calc_stream=True,
+                group=None):
+    out = []
+    _c.all_gather(out, x, group=group)
+    from ..ops.manipulation import concat
+    return concat(out, axis=0)
+
+
+def c_reducescatter(x, nranks=None, ring_id=0, use_calc_stream=True,
+                    group=None):
+    return _c.reduce_scatter(x, None, op=ReduceOp.SUM, group=group)
+
+
+def c_identity(x, ring_id=0, use_calc_stream=True, use_model_parallel=True):
+    """Identity forward whose BACKWARD is an all-reduce (the mp-layers
+    input marker). GSPMD inserts the gradient collective from the
+    sharding, so forward identity is the whole op here."""
+    return x
+
+
+def c_concat(x, nranks=None, ring_id=0, group=None):
+    """Gather model-parallel shards along the LAST dim (the reference's
+    mp gather for gather_output=True)."""
+    out = []
+    _c.all_gather(out, x, group=group)
+    from ..ops.manipulation import concat
+    return concat(out, axis=-1)
+
+
+def c_split(x, rank=None, nranks=None, ring_id=0, group=None):
+    """Take this rank's slice along the last dim."""
+    from .env import get_rank, get_world_size
+    from ..ops.manipulation import split
+    nr = nranks or max(get_world_size(), 1)
+    r = rank if rank is not None else get_rank()
+    return split(x, nr, axis=-1)[r]
+
+
+def c_sync_calc_stream(x=None):
+    """Stream sync is a no-op under XLA's single ordered program."""
+    return x
+
+
+def c_sync_comm_stream(x=None, ring_id=0):
+    return x

@@ -29,8 +29,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["SparseTable", "DenseTable", "run_server", "stop_server",
-           "PSClient", "DistributedEmbedding"]
+__all__ = ["SparseTable", "SSDSparseTable", "DenseTable", "run_server",
+           "stop_server", "PSClient", "DistributedEmbedding"]
 
 
 class SparseTable:
@@ -78,6 +78,134 @@ class SparseTable:
         return len(self.rows)
 
 
+class SSDSparseTable(SparseTable):
+    """Two-tier sparse table (reference: ``paddle/fluid/distributed/ps/
+    table/ssd_sparse_table.cc`` + the CtrAccessor show/shrink flow): a
+    bounded in-memory HOT tier with LRU eviction to a fixed-slot disk
+    file, plus per-row show counters driving ``shrink()``. This is the
+    industrial shape of the reference's largest subsystem scaled to the
+    in-tree PS: embedding tables larger than host RAM keep serving,
+    cold ids age out.
+
+    Disk layout: one record per slot = [value row | accumulator row]
+    (both ``dim`` wide, table dtype); ``_slots`` maps id -> slot. Slots
+    are allocated on first eviction and reused for the row's lifetime,
+    so the file never needs compaction until ``shrink``.
+    """
+
+    def __init__(self, dim, dtype="float32", optimizer="sgd", lr=0.01,
+                 init_std=0.01, seed=0, cache_rows=100_000, path=None):
+        super().__init__(dim, dtype, optimizer, lr, init_std, seed)
+        import collections
+        import os
+        import tempfile
+        # cache_rows=0 would evict the row being returned; the hot
+        # tier needs at least one slot
+        self.cache_rows = max(int(cache_rows), 1)
+        self.rows = collections.OrderedDict()     # hot tier (LRU)
+        if path is None:
+            fd, path = tempfile.mkstemp(suffix=".pstable")
+            os.close(fd)
+            self._own_path = True
+        else:
+            self._own_path = False
+        self.path = path
+        # O_CREAT semantics without append-mode write repositioning
+        # ("a+b" ignores seek() for writes on POSIX)
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        self._file = os.fdopen(fd, "r+b")
+        self._slots: Dict[int, int] = {}          # id -> disk slot
+        self._free: List[int] = []                # reusable slots
+        self._n_slots = 0
+        self._rec_bytes = 2 * self.dim * self.dtype.itemsize
+        self.show: Dict[int, int] = {}            # CtrAccessor-lite
+
+    # ---- disk records -------------------------------------------------
+
+    def _write_slot(self, slot: int, value, acc) -> None:
+        rec = np.concatenate([value, acc]).astype(self.dtype)
+        self._file.seek(slot * self._rec_bytes)
+        self._file.write(rec.tobytes())
+
+    def _read_slot(self, slot: int):
+        self._file.seek(slot * self._rec_bytes)
+        buf = self._file.read(self._rec_bytes)
+        rec = np.frombuffer(buf, self.dtype).copy()
+        return rec[:self.dim], rec[self.dim:]
+
+    def _evict_lru(self) -> None:
+        while len(self.rows) > self.cache_rows:
+            old_id, value = self.rows.popitem(last=False)
+            acc = self.acc.pop(old_id, None)
+            if acc is None:
+                acc = np.zeros(self.dim, self.dtype)
+            slot = self._slots.get(old_id)
+            if slot is None:
+                slot = self._free.pop() if self._free else self._n_slots
+                if slot == self._n_slots:
+                    self._n_slots += 1
+                self._slots[old_id] = slot
+            self._write_slot(slot, value, acc)
+
+    # ---- row access (hot tier first, then disk, then init) -----------
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is not None:
+            self.rows.move_to_end(i)
+            self.show[i] = self.show.get(i, 0) + 1
+            return r
+        slot = self._slots.get(i)
+        if slot is not None:
+            value, acc = self._read_slot(slot)
+            self.rows[i] = value
+            if np.any(acc):
+                self.acc[i] = acc
+        else:
+            self.rows[i] = (self._rng.randn(self.dim)
+                            * self.init_std).astype(self.dtype)
+        self.show[i] = self.show.get(i, 0) + 1
+        self._evict_lru()
+        return self.rows[i]
+
+    def n_rows(self) -> int:
+        return len(self.rows) + len(self._slots) - sum(
+            1 for i in self._slots if i in self.rows)
+
+    def n_hot(self) -> int:
+        return len(self.rows)
+
+    def n_disk(self) -> int:
+        return len(self._slots)
+
+    def shrink(self, threshold: int = 1) -> int:
+        """Drop rows whose show count is below ``threshold`` (the
+        CtrAccessor shrink pass). Returns the number dropped."""
+        with self._mu:
+            victims = [i for i in set(list(self.rows) +
+                                      list(self._slots))
+                       if self.show.get(i, 0) < threshold]
+            for i in victims:
+                self.rows.pop(i, None)
+                self.acc.pop(i, None)
+                slot = self._slots.pop(i, None)
+                if slot is not None:
+                    self._free.append(slot)
+                self.show.pop(i, None)
+            return len(victims)
+
+    def close(self):
+        import os
+        try:
+            self._file.close()
+        finally:
+            if self._own_path:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
 class DenseTable:
     def __init__(self, shape, dtype="float32", optimizer="sgd", lr=0.01,
                  seed=0):
@@ -109,9 +237,18 @@ class DenseTable:
 _TABLES: Dict[str, object] = {}
 
 
-def _ps_create_sparse(name, dim, optimizer, lr, init_std, seed):
-    _TABLES.setdefault(name, SparseTable(dim, optimizer=optimizer, lr=lr,
-                                         init_std=init_std, seed=seed))
+def _ps_create_sparse(name, dim, optimizer, lr, init_std, seed,
+                      table_class="memory", cache_rows=100_000,
+                      path=None):
+    if name not in _TABLES:
+        if table_class == "ssd":
+            _TABLES[name] = SSDSparseTable(
+                dim, optimizer=optimizer, lr=lr, init_std=init_std,
+                seed=seed, cache_rows=cache_rows, path=path)
+        else:
+            _TABLES[name] = SparseTable(dim, optimizer=optimizer,
+                                        lr=lr, init_std=init_std,
+                                        seed=seed)
     return True
 
 
@@ -174,11 +311,15 @@ class PSClient:
 
     # -- table management -----------------------------------------------
     def create_sparse_table(self, name, dim, optimizer="sgd", lr=0.01,
-                            init_std=0.01):
+                            init_std=0.01, table_class="memory",
+                            cache_rows=100_000, path=None):
+        """``table_class="ssd"`` selects the two-tier disk-spilling
+        table (``SSDSparseTable``) on each server shard."""
         for k, s in enumerate(self.servers):
             # per-shard seed so shards don't repeat the same rows
             self._rpc(s, _ps_create_sparse, name, dim, optimizer, lr,
-                      init_std, k)
+                      init_std, k, table_class, cache_rows,
+                      None if path is None else f"{path}.shard{k}")
         self._dims = getattr(self, "_dims", {})
         self._dims[name] = int(dim)
         return name
