@@ -10,6 +10,8 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from .nn.functional import (softmax_mask_fuse,  # noqa: F401
+                            softmax_mask_fuse_upper_triangle)
 
 
 def softmax_mask_fuse_upper_triangle(x):

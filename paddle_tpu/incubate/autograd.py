@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from ..framework.core import (Tensor, as_jax, _wrap_out, no_grad,
                               functional_mode, tree_to_arrays)
 
-__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "grad", "forward_grad",
+__all__ = ["vmap", "jvp", "vjp", "Jacobian", "Hessian", "grad", "forward_grad",
            "enable_prim", "disable_prim", "prim_enabled"]
 
 
@@ -174,3 +174,27 @@ def disable_prim():
 
 def prim_enabled():
     return _prim
+
+
+def vmap(fn, in_axes=0, out_axes=0):
+    """``paddle.incubate.autograd.vmap`` — vectorizing map over the
+    leading (or given) axis, riding ``jax.vmap`` directly: the Tensor
+    function is rebound over arrays inside functional mode, so the
+    batched rule set is XLA's own (the reference re-derives vmap rules
+    per op; here they come with the compiler)."""
+    from ..framework.core import functional_mode
+
+    def wrapped(*args):
+        arrs = [as_jax(a) if isinstance(a, Tensor) else a for a in args]
+
+        def inner(*xs):
+            with functional_mode():
+                out = fn(*[_wrap_out(x) if hasattr(x, "dtype") else x
+                           for x in xs])
+            return jax.tree_util.tree_map(
+                lambda t: as_jax(t) if isinstance(t, Tensor) else t,
+                out, is_leaf=lambda v: isinstance(v, Tensor))
+
+        out = jax.vmap(inner, in_axes=in_axes, out_axes=out_axes)(*arrs)
+        return jax.tree_util.tree_map(_wrap_out, out)
+    return wrapped

@@ -324,3 +324,25 @@ def masked_multihead_attention(x, cache_kv=None, bias=None,
                                Tensor(x_arr), Tensor(cache),
                                n_outputs=2)
     return out, new_cache
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """``paddle.incubate.softmax_mask_fuse``: softmax(x + mask) as one
+    op (the reference's fused CUDA kernel; XLA fuses the add into the
+    softmax chain here — same single HBM pass)."""
+    def f(a, m):
+        return jax.nn.softmax((a.astype(jnp.float32)
+                               + m.astype(jnp.float32)),
+                              axis=-1).astype(a.dtype)
+    return apply_jax("softmax_mask_fuse", f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal-masked softmax (upper triangle masked out)."""
+    def f(a):
+        L = a.shape[-1]
+        rows = jnp.arange(a.shape[-2])[:, None]
+        cols = jnp.arange(L)[None, :]
+        af = jnp.where(cols > rows, -1e9, a.astype(jnp.float32))
+        return jax.nn.softmax(af, axis=-1).astype(a.dtype)
+    return apply_jax("softmax_mask_fuse_ut", f, x)

@@ -13,7 +13,7 @@ from ...framework.core import Tensor, apply_jax, as_jax, _wrap_out
 from ..functional.activation import softmax
 
 __all__ = [
-    "linear", "embedding", "dropout", "dropout2d", "dropout3d",
+    "linear", "embedding", "embedding_bag", "dropout", "dropout2d", "dropout3d",
     "alpha_dropout", "interpolate", "upsample", "pixel_shuffle",
     "pixel_unshuffle", "channel_shuffle", "one_hot",
     "scaled_dot_product_attention", "sequence_mask", "class_center_sample",
@@ -383,3 +383,61 @@ def zeropad2d(x, padding, data_format="NCHW", name=None):
             widths = [(0, 0), (t, b), (l, r), (0, 0)]
         return jnp.pad(a, widths)
     return apply_jax("zeropad2d", f, x)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean",
+                  per_sample_weights=None, name=None):
+    """``paddle.nn.functional.embedding_bag``: gather + bag-reduce of
+    embedding rows in one pass. 2-D ``input`` [B, L] reduces each row's
+    looked-up vectors; 1-D ``input`` with ``offsets`` reduces variable-
+    length bags (the torch-compatible form the reference mirrors).
+    Lowered to gathers + ``jax.ops.segment_sum`` — the embedding matrix
+    is never expanded beyond the looked-up rows."""
+    if mode not in ("mean", "sum", "max"):
+        raise ValueError(f"embedding_bag mode {mode!r}")
+    if per_sample_weights is not None and mode != "sum":
+        raise ValueError(
+            "embedding_bag: per_sample_weights requires mode='sum' "
+            "(reference semantics)")
+
+    def f2d(ids, w, *psw):
+        rows = jnp.take(w, ids.astype(jnp.int32), axis=0)  # [B, L, D]
+        if psw:
+            rows = rows * psw[0][..., None].astype(rows.dtype)
+        if mode == "sum":
+            return jnp.sum(rows, axis=1)
+        if mode == "mean":
+            return jnp.mean(rows, axis=1)
+        return jnp.max(rows, axis=1)
+
+    def f1d(ids, w, offs, *psw):
+        rows = jnp.take(w, ids.astype(jnp.int32), axis=0)  # [N, D]
+        if psw:
+            rows = rows * psw[0][:, None].astype(rows.dtype)
+        n = ids.shape[0]
+        nb = offs.shape[0]
+        # bag id per element from the offsets (bags are contiguous)
+        bag = jnp.sum(jnp.arange(n)[:, None]
+                      >= offs[None, :].astype(jnp.int32), axis=1) - 1
+        if mode == "max":
+            out = jax.ops.segment_max(rows, bag, num_segments=nb)
+            counts = jax.ops.segment_sum(jnp.ones(n, jnp.int32), bag,
+                                         num_segments=nb)
+            return jnp.where(counts[:, None] > 0, out,
+                             jnp.zeros_like(out))
+        s = jax.ops.segment_sum(rows, bag, num_segments=nb)
+        if mode == "sum":
+            return s
+        counts = jax.ops.segment_sum(jnp.ones(n, rows.dtype), bag,
+                                     num_segments=nb)
+        return s / jnp.maximum(counts[:, None], 1)
+
+    extra = [per_sample_weights] if per_sample_weights is not None \
+        else []
+    ids_arr = as_jax(input)
+    if ids_arr.ndim == 2:
+        return apply_jax("embedding_bag", f2d, input, weight, *extra)
+    if offsets is None:
+        raise ValueError("1-D embedding_bag input needs offsets")
+    return apply_jax("embedding_bag", f1d, input, weight, offsets,
+                     *extra)

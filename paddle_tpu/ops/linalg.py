@@ -16,7 +16,8 @@ __all__ = [
     "matmul", "bmm", "mm", "mv", "dot", "t", "norm", "vector_norm",
     "matrix_norm", "dist", "cholesky", "cholesky_solve", "qr", "svd",
     "svdvals", "inv", "inverse", "det", "slogdet", "solve",
-    "triangular_solve", "lstsq", "matrix_power", "eig", "eigh", "eigvals",
+    "triangular_solve", "lstsq", "matrix_power", "matrix_exp",
+    "cholesky_inverse", "svd_lowrank", "eig", "eigh", "eigvals",
     "eigvalsh", "pinv", "cond", "matrix_rank", "cross", "histogram",
     "histogramdd", "bincount", "mode", "lu", "lu_unpack", "corrcoef", "cov",
     "matrix_transpose", "householder_product", "pca_lowrank", "einsum",
@@ -428,3 +429,44 @@ def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
         prod = jnp.matmul(a, b)
         return beta * inp.astype(prod.dtype) + alpha * prod
     return apply_jax("baddbmm", f, input, x, y)
+
+
+def matrix_exp(x, name=None):
+    """``paddle.linalg.matrix_exp`` — matrix exponential via
+    jax.scipy's scaling-and-squaring Padé (the reference's CPU/GPU
+    kernel pair collapses to one XLA lowering)."""
+    return apply_jax("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """``paddle.linalg.cholesky_inverse``: inverse of A from its
+    Cholesky factor (solves A Z = I with the factor)."""
+    def f(l):
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        return jax.scipy.linalg.cho_solve((l, not upper), eye)
+    return apply_jax("cholesky_inverse", f, x)
+
+
+def svd_lowrank(x, q=None, niter=2, M=None, name=None):
+    """``paddle.linalg.svd_lowrank`` — randomized low-rank SVD
+    (Halko-Martinsson-Tropp subspace iteration; the reference wraps the
+    same algorithm)."""
+    def f(a, *rest):
+        if rest:
+            a = a - rest[0]
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(6, m, n) if q is None else min(int(q), m, n)
+        from ..framework import random as _random
+        key = _random.next_key()
+        omega = jax.random.normal(key, a.shape[:-2] + (n, k), a.dtype)
+        y = a @ omega
+        for _ in range(int(niter)):
+            qy, _ = jnp.linalg.qr(y)
+            qz, _ = jnp.linalg.qr(jnp.swapaxes(a, -1, -2) @ qy)
+            y = a @ qz
+        qy, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qy, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qy @ u_b, s, jnp.swapaxes(vh, -1, -2)
+    args = [x] + ([M] if M is not None else [])
+    return apply_jax("svd_lowrank", f, *args, n_outputs=3)

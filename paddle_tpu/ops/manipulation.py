@@ -33,6 +33,7 @@ __all__ = [
     "diagonal", "diagonal_scatter", "diag_embed", "fill_diagonal_",
     "shard_index", "tensordot", "rank", "shape",
     "column_stack", "row_stack", "take", "block_diag", "combinations",
+    "cartesian_prod",
     "hstack", "vstack", "dstack", "slice_scatter", "as_strided",
 ]
 
@@ -804,3 +805,15 @@ def as_strided(x, shape, stride, offset=0, name=None):
             idx = idx + (jnp.arange(sz) * st).reshape(grid_shape)
         return flat[idx.reshape(-1)].reshape(shape)
     return apply_jax("as_strided", f, x)
+
+
+def cartesian_prod(x, name=None):
+    """``paddle.cartesian_prod``: cartesian product of 1-D tensors."""
+    tensors = x if isinstance(x, (list, tuple)) else [x]
+
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        if len(arrs) == 1:
+            return arrs[0]
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_jax("cartesian_prod", f, *tensors)

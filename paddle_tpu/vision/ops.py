@@ -15,7 +15,7 @@ import numpy as np
 
 from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
 
-__all__ = ["nms", "roi_align", "box_coder", "yolo_box",
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box",
            "distribute_fpn_proposals", "deform_conv2d", "box_area",
            "box_iou"]
 
@@ -366,3 +366,63 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     args = (x, offset, weight) + ((mask,) if has_mask else ()) \
         + ((bias,) if has_bias else ())
     return apply_jax("deform_conv2d", f, *args)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """``paddle.vision.ops.roi_pool``: MAX pooling of each RoI over an
+    output_size grid (the Fast-R-CNN quantized pool; roi_align is the
+    bilinear successor). x: [N, C, H, W]; boxes: [R, 4] (x1,y1,x2,y2);
+    boxes_num: [N] rois per image."""
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    nums = np.asarray(as_jax(boxes_num)).astype(np.int64)
+    img_of_roi = np.repeat(np.arange(len(nums)), nums)
+
+    def f(x_a, boxes_a):
+        n, c, h, w = x_a.shape
+        scaled = boxes_a.astype(jnp.float32) * spatial_scale
+        # clamp to the feature map (paddle clamps hstart/hend/wstart/
+        # wend): out-of-image boxes pool the in-image part, never
+        # an empty window's float-min garbage
+        x1 = jnp.clip(jnp.floor(scaled[:, 0]), 0, w - 1).astype(
+            jnp.int32)
+        y1 = jnp.clip(jnp.floor(scaled[:, 1]), 0, h - 1).astype(
+            jnp.int32)
+        x2 = jnp.clip(jnp.ceil(scaled[:, 2]), 1, w).astype(jnp.int32)
+        y2 = jnp.clip(jnp.ceil(scaled[:, 3]), 1, h).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1, 1)
+        rh = jnp.maximum(y2 - y1, 1)
+        img = jnp.asarray(img_of_roi, jnp.int32)
+
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        neg = jnp.finfo(jnp.float32).min
+
+        def one(roi):
+            i, xx1, yy1, hh, ww_ = roi
+            feat = x_a[i].astype(jnp.float32)   # [C, H, W]
+            gy = jnp.arange(oh)
+            gx = jnp.arange(ow)
+            y_lo = yy1 + (gy * hh) // oh        # [oh]
+            y_hi = yy1 + jnp.maximum(((gy + 1) * hh + oh - 1) // oh, 1)
+            x_lo = xx1 + (gx * ww_) // ow
+            x_hi = xx1 + jnp.maximum(((gx + 1) * ww_ + ow - 1) // ow, 1)
+            in_y = (ys[None, :] >= y_lo[:, None]) & \
+                   (ys[None, :] < jnp.maximum(y_hi, y_lo + 1)[:, None])
+            in_x = (xs[None, :] >= x_lo[:, None]) & \
+                   (xs[None, :] < jnp.maximum(x_hi, x_lo + 1)[:, None])
+            # two-stage max: reduce W per x-cell, then H per y-cell —
+            # O(C*H*ow*W + C*oh*H*ow), never an [oh,ow,H,W] mask
+            rowred = jnp.max(
+                jnp.where(in_x[None, None], feat[:, :, None, :], neg),
+                axis=-1)                        # [C, H, ow]
+            out = jnp.max(
+                jnp.where(in_y[None, :, :, None],
+                          rowred[:, None, :, :], neg),
+                axis=2)                         # [C, oh, ow]
+            return out.astype(x_a.dtype)
+
+        return jax.vmap(one)((img, x1, y1, rh, rw))
+
+    return apply_jax("roi_pool", f, x, boxes)

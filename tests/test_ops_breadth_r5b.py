@@ -1,0 +1,110 @@
+"""Second round-5 breadth batch: matrix_exp, cholesky_inverse,
+svd_lowrank, roi_pool, softmax_mask_fuse, cartesian_prod, vmap,
+embedding_bag (references: ``paddle.linalg``, ``paddle.vision.ops``,
+``paddle.incubate``, ``paddle.nn.functional``)."""
+import numpy as np
+import pytest
+from scipy import linalg as sla
+
+import paddle_tpu as paddle
+
+
+def test_matrix_exp():
+    a = np.random.RandomState(0).randn(4, 4).astype(np.float32) * 0.3
+    out = paddle.linalg.matrix_exp(paddle.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(), sla.expm(a), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_cholesky_inverse():
+    rng = np.random.RandomState(1)
+    m = rng.randn(5, 5).astype(np.float32)
+    a = m @ m.T + 5 * np.eye(5, dtype=np.float32)
+    l = np.linalg.cholesky(a)
+    out = paddle.linalg.cholesky_inverse(paddle.to_tensor(l))
+    np.testing.assert_allclose(out.numpy(), np.linalg.inv(a),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_svd_lowrank():
+    rng = np.random.RandomState(2)
+    # a genuinely low-rank matrix: rank 3
+    a = (rng.randn(20, 3) @ rng.randn(3, 12)).astype(np.float32)
+    u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(a), q=5)
+    approx = u.numpy() @ np.diag(s.numpy()) @ v.numpy().T
+    np.testing.assert_allclose(approx, a, rtol=1e-3, atol=1e-3)
+    s_full = np.linalg.svd(a, compute_uv=False)
+    np.testing.assert_allclose(s.numpy()[:3], s_full[:3], rtol=1e-3)
+
+
+def test_roi_pool():
+    x = np.arange(2 * 1 * 8 * 8, dtype=np.float32).reshape(2, 1, 8, 8)
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], np.float32)
+    nums = np.array([1, 1], np.int32)
+    out = paddle.vision.ops.roi_pool(
+        paddle.to_tensor(x), paddle.to_tensor(boxes),
+        paddle.to_tensor(nums), output_size=2)
+    assert tuple(out.shape) == (2, 1, 2, 2)
+    # roi 0 on image 0: windows [0:2,0:2],[0:2,2:4],[2:4,0:2],[2:4,2:4]
+    np.testing.assert_allclose(out.numpy()[0, 0],
+                               [[9., 11.], [25., 27.]])
+    # roi 1 on image 1 (feature base 64): window maxes of [2:8] quads
+    ref = x[1, 0]
+    np.testing.assert_allclose(
+        out.numpy()[1, 0],
+        [[ref[2:5, 2:5].max(), ref[2:5, 5:8].max()],
+         [ref[5:8, 2:5].max(), ref[5:8, 5:8].max()]])
+
+
+def test_softmax_mask_fuse():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    mask = np.where(rng.rand(2, 1, 8, 8) > 0.5, 0.0, -1e9) \
+        .astype(np.float32)
+    out = paddle.incubate.softmax_mask_fuse(
+        paddle.to_tensor(x), paddle.to_tensor(mask))
+    ref = np.exp(x + mask - (x + mask).max(-1, keepdims=True))
+    ref = ref / ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-6)
+    ut = paddle.incubate.softmax_mask_fuse_upper_triangle(
+        paddle.to_tensor(x))
+    arr = ut.numpy()
+    assert np.allclose(arr[..., 0, 1:], 0.0)   # causal row 0
+
+
+def test_cartesian_prod():
+    a = paddle.to_tensor(np.array([1, 2], np.int64))
+    b = paddle.to_tensor(np.array([3, 4, 5], np.int64))
+    out = paddle.cartesian_prod([a, b])
+    assert tuple(out.shape) == (6, 2)
+    np.testing.assert_array_equal(
+        out.numpy(), [[1, 3], [1, 4], [1, 5], [2, 3], [2, 4], [2, 5]])
+
+
+def test_incubate_vmap():
+    def f(x):
+        return (x * 2.0).sum()
+
+    batched = paddle.incubate.autograd.vmap(f)
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    out = batched(x)
+    np.testing.assert_allclose(out.numpy(), [2., 10., 18.])
+
+
+def test_embedding_bag_2d_and_offsets():
+    w = paddle.to_tensor(
+        np.arange(20, dtype=np.float32).reshape(10, 2))
+    ids2 = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int64))
+    out = paddle.nn.functional.embedding_bag(ids2, w, mode="mean")
+    np.testing.assert_allclose(out.numpy(), [[1., 2.], [5., 6.]])
+    out_sum = paddle.nn.functional.embedding_bag(ids2, w, mode="sum")
+    np.testing.assert_allclose(out_sum.numpy(), [[2., 4.], [10., 12.]])
+    # 1-D + offsets: bags [0,1,2] and [3]
+    ids1 = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    offs = paddle.to_tensor(np.array([0, 3], np.int64))
+    out1 = paddle.nn.functional.embedding_bag(ids1, w, offsets=offs,
+                                              mode="sum")
+    np.testing.assert_allclose(out1.numpy(), [[6., 9.], [6., 7.]])
+    outm = paddle.nn.functional.embedding_bag(ids1, w, offsets=offs,
+                                              mode="max")
+    np.testing.assert_allclose(outm.numpy(), [[4., 5.], [6., 7.]])
