@@ -86,6 +86,11 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     times = []
     it = 0
     for _ in range(max(int(windows), 1)):
+        # burn one untimed trial per window so a cold-cache/compile
+        # straggler can never land inside the measurement (r5 weak #5)
+        loss = step(*pool[it % len(pool)])
+        it += 1
+        _ = float(loss.numpy())
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = step(*pool[it % len(pool)])
@@ -123,8 +128,12 @@ def _moe_bench(dropless=False):
     """Qwen2-MoE-shaped pretrain step: tokens/s/chip + MFU + router drop
     rate (single-chip scale of the 57B-A14B geometry: GQA attention,
     shared expert + 32 routed experts, top-4). ``dropless=True`` swaps
-    the capacity-limited GShard dispatch for the ragged grouped-matmul
-    path (zero drops)."""
+    the capacity-limited GShard dispatch for the grouped-matmul path
+    (zero drops); since r6 BOTH modes run the sort-based grouped
+    engine (megablox on TPU). The default expert width is h-scaled
+    (1408 = 1.375h vs r5's 704): 1024-in 704-out matmuls starved the
+    MXU — wider experts raise arithmetic intensity at the same
+    active-param accounting."""
     import gc
     import paddle_tpu as paddle
     from paddle_tpu.jit import TrainStep
@@ -137,7 +146,7 @@ def _moe_bench(dropless=False):
         hidden_size=int(os.environ.get("BENCH_MOE_HIDDEN", 1024)),
         intermediate_size=int(os.environ.get("BENCH_MOE_FFN", 2816)),
         moe_intermediate_size=int(
-            os.environ.get("BENCH_MOE_EFFN", 704)),
+            os.environ.get("BENCH_MOE_EFFN", 1408)),
         shared_expert_intermediate_size=int(
             os.environ.get("BENCH_MOE_SFFN", 2816)),
         num_hidden_layers=int(os.environ.get("BENCH_MOE_LAYERS", 4)),
@@ -167,12 +176,20 @@ def _moe_bench(dropless=False):
 
     drops = model.collect_drop_rates(x)
 
+    from paddle_tpu.distributed.moe import moe_stats, reset_moe_stats
+    reset_moe_stats()
     loss = step(*pool[0])
     _ = float(loss.numpy())
+    kernel_stats = moe_stats()
     # tunnel noise is ±7-10% per window: median of 3 windows
     times = []
     it = 0
     for _ in range(3):
+        # burn one untimed trial per window (r5 weak #5: cold trials
+        # were landing inside the median's input)
+        loss = step(*pool[it % len(pool)])
+        it += 1
+        _ = float(loss.numpy())
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = step(*pool[it % len(pool)])
@@ -197,6 +214,9 @@ def _moe_bench(dropless=False):
         "n_params": n_params,
         "active_params": active_params,
         "dispatch": "dropless" if dropless else "gshard_capacity",
+        # which grouped kernel the train step actually compiled
+        # (megablox on TPU / ragged_dot fallback) + path counters
+        "kernel_stats": kernel_stats,
         "drop_rate_mean": round(float(np.mean(drops)), 4),
         "drop_rate_per_block": [round(d, 4) for d in drops],
         "loss": round(val, 4),
@@ -209,6 +229,79 @@ def _moe_bench(dropless=False):
     del step, opt, model, loss, pool, x
     gc.collect()
     return out
+
+
+def _moe_stage_profile():
+    """Step-profile of ONE MoE block at the bench shapes, broken into
+    the dispatch pipeline's stages: route+sort+gather (dispatch), the
+    two grouped expert matmuls (expert_mm), and unsort+weighted-sum
+    (combine) — so the remaining MoE-vs-dense MFU gap is attributable
+    to a stage instead of a guess. Stages are jitted SEPARATELY, so
+    boundaries materialize to HBM: the sum slightly exceeds the fused
+    in-graph cost — use for attribution, not as a step time. a2a_ms is
+    None on a single chip (the explicit all-to-all pair only exists
+    inside the EP shard_map path; under a sharded run its cost is the
+    profile's residual)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import moe as M
+
+    hidden = int(os.environ.get("BENCH_MOE_HIDDEN", 1024))
+    effn = int(os.environ.get("BENCH_MOE_EFFN", 1408))
+    experts = int(os.environ.get("BENCH_MOE_EXPERTS", 32))
+    topk = int(os.environ.get("BENCH_MOE_TOPK", 4))
+    tokens = int(os.environ.get("BENCH_MOE_BATCH", 4)) * 2048
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(tokens, hidden)).astype(jnp.bfloat16)
+    logits = jnp.asarray(rng.randn(tokens, experts)) \
+        .astype(jnp.bfloat16)
+    gu_w = jnp.asarray(0.02 * rng.randn(experts, hidden, 2 * effn)) \
+        .astype(jnp.bfloat16)
+    dn_w = jnp.asarray(0.02 * rng.randn(experts, effn, hidden)) \
+        .astype(jnp.bfloat16)
+
+    @jax.jit
+    def route(x, logits):
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        tp, ti = jax.lax.top_k(probs, topk)
+        flat_e = ti.astype(jnp.int32).reshape(-1)
+        order, rank, counts = M._sort_pairs(flat_e, experts)
+        gates = (tp / jnp.maximum(tp.sum(-1, keepdims=True), 1e-9)) \
+            .astype(x.dtype)
+        xs = jnp.take(x, order // topk, axis=0)
+        return xs, counts, rank, order, gates
+
+    @jax.jit
+    def expert_mm(xs, counts):
+        return M._expert_swiglu_grouped(xs, gu_w, dn_w, counts,
+                                        xs.dtype)
+
+    @jax.jit
+    def combine(ys, rank, gates):
+        picked = jnp.take(ys, rank, axis=0).reshape(tokens, topk, -1)
+        return jnp.einsum("sk,skd->sd", gates, picked)
+
+    def timeit(f, *args, n=20):
+        r = jax.block_until_ready(f(*args))     # compile + warm
+        r = jax.block_until_ready(f(*args))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = f(*args)
+        jax.block_until_ready(r)
+        return round((time.perf_counter() - t0) / n * 1000, 3)
+
+    xs, counts, rank, order, gates = jax.block_until_ready(
+        route(x, logits))
+    ys = jax.block_until_ready(expert_mm(xs, counts))
+    return {
+        "tokens": tokens, "experts": experts, "top_k": topk,
+        "hidden": hidden, "expert_ffn": effn,
+        "dispatch_ms": timeit(route, x, logits),
+        "expert_mm_ms": timeit(expert_mm, xs, counts),
+        "combine_ms": timeit(combine, ys, rank, gates),
+        "a2a_ms": None,
+    }
 
 
 def _flashmask_bench():
@@ -292,6 +385,11 @@ def _decode_bench():
     x = paddle.to_tensor(ids.astype(np.int64))
 
     def run_trials(n=5):
+        # burn one untimed trial first: the first post-warmup generate
+        # was still ~half the median (r5 weak #5) — never let it into
+        # the median's input
+        out, _ = model.generate(x, max_new_tokens=new)
+        _ = out.numpy()
         vals = []
         for _ in range(n):                       # tunnel-noise robust
             t0 = time.perf_counter()
@@ -417,6 +515,10 @@ def main():
     except Exception as exc:
         moe_dropless = {"error": repr(exc)}
     try:
+        moe_profile = _moe_stage_profile()
+    except Exception as exc:
+        moe_profile = {"error": repr(exc)}
+    try:
         decode = _decode_bench()
     except Exception as exc:
         decode = {"error": repr(exc)}
@@ -428,7 +530,8 @@ def main():
     detail = {"large": large, "base": base,
               "remat_regime": remat_regime, "deep": deep,
               "deep32": deep32, "moe": moe,
-              "moe_dropless": moe_dropless, "decode": decode,
+              "moe_dropless": moe_dropless,
+              "moe_profile": moe_profile, "decode": decode,
               "flashmask": flashmask}
     # headline FIRST and compact (<4KB) so driver tail-capture can
     # never truncate "value"; full per-config detail goes to a file
@@ -440,7 +543,7 @@ def main():
         "summary": {
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
-            if k not in ("decode", "flashmask")
+            if k not in ("decode", "flashmask", "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,

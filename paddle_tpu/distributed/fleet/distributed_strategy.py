@@ -30,6 +30,10 @@ class DistributedStrategy:
         self.hybrid_configs: Dict[str, Any] = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
             "sharding_degree": 1, "sep_degree": 1,
+            # expert-parallel degree: MoE expert dim shards over the
+            # "ep" mesh axis; dropless dispatch runs grouped matmuls
+            # inside a shard_map over it (distributed/moe.py)
+            "ep_degree": 1,
             # mechanism consuming the sep axis: "ulysses" (all-to-all
             # head<->seq, the reference's sep semantics) or "ring"
             # (ppermute KV ring / context parallel)

@@ -5,6 +5,11 @@ The reference builds cartesian NCCL process groups in axis order
 (pp, dp, sharding, sep, mp). Here the same degrees define a
 ``jax.sharding.Mesh`` with those named axes — each "communication group"
 is a mesh axis, and XLA emits the collectives over ICI (SURVEY.md §5.8).
+Since r6 the mesh also carries an ``ep`` (expert-parallel) axis between
+sep and mp: MoE layers shard their stacked expert dim over it and the
+dropless dispatch runs its explicit all-to-alls inside a shard_map over
+this axis (``distributed/moe.py::_dropless_ep``). Degree-1 axes are
+inert, so non-MoE configs are unaffected.
 
 On a single-controller jax runtime every process sees all devices, so the
 "rank in group" notions are derived from the mesh coordinates of the
@@ -22,14 +27,14 @@ import jax
 from ..collective import Group
 from .. import env as _env
 
-_AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+_AXIS_ORDER = ("pp", "dp", "sharding", "sep", "ep", "mp")
 
 
 class CommunicateTopology:
     def __init__(self, hybrid_group_names=None, dims=None):
         self._parallel_names = list(hybrid_group_names
                                     or ["pipe", "data", "sharding", "sep",
-                                        "model"])
+                                        "expert", "model"])
         self._dims = list(dims or [1] * len(self._parallel_names))
         self._world_size = int(np.prod(self._dims))
         self._coords = np.arange(self._world_size).reshape(self._dims)
@@ -74,16 +79,29 @@ class HybridCommunicateGroup:
             cfg = strategy.hybrid_configs
             dims = [cfg.get("pp_degree", 1), cfg.get("dp_degree", 1),
                     cfg.get("sharding_degree", 1),
-                    cfg.get("sep_degree", 1), cfg.get("mp_degree", 1)]
+                    cfg.get("sep_degree", 1), cfg.get("ep_degree", 1),
+                    cfg.get("mp_degree", 1)]
             topology = CommunicateTopology(
-                ["pipe", "data", "sharding", "sep", "model"], dims)
+                ["pipe", "data", "sharding", "sep", "expert", "model"],
+                dims)
         self._topo = topology
+        if "expert" not in self._topo._parallel_names:
+            # accept a caller-built 5-axis topology (pre-r6 layout):
+            # splice in a degree-1 expert axis so the mesh always
+            # carries the full _AXIS_ORDER
+            names = list(self._topo._parallel_names)
+            dims = list(self._topo._dims)
+            i = names.index("model") if "model" in names else len(names)
+            names.insert(i, "expert")
+            dims.insert(i, 1)
+            self._topo = CommunicateTopology(names, dims)
         dims = self._topo._dims
         self._dp_degree = self._topo.get_dim("data")
         self._mp_degree = self._topo.get_dim("model")
         self._pp_degree = self._topo.get_dim("pipe")
         self._sharding_degree = self._topo.get_dim("sharding")
         self._sep_degree = self._topo.get_dim("sep")
+        self._ep_degree = self._topo.get_dim("expert")
 
         n_needed = self._topo.world_size()
         devices = jax.devices()
@@ -102,6 +120,7 @@ class HybridCommunicateGroup:
         self._pp_rank = coord.pipe
         self._sharding_rank = coord.sharding
         self._sep_rank = coord.sep
+        self._ep_rank = coord.expert
 
         self._dp_group = Group(
             self._topo.get_axis_list("data", 0), axis_name="dp")
@@ -113,6 +132,8 @@ class HybridCommunicateGroup:
             self._topo.get_axis_list("sharding", 0), axis_name="sharding")
         self._sep_group = Group(
             self._topo.get_axis_list("sep", 0), axis_name="sep")
+        self._ep_group = Group(
+            self._topo.get_axis_list("expert", 0), axis_name="ep")
 
     # mesh access (TPU-native extension point)
     @property
@@ -188,6 +209,15 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._sep_group
+
+    def get_expert_parallel_rank(self):
+        return self._ep_rank
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     def get_p2p_groups(self):
         return None

@@ -2,12 +2,30 @@
 (``python/paddle/incubate/distributed/models/moe/moe_layer.py`` +
 ``gate/*.py`` parity).
 
-TPU-first (SURVEY.md §7.4): GShard-style static-capacity dispatch. Expert
-weights are stacked with a leading expert dim sharded over the expert
-axis; dispatch/combine are einsums against one-hot capacity masks, so the
-all-to-all the reference codes against ProcessGroup appears as GSPMD
-collectives when the expert dim is mesh-sharded. Static shapes throughout
-(capacity padding), as jit requires.
+TPU-first (SURVEY.md §7.4). Three dispatch formulations share one
+sort-based router (``_sort_pairs``: a stable argsort groups (token,
+slot) pairs expert-major; the inverse permutation is one int32
+scatter):
+
+- ``moe_dispatch_combine`` — GShard static-capacity dispatch against a
+  ``[e, c, d]`` padded buffer; works with ARBITRARY per-expert layers
+  (``expert_fn``) and under any GSPMD sharding. The all-to-all the
+  reference codes against ProcessGroup appears as GSPMD collectives
+  when the expert dim is mesh-sharded.
+- ``moe_dispatch_combine_grouped`` — capacity SEMANTICS on the
+  grouped-matmul engine for stacked SwiGLU experts: dropped pairs are
+  zero-gated instead of excluded, so compute is the dropless total
+  (s*k rows) with no capacity padding.
+- ``moe_dispatch_combine_dropless`` — capacity-free routing as two
+  grouped matmuls (megablox Pallas kernel on TPU, lax.ragged_dot
+  elsewhere). Under an expert-sharded mesh the whole pipeline runs
+  INSIDE ``shard_map`` (``_dropless_ep``): explicit all-to-alls place
+  pairs on the shard owning their expert, the grouped kernels run on
+  static per-shard shapes, and a hand-written custom VJP replays the
+  same structure backward with separately tuned tilings.
+
+``MOE_STATS`` records (at trace time) which path/kernel a compilation
+took; static shapes throughout, as jit requires.
 """
 from __future__ import annotations
 
@@ -24,7 +42,9 @@ from ..nn.layer.layers import Layer
 from .shard_utils import annotate_param, constraint, mesh_axis_size
 
 __all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
-           "moe_dispatch_combine", "ClipGradForMOEByGlobalNorm"]
+           "moe_dispatch_combine", "moe_dispatch_combine_dropless",
+           "moe_dispatch_combine_grouped", "moe_stats",
+           "reset_moe_stats", "ClipGradForMOEByGlobalNorm"]
 
 
 from ..nn.clip import ClipGradByGlobalNorm as _ClipGradByGlobalNorm
@@ -120,30 +140,48 @@ class SwitchGate(NaiveGate):
 import functools as _functools
 
 
-def _positions(onehot, flat_e):
-    """(pos_within_expert [N], counts [E]) from routing one-hots.
+# Trace-time path-selection statistics. Incremented while the dispatch
+# functions TRACE (not per executed step), so a test — or an operator
+# reading bench output — can prove WHICH kernel a given mesh/shape
+# combination compiled: the megablox grouped Pallas kernel, the
+# lax.ragged_dot grouped fallback, or the dense capacity-padded einsum
+# path, and whether the EP shard_map fast path was entered.
+MOE_STATS = {
+    "grouped_mm_calls": 0,        # grouped-matmul call sites traced
+    "grouped_mm_kernel": None,    # "megablox" | "ragged_dot" (last)
+    "ep_shard_map_calls": 0,      # EP fast-path dispatches traced
+    "padded_einsum_calls": 0,     # dense capacity-padded dispatches
+}
 
-    A plain ``jnp.cumsum`` over N=32k rows lowers to a long serial
-    scan on TPU (~1.4 ms at bench shapes); chunking into 128-row tiles
-    turns it into one batched triangular f32 matmul (MXU) plus a
-    256-step scan over chunk totals (0.93 ms, bit-exact — f32 is exact
-    for counts < 2^24)."""
-    n, e = onehot.shape
-    if n % 128 or n < 256:
-        cum = jnp.cumsum(onehot, axis=0) - onehot
-        pos = jnp.take_along_axis(cum, flat_e[:, None], axis=1)[:, 0]
-        return pos.astype(jnp.int32), jnp.sum(onehot, axis=0)
-    c = 128
-    nc = n // c
-    x = onehot.reshape(nc, c, e).astype(jnp.float32)
-    tri = jnp.tril(jnp.ones((c, c), jnp.float32), k=-1)  # exclusive
-    within = jnp.einsum("ij,nje->nie", tri, x)
-    chunk_tot = x.sum(axis=1)
-    offs = jnp.cumsum(chunk_tot, axis=0) - chunk_tot
-    pos = (within + offs[:, None, :]).reshape(n, e)
-    pos = jnp.take_along_axis(pos, flat_e[:, None], axis=1)[:, 0]
-    return pos.astype(jnp.int32), chunk_tot.sum(axis=0).astype(
-        onehot.dtype)
+
+def reset_moe_stats():
+    MOE_STATS.update(grouped_mm_calls=0, grouped_mm_kernel=None,
+                     ep_shard_map_calls=0, padded_einsum_calls=0)
+
+
+def moe_stats():
+    return dict(MOE_STATS)
+
+
+def _sort_pairs(flat_e, e, valid=None):
+    """Sort-based token→expert grouping (replaces the r5 chunked-cumsum
+    position scan, which profiling showed dominating dispatch at bench
+    shapes). A single stable argsort of the pair→expert keys groups the
+    (token, slot) pairs expert-major while preserving arrival order —
+    so capacity semantics (earlier tokens win slots) are unchanged —
+    and its inverse permutation comes from one int32 scatter.
+
+    Returns ``(order, rank, counts)``: ``order[r]`` = pair index at
+    sorted position r, ``rank`` = inverse permutation, ``counts[j]`` =
+    pairs routed to expert j. Pairs with ``valid=False`` get sentinel
+    key ``e`` so they sort last and are excluded from ``counts``."""
+    n = flat_e.shape[0]
+    key = flat_e if valid is None else jnp.where(valid, flat_e, e)
+    order = jnp.argsort(key).astype(jnp.int32)
+    rank = jnp.zeros(n, jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+    counts = jnp.zeros(e, jnp.int32).at[key].add(1, mode="drop")
+    return order, rank, counts
 
 
 @_functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -289,29 +327,29 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
     # top-k selection
     topk_prob, topk_idx = jax.lax.top_k(probs, top_k)  # [s, k]
 
-    # position of each (token, k) within its expert's queue
-    onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.int32)  # [s, k, e]
-
     sel = None
     if second_expert_policy == "random" and rng_key is not None \
             and top_k >= 2:
         u = jax.random.uniform(rng_key, (s, top_k))
         sel = u < jnp.minimum(top_k * topk_prob, 1.0)
         sel = sel.at[:, 0].set(True)  # 1st choice always dispatches
-        onehot = onehot * sel[..., None].astype(onehot.dtype)
 
-    flat = onehot.reshape(s * top_k, e)
-    # chunked MXU scan (see _positions) instead of a serial cumsum
-    pos, _counts = _positions(flat, topk_idx.reshape(-1).astype(
-        jnp.int32))
-    pos = pos.reshape(s, top_k)
-    slot_used = jnp.sum(onehot, axis=-1) > 0  # [s, k]
+    # position of each (token, k) within its expert's queue via the
+    # sort-based grouping (random-skipped slots don't consume capacity)
+    flat_e_all = topk_idx.reshape(-1).astype(jnp.int32)
+    _order, rank, counts = _sort_pairs(
+        flat_e_all, e, valid=None if sel is None else sel.reshape(-1))
+    starts = jnp.cumsum(counts) - counts
+    pos = (rank - starts[flat_e_all]).reshape(s, top_k)
+    slot_used = jnp.ones((s, top_k), bool) if sel is None else sel
     keep = (pos < c) & slot_used
 
     # load-balancing aux loss (GShard eq.: e * sum(me * ce))
     me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(onehot[:, 0].astype(jnp.float32), axis=0)
+    ce = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], e,
+                                 dtype=jnp.float32), axis=0)
     aux = e * jnp.sum(me * ce)
+    MOE_STATS["padded_einsum_calls"] += 1
 
     # random-skipped slots are zeroed BEFORE normalization (GShard/
     # fairseq top2gating order): a token whose 2nd expert was skipped
@@ -391,19 +429,37 @@ def _gmm32_fwd(lhs, rhs, group_sizes, tiling):
     return _gmm32(lhs, rhs, group_sizes, tiling), (lhs, rhs, group_sizes)
 
 
-def _gmm32_bwd(tiling, res, g):
+def _mb_bwd_dlhs(g, rhs, group_sizes):
+    """Raw megablox d(lhs): transpose-rhs gmm under the bwd tiling."""
     import importlib
     _mb = importlib.import_module(
         "jax.experimental.pallas.ops.tpu.megablox.gmm")
     from ..ops.pallas.flash_attention_kernel import disable_x64
-    lhs, rhs, gs = res
     with disable_x64():
-        dlhs = _mb.gmm(g, rhs, gs, preferred_element_type=lhs.dtype,
+        return _mb.gmm(g, rhs, group_sizes,
+                       preferred_element_type=g.dtype,
                        tiling=_GMM_TILING_BWD, transpose_rhs=True)
-        drhs = _mb.tgmm(lhs.swapaxes(0, 1), g, gs,
-                        preferred_element_type=rhs.dtype,
+
+
+def _mb_bwd_drhs(lhs, g, group_sizes, num_groups):
+    """Raw megablox d(rhs): tgmm under the bwd tiling."""
+    import importlib
+    _mb = importlib.import_module(
+        "jax.experimental.pallas.ops.tpu.megablox.gmm")
+    from ..ops.pallas.flash_attention_kernel import disable_x64
+    with disable_x64():
+        return _mb.tgmm(lhs.swapaxes(0, 1), g, group_sizes,
+                        preferred_element_type=g.dtype,
                         tiling=_GMM_TILING_BWD,
-                        num_actual_groups=rhs.shape[0])
+                        num_actual_groups=num_groups)
+
+
+def _gmm32_bwd(tiling, res, g):
+    # no fallback here by design: a shape that traced the forward
+    # kernel traces the backward (same block alignment, dims swapped)
+    lhs, rhs, gs = res
+    dlhs = _mb_bwd_dlhs(g, rhs, gs)
+    drhs = _mb_bwd_drhs(lhs, g, gs, rhs.shape[0])
     return dlhs.astype(lhs.dtype), drhs.astype(rhs.dtype), None
 
 
@@ -415,10 +471,12 @@ def _use_megablox(n_rows, d_in, d_out):
     at MXU-scale shapes (measured 2.25 -> 1.70 ms at [32768, 1024, 1408])
     but needs a tpu backend and 8-aligned dims (its TILE dims carry the
     (8, 128) rule; array dims only need sublane alignment — d=704 works
-    under the fixed (512, 1024, 512) tiling). Everything else (CPU test
-    meshes, tiny shapes, expert-sharded runs where GSPMD owns the
-    partitioning) takes the ragged_dot path, as does any shape the
-    kernel rejects at trace time (see the fallback in the caller)."""
+    under the fixed (512, 1024, 512) tiling). Since r6 this predicate
+    also gates the PER-SHARD shapes inside the EP shard_map fast path —
+    per-shard buffer shapes are static there, so the kernel is legal
+    under expert sharding. CPU test meshes, tiny shapes, and any shape
+    the kernel rejects at trace time take the ragged_dot path (see the
+    fallback in the callers)."""
     try:
         if jax.default_backend() != "tpu":
             return False
@@ -427,107 +485,416 @@ def _use_megablox(n_rows, d_in, d_out):
     return (n_rows >= 1024 and d_in % 8 == 0 and d_out % 8 == 0)
 
 
+def _grouped_mm(lhs, rhs, group_sizes, tiling=None,
+                allow_pallas=True):
+    """Single entry point for the grouped expert matmul: the megablox
+    Pallas kernel on real TPU at MXU-scale aligned shapes (fwd AND bwd
+    run grouped kernels via the ``_gmm32`` custom VJP, with the
+    separately tuned backward tiling), ``jax.lax.ragged_dot`` elsewhere.
+    Increments ``MOE_STATS`` at trace time so tests can assert which
+    kernel a given mesh/shape combination actually compiled.
+
+    ``allow_pallas=False`` forces ragged_dot: the Pallas kernel is only
+    legal on REPLICATED/manual (shard_map) operands — under GSPMD
+    sharding an opaque pallas_call can't be partitioned, so the sharded
+    non-shard_map fallback path must keep the r5 ragged_dot gate."""
+    MOE_STATS["grouped_mm_calls"] += 1
+    if allow_pallas and _use_megablox(lhs.shape[0], lhs.shape[1],
+                                      rhs.shape[-1]):
+        try:
+            out = _gmm32(lhs, rhs, group_sizes, tiling or _GMM_TILING)
+            MOE_STATS["grouped_mm_kernel"] = "megablox"
+            return out
+        except Exception as exc:
+            import warnings
+            warnings.warn(
+                "moe: megablox gmm unavailable for shape "
+                f"{lhs.shape} x {rhs.shape} ({exc!r}); using "
+                "lax.ragged_dot")
+    MOE_STATS["grouped_mm_kernel"] = "ragged_dot"
+    return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+
+
+def _grouped_mm_dlhs(g, rhs, group_sizes):
+    """d(lhs) of the grouped matmul for the hand-written EP backward:
+    transpose-rhs grouped matmul with the backward tiling."""
+    MOE_STATS["grouped_mm_calls"] += 1
+    if _use_megablox(g.shape[0], g.shape[1], rhs.shape[1]):
+        try:
+            out = _mb_bwd_dlhs(g, rhs, group_sizes)
+            MOE_STATS["grouped_mm_kernel"] = "megablox"
+            return out
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"moe: megablox bwd gmm unavailable "
+                          f"({exc!r}); using lax.ragged_dot")
+    MOE_STATS["grouped_mm_kernel"] = "ragged_dot"
+    return jax.lax.ragged_dot(g, rhs.swapaxes(1, 2), group_sizes)
+
+
+def _grouped_mm_drhs(lhs, g, group_sizes, num_groups):
+    """d(rhs) of the grouped matmul for the hand-written EP backward:
+    megablox tgmm with the backward tiling on TPU, the linear transpose
+    of ragged_dot elsewhere."""
+    MOE_STATS["grouped_mm_calls"] += 1
+    if _use_megablox(lhs.shape[0], lhs.shape[1], g.shape[-1]):
+        try:
+            out = _mb_bwd_drhs(lhs, g, group_sizes, num_groups)
+            MOE_STATS["grouped_mm_kernel"] = "megablox"
+            return out
+        except Exception as exc:
+            import warnings
+            warnings.warn(f"moe: megablox tgmm unavailable "
+                          f"({exc!r}); using ragged_dot transpose")
+    MOE_STATS["grouped_mm_kernel"] = "ragged_dot"
+    shape = jax.ShapeDtypeStruct(
+        (num_groups, lhs.shape[1], g.shape[-1]), g.dtype)
+    transposed = jax.linear_transpose(
+        lambda r: jax.lax.ragged_dot(lhs, r, group_sizes), shape)
+    return transposed(g)[0]
+
+
+def _expert_swiglu_grouped(xs, gate_up, down, group_sizes, dtype,
+                           allow_pallas=True):
+    """Expert SwiGLU MLP over expert-sorted rows as TWO grouped
+    matmuls (``[n, d] x [e, d, 2f] -> [n, 2f]``, swiglu,
+    ``[n, f] x [e, f, d] -> [n, d]``)."""
+    gu = _grouped_mm(xs, gate_up.astype(dtype), group_sizes,
+                     allow_pallas=allow_pallas)
+    g, u = jnp.split(gu, 2, axis=-1)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(dtype) * u
+    return _grouped_mm(h, down.astype(dtype), group_sizes,
+                       allow_pallas=allow_pallas)
+
+
 def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
                                   gate_up, down, normalize_gates=True,
-                                  expert_axis=None, return_stats=False):
+                                  expert_axis=None, return_stats=False,
+                                  ep_buffer_factor=2.0):
     """DROPLESS dispatch → SwiGLU experts → combine (reference:
     capacity-free routing the fused-MoE kernels in
     ``phi/kernels/fusion/`` approximate; design follows the MegaBlocks
     grouped-matmul formulation).
 
     No capacity factor and no dropped tokens: (token, slot) pairs are
-    grouped by expert and the expert MLP runs as TWO grouped matmuls —
-    the megablox Pallas kernel on real TPU (tiles each ragged expert
-    segment onto the MXU), ``jax.lax.ragged_dot`` elsewhere. The sorted
-    order is derived WITHOUT an argsort: position-within-expert comes
-    from a cumsum over the routing one-hots, and
-    ``rank = group_start[expert] + pos`` is itself the inverse
-    permutation, so sort and unsort are gathers in both autodiff
-    directions (``_expand_sort`` / ``_perm_rows`` custom VJPs). Under an
-    expert-sharded mesh the cross-device exchange this implies is
-    ``ragged_all_to_all``; inside one jitted program GSPMD inserts the
-    equivalent collectives from the sharding annotations.
+    grouped by expert with ONE stable argsort (``_sort_pairs``) and the
+    expert MLP runs as TWO grouped matmuls — the megablox Pallas kernel
+    on real TPU (tiles each ragged expert segment onto the MXU),
+    ``jax.lax.ragged_dot`` elsewhere. Sort and unsort are gathers in
+    both autodiff directions (``_expand_sort`` / ``_perm_rows`` custom
+    VJPs). Under an expert-sharded mesh the whole pipeline moves INSIDE
+    ``shard_map`` (``_dropless_ep``): explicit all-to-alls place each
+    pair on the shard owning its expert, the grouped kernels run on
+    static per-shard shapes, and a hand-written custom VJP replays the
+    same structure backward with the separately tuned backward tilings.
+    ``ep_buffer_factor`` bounds the per-(src, dst) exchange slots;
+    >= the EP degree is exactly dropless (overflow is reported in the
+    ``drop_rate`` stat).
 
     x: [s, d]; gate_logits: [s, e]; gate_up: [e, d, 2f]; down: [e, f, d].
-    Returns (y [s, d], aux) (+ stats dict with drop_rate=0.0).
+    Returns (y [s, d], aux) (+ stats dict with drop_rate).
     """
+    return _grouped_dispatch(
+        x, gate_logits, num_expert, top_k, gate_up, down,
+        capacity_factor=None, normalize_gates=normalize_gates,
+        expert_axis=expert_axis, ep_buffer_factor=ep_buffer_factor,
+        return_stats=return_stats)
+
+
+def moe_dispatch_combine_grouped(x, gate_logits, num_expert, top_k,
+                                 gate_up, down, capacity_factor=1.25,
+                                 normalize_gates=True,
+                                 second_expert_policy="all",
+                                 rng_key=None, expert_axis=None,
+                                 return_stats=False):
+    """GShard CAPACITY semantics on the grouped-matmul engine: same
+    routing, same capacity rule (earlier tokens win their expert's
+    slots), same gate zeroing for dropped pairs as the padded
+    ``moe_dispatch_combine`` — but the expert MLP runs as two grouped
+    matmuls over expert-sorted rows instead of the ``[e, c, d]``
+    capacity-padded batched einsum. Dropped pairs are zero-gated at
+    combine rather than excluded from the matmul, so the compute is
+    exactly the dropless total (s*k rows) and the ~(cf-1) capacity
+    padding waste is gone.
+
+    Under an expert-sharded mesh this falls back to the padded GSPMD
+    formulation (the capacity rule needs global arrival positions; the
+    shard_map fast path is dropless-only)."""
+    sharded = expert_axis is not None and mesh_axis_size(expert_axis) > 1
+    if sharded:
+        def efn(expert_in):
+            gu = jnp.einsum("ecd,edm->ecm", expert_in,
+                            gate_up.astype(expert_in.dtype))
+            g, u = jnp.split(gu, 2, axis=-1)
+            h = jax.nn.silu(g.astype(jnp.float32)) \
+                .astype(expert_in.dtype) * u
+            return jnp.einsum("ecm,emd->ecd", h,
+                              down.astype(expert_in.dtype))
+        return moe_dispatch_combine(
+            x, gate_logits, num_expert, top_k=top_k,
+            capacity_factor=capacity_factor, expert_fn=efn,
+            expert_axis=expert_axis, normalize_gates=normalize_gates,
+            second_expert_policy=second_expert_policy, rng_key=rng_key,
+            return_stats=return_stats)
+    return _grouped_dispatch(
+        x, gate_logits, num_expert, top_k, gate_up, down,
+        capacity_factor=capacity_factor, normalize_gates=normalize_gates,
+        second_expert_policy=second_expert_policy, rng_key=rng_key,
+        expert_axis=expert_axis, return_stats=return_stats)
+
+
+def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
+                      *, capacity_factor, normalize_gates=True,
+                      second_expert_policy="all", rng_key=None,
+                      expert_axis=None, ep_buffer_factor=2.0,
+                      return_stats=False):
+    """Shared engine behind the dropless and capacity-grouped paths:
+    route → sort-group → grouped expert matmuls → combine, with the EP
+    shard_map fast path when the expert axis is mesh-sharded."""
     s, d = x.shape
     e = num_expert
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     topk_prob, topk_idx = jax.lax.top_k(probs, top_k)       # [s, k]
+    topk_idx = topk_idx.astype(jnp.int32)
 
-    # group (token, slot) pairs by destination expert via cumsum-rank:
-    # rank[i] = start of expert(i)'s segment + arrival position
-    # (chunked MXU scan — see _positions)
+    sel = None
+    if second_expert_policy == "random" and rng_key is not None \
+            and top_k >= 2:
+        u = jax.random.uniform(rng_key, (s, top_k))
+        sel = u < jnp.minimum(top_k * topk_prob, 1.0)
+        sel = sel.at[:, 0].set(True)  # 1st choice always dispatches
+
     flat_e = topk_idx.reshape(-1)                           # [s*k]
-    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)     # [s*k, e]
-    pos, counts = _positions(onehot, flat_e.astype(jnp.int32))
-    starts = jnp.cumsum(counts) - counts
-    rank = (starts[flat_e] + pos).astype(jnp.int32)         # inverse perm
-    order = jnp.zeros(s * top_k, jnp.int32).at[rank].set(
-        jnp.arange(s * top_k, dtype=jnp.int32))
-    group_sizes = counts.astype(jnp.int32)
+    valid = None if sel is None else sel.reshape(-1)
+    order, rank, counts = _sort_pairs(flat_e, e, valid=valid)
 
-    xs = _expand_sort(x, order // top_k, rank, top_k)       # [s*k, d]
-
-    # expert weights shard over the EP axis (same constraint the
-    # capacity path puts on its expert buffers); GSPMD turns the
-    # token-side exchange into the ragged all-to-all equivalent
-    sharded = False
-    if expert_axis is not None:
-        sharded = mesh_axis_size(expert_axis) > 1
-        gate_up = _ep_constraint(gate_up, expert_axis)
-        down = _ep_constraint(down, expert_axis)
-    f2 = gate_up.shape[-1]
-    ys = None
-    if not sharded and _use_megablox(s * top_k, d, f2) \
-            and _use_megablox(s * top_k, f2 // 2, d):
-        try:
-            gu = _gmm32(xs, gate_up.astype(xs.dtype), group_sizes,
-                        _GMM_TILING)
-            g, u = jnp.split(gu, 2, axis=-1)
-            h = (jax.nn.silu(g.astype(jnp.float32)).astype(xs.dtype)
-                 * u)
-            ys = _gmm32(h, down.astype(xs.dtype), group_sizes,
-                        _GMM_TILING)
-        except Exception as exc:
-            # shape the kernel rejects at trace time -> ragged_dot.
-            # Scope note: this guards the FORWARD trace only; _gmm32's
-            # backward traces inside jax.grad with the same tiling and
-            # the same (8, 128) block alignment (dims swapped), so a
-            # shape that passes here passes there. Warn so a fallback
-            # is never a silent perf downgrade.
-            import warnings
-            warnings.warn(
-                "moe dropless: megablox gmm unavailable for shape "
-                f"[{s * top_k}, {d}] x [{e}, {d}, {f2}] ({exc!r}); "
-                "using lax.ragged_dot")
-            ys = None
-    if ys is None:
-        gu = jax.lax.ragged_dot(xs, gate_up.astype(xs.dtype),
-                                group_sizes)
-        g, u = jnp.split(gu, 2, axis=-1)
-        h = (jax.nn.silu(g.astype(jnp.float32)).astype(xs.dtype) * u)
-        ys = jax.lax.ragged_dot(h, down.astype(xs.dtype), group_sizes)
-
-    # unsort back to (token, slot) order and combine — both directions
-    # of the permutation are gathers (custom VJP)
-    picked = _perm_rows(ys, rank, order).reshape(s, top_k, -1)
-
-    if normalize_gates:
-        gates = topk_prob / jnp.maximum(
-            jnp.sum(topk_prob, axis=-1, keepdims=True), 1e-9)
+    if capacity_factor is None:
+        keep = sel                                          # dropless
     else:
-        gates = topk_prob
-    y = jnp.einsum("sk,skd->sd", gates.astype(x.dtype), picked)
+        c = max(int(math.ceil(capacity_factor * s * top_k / e)), 1)
+        starts = jnp.cumsum(counts) - counts
+        pos = (rank - starts[flat_e]).reshape(s, top_k)
+        slot_used = jnp.ones((s, top_k), bool) if sel is None else sel
+        keep = (pos < c) & slot_used
 
-    # same GShard load-balance aux as the capacity path
+    # random-skipped slots are zeroed BEFORE normalization (GShard/
+    # fairseq top2gating order), capacity-dropped slots after
+    eff_prob = topk_prob if sel is None \
+        else topk_prob * sel.astype(topk_prob.dtype)
+    if normalize_gates:
+        gates = eff_prob / jnp.maximum(
+            jnp.sum(eff_prob, axis=-1, keepdims=True), 1e-9)
+    else:
+        gates = eff_prob
+    if keep is not None:
+        gates = jnp.where(keep, gates, 0.0)
+    gates = gates.astype(x.dtype)
+
+    ep = mesh_axis_size(expert_axis) if expert_axis is not None else 1
+    ep_drop = None
+    if ep > 1 and capacity_factor is None and e % ep == 0 \
+            and s % ep == 0 and _env_mesh() is not None:
+        y, ep_drop = _dropless_ep(x, gates, topk_idx, gate_up, down,
+                                  expert_axis, ep, ep_buffer_factor)
+    else:
+        if ep > 1:
+            gate_up = _ep_constraint(gate_up, expert_axis)
+            down = _ep_constraint(down, expert_axis)
+        # local sorted grouped-matmul path: all s*k pairs flow through
+        # the grouped matmuls (capacity-dropped pairs are zero-gated at
+        # combine — same total rows as dropless, no capacity padding);
+        # pairs skipped by random routing sort into the tail and are
+        # absorbed into the last group. When the expert axis IS sharded
+        # but the shard_map fast path was ineligible (non-divisible
+        # e/s), GSPMD owns the partitioning — the opaque Pallas kernel
+        # can't be partitioned, so force the ragged_dot lowering (the
+        # r5 gate, kept exactly where it is still required).
+        gs = counts.at[e - 1].add(
+            jnp.int32(s * top_k) - jnp.sum(counts, dtype=jnp.int32))
+        xs = _expand_sort(x, order // top_k, rank, top_k)   # [s*k, d]
+        ys = _expert_swiglu_grouped(xs, gate_up, down, gs, x.dtype,
+                                    allow_pallas=(ep <= 1))
+        picked = _perm_rows(ys, rank, order).reshape(s, top_k, -1)
+        y = jnp.einsum("sk,skd->sd", gates, picked)
+
+    # GShard load-balance aux (top-1 occupancy), as the padded path
     me = jnp.mean(probs, axis=0)
-    onehot0 = jax.nn.one_hot(topk_idx[:, 0], e, dtype=jnp.float32)
-    aux = e * jnp.sum(me * jnp.mean(onehot0, axis=0))
+    ce = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], e,
+                                 dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(me * ce)
     if return_stats:
-        return y, aux, {"drop_rate": jnp.float32(0.0)}
+        if ep_drop is not None:
+            drop = ep_drop          # EP exchange-buffer overflow
+        elif keep is None:
+            drop = jnp.float32(0.0)
+        else:
+            drop = 1.0 - jnp.sum(keep.astype(jnp.float32)) \
+                / float(s * top_k)
+        return y, aux, {"drop_rate": drop}
     return y, aux
+
+
+def _env_mesh():
+    from . import env as _env
+    return _env.get_mesh()
+
+
+def _dropless_ep(x, gates, topk_idx, gate_up, down, axis, ep,
+                 buffer_factor):
+    """EP-sharded dropless fast path: grouped matmuls INSIDE shard_map.
+
+    The r5 sharded path handed the whole dispatch to GSPMD with
+    sharding constraints and fell back to ``lax.ragged_dot`` — the
+    megablox kernel was gated off exactly where multi-chip training
+    runs. Inside ``shard_map`` the per-shard buffer shapes are STATIC,
+    so the Pallas grouped kernel is legal under expert sharding, and
+    the collective placement is explicit instead of inferred.
+
+    Per shard (s_l = s/ep local tokens, e_l = e/ep local experts,
+    experts laid out shard-major so destination-shard regions are
+    contiguous in expert-sorted order):
+
+      1. stable-sort local (token, slot) pairs by destination expert;
+      2. gather rows into a ``[ep, cap_pair, d]`` send buffer, exchange
+         per-expert counts, then ONE ``lax.all_to_all`` places every
+         pair on the shard owning its expert;
+      3. derive per-row local expert ids from the exchanged counts,
+         re-sort received rows expert-major, run the TWO grouped
+         matmuls (megablox on TPU), unsort;
+      4. the reverse ``all_to_all`` returns expert outputs to their
+         source shard, which combines them with the gate weights.
+
+    The whole pipeline is one custom_vjp: the backward replays the same
+    all-to-all structure on cotangents and runs the grouped matmuls
+    with the separately tuned backward tilings (transpose-rhs gmm +
+    tgmm) instead of letting autodiff transpose the dispatch gathers
+    into serialized scatters.
+
+    ``cap_pair`` bounds each (src, dst) exchange slot at
+    ``buffer_factor * s_l * k / ep`` rows (rounded up to the sublane
+    multiple); pairs beyond it are dropped and reported via the
+    returned drop fraction. ``buffer_factor >= ep`` is exactly
+    dropless (the per-slot worst case is all local pairs to one
+    shard)."""
+    mesh = _env_mesh()
+    s, d = x.shape
+    k = topk_idx.shape[1]
+    e = gate_up.shape[0]
+    e_l = e // ep
+    s_l = s // ep
+    n_l = s_l * k
+    cap_pair = int(math.ceil(float(buffer_factor) * n_l / ep))
+    cap_pair = min(max(cap_pair, 1), n_l)
+    cap_pair = -(-cap_pair // 8) * 8          # sublane-align the slots
+    n_r = ep * cap_pair
+    MOE_STATS["ep_shard_map_calls"] += 1
+
+    def _fwd(x_l, gates_l, idx_l, gu_w, dn_w):
+        flat_e = idx_l.reshape(-1)                        # [n_l] global
+        order, rank, counts = _sort_pairs(flat_e, e)
+        cnt_de = counts.reshape(ep, e_l)                  # [dest, le]
+        shard_cnt = cnt_de.sum(axis=1)                    # [ep]
+        shard_start = jnp.cumsum(shard_cnt) - shard_cnt
+        # per-(dest, expert) counts that fit the slot (tail clipped)
+        exp_off = jnp.cumsum(cnt_de, axis=1) - cnt_de
+        cnt_send = jnp.clip(jnp.minimum(cnt_de, cap_pair - exp_off),
+                            0, None).astype(jnp.int32)
+        # gather pairs into send slots (dest-major sorted order)
+        pslot = shard_start[:, None] + jnp.arange(cap_pair)[None, :]
+        sent = jnp.arange(cap_pair)[None, :] < jnp.minimum(
+            shard_cnt, cap_pair)[:, None]                 # [ep, cap]
+        send_pair = jnp.take(order, jnp.clip(pslot, 0, n_l - 1))
+        send = jnp.take(x_l, (send_pair // k).reshape(-1), axis=0) \
+            .reshape(ep, cap_pair, d)
+        cnt_recv = jax.lax.all_to_all(cnt_send, axis, 0, 0)
+        recv = jax.lax.all_to_all(send, axis, 0, 0)       # [src, cap, d]
+        # local expert id of each received row from the counts matrix;
+        # rows past a slot's total get sentinel e_l and sort last
+        bounds = jnp.cumsum(cnt_recv, axis=1)             # [src, e_l]
+        j = jnp.arange(cap_pair)
+        eid = (j[None, :, None] >= bounds[:, None, :]).sum(-1) \
+            .astype(jnp.int32)
+        order2, rank2, _ = _sort_pairs(eid.reshape(-1), e_l)
+        xs = jnp.take(recv.reshape(n_r, d), order2, axis=0)
+        gs = cnt_recv.sum(axis=0).astype(jnp.int32)
+        gs = gs.at[e_l - 1].add(
+            jnp.int32(n_r) - jnp.sum(gs, dtype=jnp.int32))    # pads
+        gu = _grouped_mm(xs, gu_w.astype(xs.dtype), gs)
+        g_a, u_a = jnp.split(gu, 2, axis=-1)
+        h = jax.nn.silu(g_a.astype(jnp.float32)).astype(xs.dtype) * u_a
+        ys = _grouped_mm(h, dn_w.astype(xs.dtype), gs)
+        back = jnp.take(ys, rank2, axis=0).reshape(ep, cap_pair, d)
+        outs = jax.lax.all_to_all(back, axis, 0, 0)       # [dest, cap, d]
+        dest = flat_e // e_l
+        off = rank - shard_start[dest]
+        kept = off < cap_pair
+        slot = dest * cap_pair + jnp.minimum(off, cap_pair - 1)
+        per_pair = jnp.take(outs.reshape(n_r, d), slot, axis=0)
+        per_pair = jnp.where(kept[:, None], per_pair,
+                             jnp.zeros((), x_l.dtype))
+        picked = per_pair.reshape(s_l, k, d)
+        y = jnp.einsum("sk,skd->sd", gates_l.astype(x_l.dtype), picked)
+        drop = jax.lax.psum(jnp.sum((~kept).astype(jnp.float32)),
+                            axis) / float(s * k)
+        res = (xs, gu, picked, gates_l, send_pair, sent, order2,
+               rank2, kept, slot, gs, gu_w, dn_w)
+        return (y, drop), res
+
+    @jax.custom_vjp
+    def core(x_l, gates_l, idx_l, gu_w, dn_w):
+        out, _ = _fwd(x_l, gates_l, idx_l, gu_w, dn_w)
+        return out
+
+    def core_fwd(x_l, gates_l, idx_l, gu_w, dn_w):
+        return _fwd(x_l, gates_l, idx_l, gu_w, dn_w)
+
+    def core_bwd(res, ct):
+        dy, _ddrop = ct
+        (xs, gu, picked, gates_l, send_pair, sent, order2, rank2,
+         kept, slot, gs, gu_w, dn_w) = res
+        dy32 = dy.astype(jnp.float32)
+        dgates = jnp.einsum("sd,skd->sk", dy32,
+                            picked.astype(jnp.float32))
+        # per-pair output cotangent routed through the SAME slots
+        dpair = (gates_l.astype(jnp.float32)[..., None]
+                 * dy32[:, None, :]).reshape(n_l, d).astype(dy.dtype)
+        dsend = jnp.take(dpair, send_pair.reshape(-1), axis=0) \
+            .reshape(ep, cap_pair, d)
+        dsend = jnp.where(sent[..., None], dsend,
+                          jnp.zeros((), dsend.dtype))
+        dback = jax.lax.all_to_all(dsend, axis, 0, 0)
+        dys = jnp.take(dback.reshape(n_r, d), order2, axis=0)
+        g_a, u_a = jnp.split(gu, 2, axis=-1)
+        g32 = g_a.astype(jnp.float32)
+        sg = jax.nn.silu(g32)
+        h = (sg * u_a.astype(jnp.float32)).astype(xs.dtype)
+        ddn = _grouped_mm_drhs(h, dys, gs, e_l)
+        dh = _grouped_mm_dlhs(dys, dn_w.astype(dys.dtype), gs) \
+            .astype(jnp.float32)
+        sig = jax.nn.sigmoid(g32)
+        dg = dh * u_a.astype(jnp.float32) * sig * (1 + g32 * (1 - sig))
+        du = dh * sg
+        dgu = jnp.concatenate([dg, du], axis=-1).astype(xs.dtype)
+        dguw = _grouped_mm_drhs(xs, dgu, gs, e_l)
+        dxs = _grouped_mm_dlhs(dgu, gu_w.astype(dgu.dtype), gs)
+        drecv = jnp.take(dxs, rank2, axis=0).reshape(ep, cap_pair, d)
+        dsent = jax.lax.all_to_all(drecv, axis, 0, 0)
+        dpx = jnp.take(dsent.reshape(n_r, d), slot, axis=0)
+        dpx = jnp.where(kept[:, None], dpx, jnp.zeros((), dpx.dtype))
+        dx = dpx.reshape(s_l, k, d).sum(axis=1)
+        return (dx.astype(xs.dtype), dgates.astype(gates_l.dtype),
+                None, dguw.astype(gu_w.dtype), ddn.astype(dn_w.dtype))
+
+    core.defvjp(core_fwd, core_bwd)
+
+    from .shard_utils import shard_map_compat
+    from jax.sharding import PartitionSpec as P
+    f = shard_map_compat(
+        core, mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None, None), P(axis, None, None)),
+        out_specs=(P(axis, None), P()))
+    return f(x, gates, topk_idx, gate_up, down)
 
 
 def _ep_constraint(arr, axis):

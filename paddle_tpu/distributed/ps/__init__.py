@@ -412,9 +412,16 @@ class DistributedEmbedding:
                 import warnings
                 warnings.warn(
                     "DistributedEmbedding: %d pulled batches pending — "
-                    "call push_grads() each step (dropping the oldest "
+                    "call push_grads() each step (flushing the oldest "
                     "to bound memory)" % len(self._pending))
-                self._pending.pop(0)
+                # push the oldest batch's gradient (if backward already
+                # produced one) BEFORE dropping it, so bounding memory
+                # never silently discards embedding updates
+                uniq0, local0 = self._pending.pop(0)
+                if local0.grad is not None:
+                    self.client.push_sparse(
+                        self.name, uniq0,
+                        np.asarray(local0.grad.numpy()))
         from ...ops.manipulation import gather, reshape
         out = gather(local, Tensor(jnp.asarray(inverse)))
         return reshape(out, list(ids_np.shape) + [self.dim])

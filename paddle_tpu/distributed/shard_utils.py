@@ -118,8 +118,11 @@ def constraint(x, *spec):
     return apply_jax("sharding_constraint", f, x)
 
 
-def batch_shard(x, axes=("dp", "sharding")):
-    """Shard the leading (batch) dim over the data-parallel axes."""
+def batch_shard(x, axes=("dp", "sharding", "ep")):
+    """Shard the leading (batch) dim over the data-parallel axes (the
+    expert axis carries tokens too: EP shards the batch like DP and
+    exchanges (token, slot) pairs by all-to-all inside the MoE
+    dispatch)."""
     mesh = current_mesh()
     if mesh is None or in_manual_region():
         return x

@@ -14,20 +14,6 @@ from .nn.functional import (softmax_mask_fuse,  # noqa: F401
                             softmax_mask_fuse_upper_triangle)
 
 
-def softmax_mask_fuse_upper_triangle(x):
-    from ..ops.creation import tril
-    from ..nn.functional import softmax
-    import jax.numpy as jnp
-    from ..framework.core import apply_jax
-
-    def f(a):
-        L = a.shape[-1]
-        mask = jnp.tril(jnp.ones((L, L), bool))
-        import jax
-        return jax.nn.softmax(jnp.where(mask, a, -1e9), axis=-1)
-    return apply_jax("softmax_mask_fuse_upper_triangle", f, x)
-
-
 def segment_sum(data, segment_ids, name=None):
     import jax
     import numpy as np

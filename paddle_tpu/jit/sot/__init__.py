@@ -75,6 +75,7 @@ class SymbolicTranslator:
                        "eager_calls": 0, "fast_hits": 0,
                        "fallback_calls": 0}
         self._unsupported: Optional[str] = None
+        self._sim_errors = 0        # generic simulator-error count
         self._fast_plan = None      # (guards, sig, key, sources, tmpl)
         _TRANSLATORS.append(self)
 
@@ -192,6 +193,24 @@ class SymbolicTranslator:
                 getattr(self.fn, "__qualname__", "?"),
                 getattr(self.fn.__code__, "co_firstlineno", 0),
                 f"SotUnsupported: {exc}")
+            return self.fn(*args, **kwargs)
+        except Exception as exc:  # non-SotUnsupported error mid-
+            # simulation — never crash the user's call: run this call
+            # plain eager (same caveat about partial py_effects replay
+            # as the SotUnsupported break). The error may be the USER's
+            # (their function legitimately raising on this input) or a
+            # transient executor failure, so a single occurrence must
+            # not disable SOT for the function — only latch the
+            # permanent eager fallback once it repeats.
+            self._sim_errors += 1
+            if self._sim_errors >= 2:
+                self._unsupported = f"simulator error: {exc!r}"
+            self._stats["fallback_calls"] += 1
+            from .. import dy2static as _d2s
+            _d2s.record_break(
+                getattr(self.fn, "__qualname__", "?"),
+                getattr(self.fn.__code__, "co_firstlineno", 0),
+                f"simulator error: {exc!r}")
             return self.fn(*args, **kwargs)
         self._record_fast_plan(sim, result, guards, sig)
         return result

@@ -255,9 +255,27 @@ class _Simulator:
         return v
 
     def _live_vars(self):
+        """Every TensorVar a later instruction could still reach: walk
+        the stack AND locals INCLUDING containers (a symbolic tensor
+        parked in a list/tuple/dict must be materialized by a flush, or
+        the next flush would dangle on its freed node)."""
         live = list(self.stack)
-        live += [v for v in self.locals_.values()
-                 if isinstance(v, TensorVar)]
+
+        def walk(v):
+            if isinstance(v, TensorVar):
+                live.append(v)
+            elif isinstance(v, (list, tuple)):
+                for e in v:
+                    walk(e)
+            elif isinstance(v, dict):
+                for e in v.values():
+                    walk(e)
+
+        for v in self.stack:
+            if not isinstance(v, TensorVar):
+                walk(v)
+        for v in self.locals_.values():
+            walk(v)
         return live
 
     def _wrap(self, v):
@@ -316,7 +334,7 @@ class _Simulator:
         code = self.code
         if code.co_flags & 0x20:          # generator/coroutine
             raise SotUnsupported("generator or coroutine function")
-        if code.co_exceptiontable:
+        if getattr(code, "co_exceptiontable", b""):  # 3.11+ attribute
             # 3.12 zero-cost exceptions keep handlers OFF the happy
             # path, so the simulator would silently skip a user's
             # except/finally clause the moment a captured op raised —

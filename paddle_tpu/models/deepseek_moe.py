@@ -19,7 +19,6 @@ from ..nn.layer.layers import Layer
 from ..nn.layer.norm import RMSNorm
 from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
-from ..distributed.moe import moe_dispatch_combine
 from ..distributed.shard_utils import batch_shard
 from ..generation import GenerationMixin
 from .llama import (LlamaAttention, LlamaPretrainingCriterion,
@@ -55,6 +54,10 @@ class DeepseekMoeConfig:
     qkv_bias: bool = False                  # DeepSeek attention: no bias
     recompute: bool = False
     expert_axis: str = "dp"
+    # dropless grouped-matmul routing (megablox on TPU; EP shard_map
+    # fast path when expert_axis is mesh-sharded) vs GShard capacity
+    dropless: bool = False
+    ep_buffer_factor: float = 2.0
     dtype: str = "float32"
 
     @staticmethod
@@ -95,11 +98,21 @@ class DeepseekMoeBlock(Layer):
         logits = self.gate(x2)
 
         def f(x_arr, logit_arr, gate_up, down):
-            efn = self.experts.expert_fn(gate_up, down)
-            return moe_dispatch_combine(
+            if getattr(cfg, "dropless", False):
+                from ..distributed.moe import \
+                    moe_dispatch_combine_dropless
+                return moe_dispatch_combine_dropless(
+                    x_arr, logit_arr, cfg.n_routed_experts,
+                    cfg.num_experts_per_tok, gate_up, down,
+                    normalize_gates=cfg.norm_topk_prob,
+                    expert_axis=cfg.expert_axis,
+                    ep_buffer_factor=getattr(cfg, "ep_buffer_factor",
+                                             2.0))
+            from ..distributed.moe import moe_dispatch_combine_grouped
+            return moe_dispatch_combine_grouped(
                 x_arr, logit_arr, cfg.n_routed_experts,
-                top_k=cfg.num_experts_per_tok,
-                capacity_factor=cfg.capacity_factor, expert_fn=efn,
+                cfg.num_experts_per_tok, gate_up, down,
+                capacity_factor=cfg.capacity_factor,
                 expert_axis=cfg.expert_axis,
                 normalize_gates=cfg.norm_topk_prob)
 

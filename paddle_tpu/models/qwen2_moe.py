@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, apply_jax, as_jax, _wrap_out
@@ -23,7 +22,6 @@ from ..nn.layer.norm import RMSNorm
 from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
-from ..distributed.moe import moe_dispatch_combine
 from ..distributed.shard_utils import batch_shard
 from ..generation import GenerationMixin
 from ..incubate.nn.functional import swiglu
@@ -59,8 +57,12 @@ class Qwen2MoeConfig:
     recompute: bool = False
     expert_axis: str = "dp"                 # mesh axis experts shard over
     # dropless routing: no capacity factor, no dropped tokens — experts
-    # run as grouped ragged matmuls (jax.lax.ragged_dot)
+    # run as grouped matmuls (megablox on TPU / lax.ragged_dot), inside
+    # shard_map with explicit all-to-alls when expert_axis is sharded
     dropless: bool = False
+    # EP exchange-slot bound, in multiples of the balanced per-shard
+    # load (see moe._dropless_ep); >= the EP degree is exactly dropless
+    ep_buffer_factor: float = 2.0
     dtype: str = "float32"
 
     @staticmethod
@@ -96,17 +98,9 @@ class StackedExpertsMLP(Layer):
         from ..distributed.shard_utils import annotate_param
         annotate_param(self.gate_up_proj, (expert_axis, None, "mp"))
         annotate_param(self.down_proj, (expert_axis, "mp", None))
-
-    def expert_fn(self, gate_up, down):
-        """Pure-jax fn: expert_in [e, c, d] -> [e, c, d]."""
-        def f(expert_in):
-            gu = jnp.einsum("ecd,edm->ecm", expert_in,
-                            gate_up.astype(expert_in.dtype))
-            g, u = jnp.split(gu, 2, axis=-1)
-            h = jax.nn.silu(g) * u
-            return jnp.einsum("ecm,emd->ecd", h,
-                              down.astype(expert_in.dtype))
-        return f
+        # NOTE: the expert computation itself lives in
+        # distributed/moe.py (_expert_swiglu_grouped and the padded
+        # fallback's efn) — this Layer only owns the stacked params.
 
 
 class Qwen2MoeSparseBlock(Layer):
@@ -148,13 +142,20 @@ class Qwen2MoeSparseBlock(Layer):
                     x_arr, logit_arr, cfg.num_experts,
                     cfg.num_experts_per_tok, gate_up, down,
                     normalize_gates=cfg.norm_topk_prob,
-                    expert_axis=cfg.expert_axis, return_stats=collect)
+                    expert_axis=cfg.expert_axis,
+                    ep_buffer_factor=getattr(cfg, "ep_buffer_factor",
+                                             2.0),
+                    return_stats=collect)
             else:
-                efn = self.experts.expert_fn(gate_up, down)
-                out = moe_dispatch_combine(
+                # capacity semantics on the grouped-matmul engine
+                # (stacked SwiGLU experts; falls back to the padded
+                # einsum under an expert-sharded mesh)
+                from ..distributed.moe import \
+                    moe_dispatch_combine_grouped
+                out = moe_dispatch_combine_grouped(
                     x_arr, logit_arr, cfg.num_experts,
-                    top_k=cfg.num_experts_per_tok,
-                    capacity_factor=cfg.capacity_factor, expert_fn=efn,
+                    cfg.num_experts_per_tok, gate_up, down,
+                    capacity_factor=cfg.capacity_factor,
                     expert_axis=cfg.expert_axis,
                     normalize_gates=cfg.norm_topk_prob,
                     return_stats=collect)

@@ -13,7 +13,13 @@ import jax
 
 if os.environ.get("PADDLE_TPU_TEST_BACKEND", "cpu") == "cpu":
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax: the config knob doesn't exist — the XLA flag does
+        # the same as long as it lands before backend initialization
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8")
 
 import numpy as np
 import pytest

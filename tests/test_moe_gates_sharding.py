@@ -171,3 +171,73 @@ def test_group_sharded_parallel_stage2_and_scaler():
     assert getattr(o2, "_shard_grads", False)
     assert isinstance(s2, GroupShardedScaler)
     assert s2.is_enable() == scaler.is_enable()
+
+
+def test_grouped_capacity_matches_padded_with_real_drops():
+    """The r6 grouped-matmul CAPACITY engine must reproduce the padded
+    einsum path exactly — including WHICH (token, slot) pairs the
+    capacity rule drops (earlier arrivals win) — at a capacity factor
+    tight enough to force real drops."""
+    from paddle_tpu.distributed.moe import (moe_dispatch_combine,
+                                            moe_dispatch_combine_grouped)
+    rng = np.random.RandomState(5)
+    s, e, d, f, k = 64, 4, 16, 24, 2
+    x = jnp.asarray(rng.randn(s, d).astype(np.float32))
+    # skew the router so expert 0 overflows its capacity
+    logits = jnp.asarray(
+        (rng.randn(s, e) + np.array([3.0, 0, 0, 0])).astype(np.float32))
+    gate_up = jnp.asarray(0.1 * rng.randn(e, d, 2 * f).astype(np.float32))
+    down = jnp.asarray(0.1 * rng.randn(e, f, d).astype(np.float32))
+
+    def efn(expert_in):
+        gu = jnp.einsum("ecd,edm->ecm", expert_in, gate_up)
+        g, u = jnp.split(gu, 2, axis=-1)
+        h = jax.nn.silu(g.astype(jnp.float32)).astype(
+            expert_in.dtype) * u
+        return jnp.einsum("ecm,emd->ecd", h, down)
+
+    y_pad, aux_pad, st_pad = moe_dispatch_combine(
+        x, logits, e, top_k=k, capacity_factor=0.5, expert_fn=efn,
+        return_stats=True)
+    y_grp, aux_grp, st_grp = moe_dispatch_combine_grouped(
+        x, logits, e, k, gate_up, down, capacity_factor=0.5,
+        return_stats=True)
+    assert float(st_pad["drop_rate"]) > 0.05       # drops really happen
+    np.testing.assert_allclose(float(st_grp["drop_rate"]),
+                               float(st_pad["drop_rate"]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y_grp), np.asarray(y_pad),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(float(aux_grp), float(aux_pad),
+                               rtol=1e-5)
+
+
+def test_ep_dropless_vs_capacity_loss_parity():
+    """Under an EXPERT-SHARDED mesh, the dropless shard_map fast path
+    and the capacity path (padded GSPMD formulation) must train to the
+    same loss when capacity is high enough that nothing drops."""
+    from paddle_tpu.models.qwen2_moe import (Qwen2MoeConfig,
+                                             Qwen2MoeForCausalLM)
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4]).reshape(4, 1)
+    denv.set_mesh(Mesh(devs, ("ep", "mp")))
+    try:
+        losses = {}
+        for dropless in (False, True):
+            paddle.seed(11)
+            cfg = Qwen2MoeConfig.tiny(vocab=128, hidden=32, layers=1,
+                                      heads=4, kv_heads=2, moe_ffn=16,
+                                      shared_ffn=32, experts=8, topk=2)
+            cfg.capacity_factor = 100.0     # padded path drops nothing
+            cfg.dropless = dropless
+            cfg.expert_axis = "ep"
+            cfg.ep_buffer_factor = 4.0      # == ep degree: no overflow
+            model = Qwen2MoeForCausalLM(cfg)
+            ids = paddle.to_tensor(np.random.RandomState(2).randint(
+                0, 128, (4, 16)).astype(np.int64))
+            labels = paddle.to_tensor(
+                np.roll(np.asarray(ids.numpy()), -1, axis=1))
+            losses[dropless] = float(model(ids, labels=labels).numpy())
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=2e-4)
+    finally:
+        denv.set_mesh(None)

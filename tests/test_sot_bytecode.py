@@ -260,3 +260,55 @@ def test_python_side_effects_not_skipped_by_fast_path():
     st(x, cfg)
     st(x, cfg)
     assert cfg.calls == 3          # effects replayed every call
+
+
+def test_tensors_nested_in_list_survive_mid_function_flush():
+    """r5 advisor repro: symbolic tensors parked in a LIST across a
+    data-dependent branch. The mid-function flush must materialize
+    container-held tensors too (``_live_vars`` walks containers) —
+    before the fix the next flush raised an uncaught KeyError instead
+    of the documented clean fallback. Asserted on VALUES so the test
+    also passes where the VM itself falls back to eager."""
+    def f(x):
+        ys = [x * 1.0, x * 2.0]
+        if (x.sum() > 0.0):
+            pass
+        return ys[0] + ys[1]
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0, 3.0])
+    out = st(x)
+    np.testing.assert_allclose(out.numpy(), [3.0, 6.0, 9.0], rtol=1e-6)
+    # and again (exercises whatever plan the first call recorded)
+    np.testing.assert_allclose(st(x).numpy(), [3.0, 6.0, 9.0],
+                               rtol=1e-6)
+
+
+def test_simulator_errors_fall_back_to_eager():
+    """A defect inside the simulator must degrade to plain eager for
+    the whole call (like an explicit SotUnsupported), never crash the
+    user's function."""
+    def f(x):
+        return (x * 2.0).sum()
+
+    st = symbolic_translate(f)
+
+    # poison the simulation path only
+    from paddle_tpu.jit.sot import opcode_translator as ot
+    saved = ot._Simulator.run
+
+    def boom(self, args, kwargs):
+        raise KeyError("injected simulator defect")
+
+    ot._Simulator.run = boom
+    try:
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(st(x).numpy(), 6.0, rtol=1e-6)
+        assert st.stats()["fallback_calls"] >= 1
+        # one generic error must NOT permanently disable SOT (it could
+        # be the user's own exception); a repeat latches eager fallback
+        assert st._unsupported is None
+        np.testing.assert_allclose(st(x).numpy(), 6.0, rtol=1e-6)
+        assert "simulator error" in (st._unsupported or "")
+    finally:
+        ot._Simulator.run = saved
