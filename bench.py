@@ -37,6 +37,40 @@ def _peak_flops_per_chip() -> float:
     return 197e12
 
 
+def _step_telemetry(step, step_time_s):
+    """Telemetry block for one TrainStep config: the compiled-step
+    accounting the monitor recorded at AOT-compile time (analytic
+    FLOPs/step from XLA's cost model, peak HBM from memory_analysis,
+    jaxpr collective census) plus the jit-cache counters. The analytic
+    MFU counts remat recompute and optimizer/elementwise FLOPs that the
+    6N closed form does not, so it sits above the bench MFU; their
+    ratio is the compiled program's overhead factor (docs/OPS.md)."""
+    from paddle_tpu import monitor
+    name = step.telemetry_name
+    rep = monitor.step_report(name) or {}
+    mem = rep.get("memory") or {}
+
+    def c(metric):
+        return monitor.counter(metric, labels=("step",)) \
+            .labels(step=name).value()
+
+    amfu = monitor.analytic_mfu(name, step_time_s)
+    return {
+        "step_name": name,
+        "analytic_flops_per_step": rep.get("flops"),
+        "analytic_bytes_per_step": rep.get("bytes_accessed"),
+        "analytic_mfu": None if amfu is None else round(amfu, 4),
+        "peak_hbm_bytes": mem.get("peak_hbm_bytes"),
+        "memory": mem,
+        "collective_census": rep.get("collective_census", []),
+        "cache": {
+            "train_step_compiles": c("train_step_compiles"),
+            "train_step_calls": c("train_step_calls"),
+            "fallback_recompiles": c("train_step_fallback_recompiles"),
+        },
+    }
+
+
 def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
                   seq, batch, steps, multi_precision=True,
                   remat="none", remat_interval=1, windows=1):
@@ -104,6 +138,7 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     # training flops/token: 6N (fwd+bwd matmuls) + 12*L*s*h attention
     flops_per_token = 6 * n_params + 12 * layers * seq * hidden
     mfu = tok_per_sec * flops_per_token / _peak_flops_per_chip()
+    telemetry = _step_telemetry(step, dt / steps)
     # free this config's params/optimizer state before the next one
     # builds (three ~1B configs would otherwise exhaust HBM)
     import gc
@@ -112,6 +147,7 @@ def _train_config(name, *, hidden, layers, heads, kv_heads, ffn, vocab,
     return {
         "name": name,
         "mfu": round(mfu, 4),
+        "telemetry": telemetry,
         "tokens_per_sec_per_chip": round(tok_per_sec, 1),
         "step_time_ms": round(1000 * dt / steps, 1),
         "n_params": n_params,
@@ -219,6 +255,7 @@ def _moe_bench(dropless=False):
         "kernel_stats": kernel_stats,
         "drop_rate_mean": round(float(np.mean(drops)), 4),
         "drop_rate_per_block": [round(d, 4) for d in drops],
+        "telemetry": _step_telemetry(step, dt / steps),
         "loss": round(val, 4),
         "config": {"hidden": cfg.hidden_size,
                    "experts": cfg.num_experts,
@@ -532,7 +569,11 @@ def main():
               "deep32": deep32, "moe": moe,
               "moe_dropless": moe_dropless,
               "moe_profile": moe_profile, "decode": decode,
-              "flashmask": flashmask}
+              "flashmask": flashmask,
+              # headline config's compiled-step accounting (analytic
+              # FLOPs/step, peak HBM, collective census, cache counts)
+              "telemetry": large.get("telemetry")
+              if isinstance(large, dict) else None}
     # headline FIRST and compact (<4KB) so driver tail-capture can
     # never truncate "value"; full per-config detail goes to a file
     result = {

@@ -59,6 +59,7 @@ from .framework import errors
 # paddle.log math op with the logging module
 from .framework.log import get_logger, logger, vlog
 from . import profiler
+from . import monitor
 from . import regularizer
 from . import sparse
 from . import geometric

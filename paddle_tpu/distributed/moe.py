@@ -146,12 +146,64 @@ import functools as _functools
 # combination compiled: the megablox grouped Pallas kernel, the
 # lax.ragged_dot grouped fallback, or the dense capacity-padded einsum
 # path, and whether the EP shard_map fast path was entered.
-MOE_STATS = {
-    "grouped_mm_calls": 0,        # grouped-matmul call sites traced
-    "grouped_mm_kernel": None,    # "megablox" | "ragged_dot" (last)
-    "ep_shard_map_calls": 0,      # EP fast-path dispatches traced
-    "padded_einsum_calls": 0,     # dense capacity-padded dispatches
-}
+#
+# Since the telemetry PR these are SERVED BY the framework-wide metrics
+# registry (``paddle_tpu.monitor``): ``MOE_STATS`` is a thin mapping
+# alias over a ``moe_path_calls{path=...}`` gauge plus a
+# ``moe_grouped_mm_kernel`` info metric, so the counters land in the
+# JSONL export/atexit table alongside every other metric while the
+# historical dict-style API (``MOE_STATS[k] += 1``, ``moe_stats()``,
+# ``reset_moe_stats()``) keeps working unchanged.
+from .. import monitor as _monitor
+
+_moe_path_calls = _monitor.gauge(
+    "moe_path_calls",
+    "MoE dispatch path selections recorded at trace time",
+    labels=("path",))
+_moe_kernel_info = _monitor.info(
+    "moe_grouped_mm_kernel",
+    "last grouped-matmul kernel a compilation selected")
+
+from collections.abc import MutableMapping as _MutableMapping
+
+
+class _MoeStats(_MutableMapping):
+    """Dict-shaped view over the registry-backed MoE path counters."""
+
+    _COUNTER_KEYS = ("grouped_mm_calls", "ep_shard_map_calls",
+                     "padded_einsum_calls")
+    _KEYS = ("grouped_mm_calls", "grouped_mm_kernel",
+             "ep_shard_map_calls", "padded_einsum_calls")
+
+    def __getitem__(self, k):
+        if k == "grouped_mm_kernel":
+            return _moe_kernel_info.get()
+        if k in self._COUNTER_KEYS:
+            return int(_moe_path_calls.labels(path=k).value())
+        raise KeyError(k)
+
+    def __setitem__(self, k, v):
+        if k == "grouped_mm_kernel":
+            _moe_kernel_info.set(v)
+        elif k in self._COUNTER_KEYS:
+            _moe_path_calls.labels(path=k).set(int(v))
+        else:
+            raise KeyError(k)
+
+    def __delitem__(self, k):
+        raise TypeError("MOE_STATS keys are fixed")
+
+    def __iter__(self):
+        return iter(self._KEYS)
+
+    def __len__(self):
+        return len(self._KEYS)
+
+    def __repr__(self):
+        return repr(dict(self))
+
+
+MOE_STATS = _MoeStats()
 
 
 def reset_moe_stats():
@@ -379,13 +431,18 @@ def moe_dispatch_combine(x, gate_logits, num_expert, top_k=2,
         .reshape(e, c)
     dest = dest.reshape(s, top_k)
 
-    expert_in = _moe_pack(x, src_row, filled, dest, top_k)
-    if expert_axis is not None:
-        expert_in = _ep_constraint(expert_in, expert_axis)
-    expert_out = expert_fn(expert_in)          # [e, c, d_out]
-    if expert_axis is not None:
-        expert_out = _ep_constraint(expert_out, expert_axis)
-    y = _moe_combine(expert_out, gates, dest, src_row, filled, gates_ec)
+    from ..profiler import RecordEvent
+    with RecordEvent("moe:dispatch"):
+        expert_in = _moe_pack(x, src_row, filled, dest, top_k)
+        if expert_axis is not None:
+            expert_in = _ep_constraint(expert_in, expert_axis)
+    with RecordEvent("moe:expert_mm"):
+        expert_out = expert_fn(expert_in)      # [e, c, d_out]
+        if expert_axis is not None:
+            expert_out = _ep_constraint(expert_out, expert_axis)
+    with RecordEvent("moe:combine"):
+        y = _moe_combine(expert_out, gates, dest, src_row, filled,
+                         gates_ec)
     if return_stats:
         # fraction of requested (token, slot) dispatches that were
         # dropped — capacity overflow plus random-routing skips
@@ -692,10 +749,13 @@ def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
 
     ep = mesh_axis_size(expert_axis) if expert_axis is not None else 1
     ep_drop = None
+    from ..profiler import RecordEvent
     if ep > 1 and capacity_factor is None and e % ep == 0 \
             and s % ep == 0 and _env_mesh() is not None:
-        y, ep_drop = _dropless_ep(x, gates, topk_idx, gate_up, down,
-                                  expert_axis, ep, ep_buffer_factor)
+        with RecordEvent("moe:ep_dispatch_combine"):
+            y, ep_drop = _dropless_ep(x, gates, topk_idx, gate_up,
+                                      down, expert_axis, ep,
+                                      ep_buffer_factor)
     else:
         if ep > 1:
             gate_up = _ep_constraint(gate_up, expert_axis)
@@ -709,13 +769,16 @@ def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
         # e/s), GSPMD owns the partitioning — the opaque Pallas kernel
         # can't be partitioned, so force the ragged_dot lowering (the
         # r5 gate, kept exactly where it is still required).
-        gs = counts.at[e - 1].add(
-            jnp.int32(s * top_k) - jnp.sum(counts, dtype=jnp.int32))
-        xs = _expand_sort(x, order // top_k, rank, top_k)   # [s*k, d]
-        ys = _expert_swiglu_grouped(xs, gate_up, down, gs, x.dtype,
-                                    allow_pallas=(ep <= 1))
-        picked = _perm_rows(ys, rank, order).reshape(s, top_k, -1)
-        y = jnp.einsum("sk,skd->sd", gates, picked)
+        with RecordEvent("moe:dispatch"):
+            gs = counts.at[e - 1].add(
+                jnp.int32(s * top_k) - jnp.sum(counts, dtype=jnp.int32))
+            xs = _expand_sort(x, order // top_k, rank, top_k)  # [s*k,d]
+        with RecordEvent("moe:expert_mm"):
+            ys = _expert_swiglu_grouped(xs, gate_up, down, gs, x.dtype,
+                                        allow_pallas=(ep <= 1))
+        with RecordEvent("moe:combine"):
+            picked = _perm_rows(ys, rank, order).reshape(s, top_k, -1)
+            y = jnp.einsum("sk,skd->sd", gates, picked)
 
     # GShard load-balance aux (top-1 occupancy), as the padded path
     me = jnp.mean(probs, axis=0)

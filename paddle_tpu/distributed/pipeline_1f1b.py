@@ -161,8 +161,22 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
     mesh = mesh or _env.get_mesh()
     pp = mesh.shape[axis]
     nm = feeds.shape[0]
-    op_tab, mi_tab = make_1f1b_schedule(pp, nm)
+    from ..profiler import RecordEvent
+    with RecordEvent("pipeline:1f1b_schedule"):
+        op_tab, mi_tab = make_1f1b_schedule(pp, nm)
     T = op_tab.shape[1]
+    # schedule-shape telemetry: slots per device and bubble fraction
+    # (idle slots / total) — the quantity 1F1B exists to minimize
+    from .. import monitor as _monitor
+    _monitor.gauge("pipeline_schedule_slots",
+                   "1F1B timetable length T per device",
+                   labels=("pp", "n_micro")).labels(
+        pp=str(pp), n_micro=str(nm)).set(int(T))
+    _monitor.gauge("pipeline_bubble_fraction",
+                   "idle-slot fraction of the 1F1B timetable",
+                   labels=("pp", "n_micro")).labels(
+        pp=str(pp), n_micro=str(nm)).set(
+        round(float((op_tab == _IDLE).mean()), 4))
     env = _pipe_env(mesh, axis, batch_axes, feeds, last_feeds,
                     first_fn, first_params)
     _axes, n_dp = env["axes"], env["n_dp"]
@@ -307,7 +321,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, stacked_params, feeds,
          rep(first_params), rep(last_params)))
     scale_a = jnp.float32(1.0) if loss_scale is None \
         else jnp.asarray(loss_scale, jnp.float32)
-    with manual_region():
+    with manual_region(), RecordEvent("pipeline:1f1b"):
         loss, g_stacked, g_first, g_last = mapped(
             stacked_params, feeds, first_params, last_params, last_feeds,
             scale_a)
@@ -677,7 +691,8 @@ def pipeline_interleaved_grads(stage_fn: Callable, stacked_params, feeds,
          rep(first_params), rep(last_params)))
     scale_a = jnp.float32(1.0) if loss_scale is None \
         else jnp.asarray(loss_scale, jnp.float32)
-    with manual_region():
+    from ..profiler import RecordEvent
+    with manual_region(), RecordEvent("pipeline:interleaved_1f1b"):
         loss, g_stacked, g_first, g_last = mapped(
             stacked_params, feeds, first_params, last_params, last_feeds,
             scale_a)

@@ -296,6 +296,24 @@ def stop_server():
     rpc.shutdown()
 
 
+def _ps_account(op, table, rows, nbytes):
+    """Push/pull volume counters (rows + payload bytes per table) in
+    the metrics registry — the PS analogue of the collective census."""
+    try:
+        from ... import monitor as _monitor
+        _monitor.counter("ps_ops", "PS push/pull calls",
+                         labels=("op", "table")) \
+            .labels(op=op, table=table).inc()
+        _monitor.counter("ps_rows", "PS rows moved",
+                         labels=("op", "table")) \
+            .labels(op=op, table=table).inc(int(rows))
+        _monitor.counter("ps_bytes", "PS payload bytes moved",
+                         labels=("op", "table")) \
+            .labels(op=op, table=table).inc(int(nbytes))
+    except Exception:
+        pass
+
+
 class PSClient:
     """Worker-side facade: shards sparse ids across the server list by
     ``id % n_servers``; dense tables live on server 0."""
@@ -337,36 +355,51 @@ class PSClient:
         return ids, which
 
     def pull_sparse(self, name, ids) -> np.ndarray:
-        ids, which = self._shard(ids)
-        dim = getattr(self, "_dims", {}).get(name, 0)
-        out = np.zeros((len(ids), dim), np.float32)
-        for k, s in enumerate(self.servers):
-            sel = np.nonzero(which == k)[0]
-            if sel.size == 0:
-                continue
-            rows = self._rpc(s, _ps_pull_sparse, name,
-                             ids[sel].tolist())
-            if out.shape[1] != rows.shape[1] or out.dtype != rows.dtype:
-                out = np.zeros((len(ids), rows.shape[1]), rows.dtype)
-            out[sel] = rows
+        from ...profiler import RecordEvent
+        with RecordEvent("ps:pull_sparse"):
+            ids, which = self._shard(ids)
+            dim = getattr(self, "_dims", {}).get(name, 0)
+            out = np.zeros((len(ids), dim), np.float32)
+            for k, s in enumerate(self.servers):
+                sel = np.nonzero(which == k)[0]
+                if sel.size == 0:
+                    continue
+                rows = self._rpc(s, _ps_pull_sparse, name,
+                                 ids[sel].tolist())
+                if out.shape[1] != rows.shape[1] \
+                        or out.dtype != rows.dtype:
+                    out = np.zeros((len(ids), rows.shape[1]),
+                                   rows.dtype)
+                out[sel] = rows
+        _ps_account("pull_sparse", name, len(ids), out.nbytes)
         return out
 
     def push_sparse(self, name, ids, grads) -> None:
-        ids, which = self._shard(ids)
-        grads = np.asarray(grads)
-        for k, s in enumerate(self.servers):
-            sel = np.nonzero(which == k)[0]
-            if sel.size:
-                self._rpc(s, _ps_push_sparse, name, ids[sel].tolist(),
-                          grads[sel])
+        from ...profiler import RecordEvent
+        with RecordEvent("ps:push_sparse"):
+            ids, which = self._shard(ids)
+            grads = np.asarray(grads)
+            for k, s in enumerate(self.servers):
+                sel = np.nonzero(which == k)[0]
+                if sel.size:
+                    self._rpc(s, _ps_push_sparse, name,
+                              ids[sel].tolist(), grads[sel])
+        _ps_account("push_sparse", name, len(ids), grads.nbytes)
 
     # -- dense ----------------------------------------------------------
     def pull_dense(self, name) -> np.ndarray:
-        return self._rpc(self.servers[0], _ps_pull_dense, name)
+        from ...profiler import RecordEvent
+        with RecordEvent("ps:pull_dense"):
+            out = self._rpc(self.servers[0], _ps_pull_dense, name)
+        _ps_account("pull_dense", name, len(out), out.nbytes)
+        return out
 
     def push_dense(self, name, grad) -> None:
-        self._rpc(self.servers[0], _ps_push_dense, name,
-                  np.asarray(grad))
+        from ...profiler import RecordEvent
+        grad = np.asarray(grad)
+        with RecordEvent("ps:push_dense"):
+            self._rpc(self.servers[0], _ps_push_dense, name, grad)
+        _ps_account("push_dense", name, len(grad), grad.nbytes)
 
     def stat(self, name) -> dict:
         stats = [self._rpc(s, _ps_stat, name) for s in self.servers]

@@ -117,10 +117,12 @@ def constrain_grad_shards(grads, params=None, axis="sharding"):
         return grads
     params = params or [None] * len(grads)
     out = []
+    n_constrained = n_skipped = 0
     for g, p in zip(grads, params):
         if g is None or getattr(g, "ndim", 0) < 1 \
                 or g.shape[0] % degree != 0:
             out.append(g)
+            n_skipped += 1
             continue
         pspec = getattr(p, "dist_spec", None) if p is not None else None
         rest = [None] * (g.ndim - 1)
@@ -128,11 +130,23 @@ def constrain_grad_shards(grads, params=None, axis="sharding"):
             entries = list(pspec) + [None] * (g.ndim - len(pspec))
             if entries[0] is not None:
                 out.append(g)  # dim 0 already owned by another axis
+                n_skipped += 1
                 continue
             rest = entries[1:g.ndim]
         spec = P(*([axis] + rest))
         out.append(jax.lax.with_sharding_constraint(
             g, NamedSharding(mesh, spec)))
+        n_constrained += 1
+    # stage-2 coverage telemetry (trace-time): how many grads actually
+    # reduce-scatter vs stay replicated — a silent coverage drop is the
+    # classic ZeRO-2 memory regression
+    from .. import monitor as _monitor
+    _monitor.gauge("zero2_grad_shards",
+                   "grads constrained to the sharding axis vs skipped",
+                   labels=("disposition",)) \
+        .labels(disposition="constrained").set(n_constrained)
+    _monitor.gauge("zero2_grad_shards", labels=("disposition",)) \
+        .labels(disposition="skipped").set(n_skipped)
     return out
 
 

@@ -101,6 +101,24 @@ def capture_buffer_writes():
         _grad_state.buffer_capture = prev
 
 
+# Parameter-version clock: a monotonically increasing counter bumped
+# whenever trainable state may have changed — optimizer steps (eager
+# ``step()`` and the compiled ``TrainStep`` write-back) and Layer
+# ``train()``/``eval()`` flips. Compiled-program caches that bake
+# parameter VALUES or mode flags in as constants (the SOT segment
+# cache) key on it so a stale program is never replayed.
+_param_version = [0]
+
+
+def bump_param_version() -> int:
+    _param_version[0] += 1
+    return _param_version[0]
+
+
+def param_version() -> int:
+    return _param_version[0]
+
+
 def is_grad_enabled() -> bool:
     return _grad_state.enabled
 

@@ -18,9 +18,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import (Tensor, as_jax, _wrap_out, functional_mode,
-                              no_grad)
+from ..framework.core import (Tensor, as_jax, bump_param_version,
+                              _wrap_out, functional_mode, no_grad)
 from ..static import InputSpec
+from .. import monitor as _monitor
+
+# jit-tier cache observability (monitor registry): every compile-cache
+# decision and every graph break is countable, with reason strings
+_jit_cache_events = _monitor.counter(
+    "jit_cache_events", "to_static compile-cache decisions",
+    labels=("fn", "event"))
+_jit_guard_invalidations = _monitor.counter(
+    "jit_guard_invalidations",
+    "guard snapshot changes forcing a retrace", labels=("fn", "reason"))
+_jit_graph_breaks = _monitor.counter(
+    "jit_graph_breaks", "to_static eager fallbacks",
+    labels=("fn", "kind"))
 
 __all__ = ["to_static", "not_to_static", "enable_to_static", "save", "load",
            "TrainStep", "ignore_module", "TranslatedLayer", "dy2static"]
@@ -256,6 +269,16 @@ class StaticFunction:
             # callable baked the OLD cell contents into its rebuilt
             # globals — drop it so conversion re-runs against the
             # current values (the compile-cache key below changes too)
+            prev = getattr(self, "_last_guards", None)
+            if prev is not None:
+                prev_d, cur_d = dict(prev), dict(guards)
+                changed = sorted(
+                    k for k in set(prev_d) | set(cur_d)
+                    if prev_d.get(k, _GUARD_MISS)
+                    != cur_d.get(k, _GUARD_MISS))
+                _jit_guard_invalidations.labels(
+                    fn=getattr(self._fn, "__name__", "?"),
+                    reason=",".join(changed[:4]) or "?").inc()
             self._last_guards = guards
             self.__dict__.pop("_conv_fn", None)
         try:
@@ -269,6 +292,9 @@ class StaticFunction:
             # re-jitting every call would silently pay full compilation
             # per invocation — run eagerly instead (with a warning)
             import warnings
+            _jit_graph_breaks.labels(
+                fn=getattr(self._fn, "__name__", "?"),
+                kind="unhashable_arg").inc()
             if not getattr(self, "_unhashable_warned", False):
                 warnings.warn(
                     f"to_static: {getattr(self._fn, '__name__', '?')} "
@@ -280,7 +306,15 @@ class StaticFunction:
             from collections import OrderedDict
             self._jitted = OrderedDict()
         jitted = self._jitted.get(key)
+        fn_label = getattr(self._fn, "__name__", "?")
         if jitted is None:
+            _jit_cache_events.labels(fn=fn_label, event="miss").inc()
+            if self._jitted:
+                # a prior specialization exists: this miss is a
+                # RE-specialization (guard change / new arg signature),
+                # the event worth alerting on vs a cold first compile
+                _jit_cache_events.labels(fn=fn_label,
+                                         event="recompile").inc()
             jitted = self._build(treedef, dyn_idx, statics)
             self._jitted[key] = jitted
             if len(self._jitted) > _JIT_CACHE_SIZE:
@@ -297,11 +331,28 @@ class StaticFunction:
                     "as a Tensor to trace it instead.")
         else:
             self._jitted.move_to_end(key)
+            _jit_cache_events.labels(fn=fn_label, event="hit").inc()
         if self._binder is not None:
             p = self._binder.param_arrays()
             b = self._binder.buffer_arrays()
         else:
             p, b = [], []
+        if key not in getattr(self, "_accounted", ()) \
+                and _monitor.metrics_enabled():
+            # per-specialization cost accounting (opt-in: it pays one
+            # extra trace). The jaxpr census is exact; FLOPs come from
+            # the pre-compile lowering's cost model when available.
+            self._accounted = getattr(self, "_accounted", set())
+            self._accounted.add(key)
+            try:
+                traced = jitted.trace(p, b, dyn_arrays)
+                lowered = traced.lower()
+                _monitor.record_compiled_step(
+                    f"jit:{fn_label}", jaxpr=traced.jaxpr,
+                    compiled=lowered
+                    if hasattr(lowered, "cost_analysis") else None)
+            except Exception:
+                pass
         try:
             out, new_buffers = jitted(p, b, dyn_arrays)
         except (jax.errors.TracerBoolConversionError,
@@ -331,6 +382,7 @@ class StaticFunction:
         import warnings
         from . import dy2static as _d2s
         name = getattr(self._fn, "__name__", str(self._fn))
+        _jit_graph_breaks.labels(fn=name, kind=kind).inc()
         _d2s.record_break(name, 0, f"{kind}: {exc}")
         breaks = [b for b in _d2s.graph_break_report()
                   if b["function"].split(".")[-1] == name.split(".")[-1]]
@@ -387,11 +439,23 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     return decorate
 
 
+_TRAIN_STEP_SEQ = [0]
+
+
 class TrainStep:
     """Whole-train-step compilation: loss, grads, clip, optimizer update in
     one donated XLA program. This is the structural replacement for the
     reference's fused optimizer + CINN path and the entry point used by
-    ``paddle.Model.fit`` and ``bench.py``."""
+    ``paddle.Model.fit`` and ``bench.py``.
+
+    The first call compiles through the AOT path (trace → lower →
+    compile) and the executable is REUSED for every later call with the
+    same input signature, so the compiled-step accounting —
+    ``cost_analysis()`` FLOPs/bytes, ``memory_analysis()`` peak HBM,
+    and the jaxpr collective census — costs no extra compilation.
+    ``paddle_tpu.monitor.step_report(step.telemetry_name)`` serves the
+    report; a signature change (new batch shape) drops back to the
+    caching ``jax.jit`` path, counted as a fallback recompile."""
 
     def __init__(self, layer, loss_fn, optimizer, donate=None):
         self.layer = layer
@@ -399,11 +463,15 @@ class TrainStep:
         self.optimizer = optimizer
         self.binder = _LayerBinder(layer)
         self._jitted = None
+        self._compiled = None
         self._state_keys: List[List[str]] = []
         if donate is None:
             from ..base_flags import get_flag
             donate = bool(get_flag("FLAGS_paddle_tpu_donate_buffers"))
         self._donate = donate
+        _TRAIN_STEP_SEQ[0] += 1
+        self.telemetry_name = (
+            f"train_step:{type(layer).__name__}:{_TRAIN_STEP_SEQ[0]}")
 
     def _layer_caller(self):
         """Callable for the traced forward: the layer through its hooks,
@@ -523,8 +591,32 @@ class TrainStep:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
+    def _aot_compile(self, call_args):
+        """AOT-compile the step for this input signature and record the
+        cost/memory accounting + collective census. The executable is
+        kept for reuse, so accounting costs no second compile; any
+        failure leaves the plain ``jax.jit`` path (which surfaces real
+        trace errors with their usual messages)."""
+        try:
+            traced = self._jitted.trace(*call_args)
+            compiled = traced.lower().compile()
+        except Exception:
+            self._compiled = None
+            return
+        self._compiled = compiled
+        _monitor.counter(
+            "train_step_compiles", "TrainStep AOT compilations",
+            labels=("step",)).labels(step=self.telemetry_name).inc()
+        try:
+            _monitor.record_compiled_step(
+                self.telemetry_name, jaxpr=traced.jaxpr,
+                compiled=compiled)
+        except Exception:
+            pass          # accounting must never sink the train step
+
     def __call__(self, *args, **kwargs):
-        if self._jitted is None:
+        first = self._jitted is None
+        if first:
             self._opt_states = self._init_opt_state()
             self._jitted = self._build()
             self._base_key = jax.random.PRNGKey(
@@ -536,9 +628,32 @@ class TrainStep:
         step_idx = np.uint32(self._step_idx)
         self._step_idx += 1
         batch = (_tree_to_arrays(args), _tree_to_arrays(kwargs))
-        loss, new_params, new_states, new_buffers = self._jitted(
-            params, self._opt_states, buffers, lr, self._base_key,
-            step_idx, batch)
+        call_args = (params, self._opt_states, buffers, lr,
+                     self._base_key, step_idx, batch)
+        if first:
+            self._aot_compile(call_args)
+        out = None
+        if self._compiled is not None:
+            try:
+                out = self._compiled(*call_args)
+            except TypeError:
+                # input signature changed (e.g. a new batch shape — jax
+                # rejects mismatched avals as TypeError BEFORE running,
+                # so donated buffers are untouched): fall back to the
+                # caching jit path, which recompiles per signature —
+                # counted so cache churn is visible. Runtime failures
+                # (OOM, XlaRuntimeError) propagate: the step may have
+                # consumed its donated inputs, so re-running would mask
+                # the real error with 'Array has been deleted'.
+                self._compiled = None
+                _monitor.counter(
+                    "train_step_fallback_recompiles",
+                    "signature misses off the AOT executable",
+                    labels=("step",)) \
+                    .labels(step=self.telemetry_name).inc()
+        if out is None:
+            out = self._jitted(*call_args)
+        loss, new_params, new_states, new_buffers = out
         for (_, p), arr in zip(self.binder.param_items, new_params):
             p._data = arr
         for (_, b), arr in zip(self.binder.buffer_items, new_buffers):
@@ -548,8 +663,15 @@ class TrainStep:
         # state (its inputs were donated), so state_dict()/save stay valid
         self._write_back_state(new_states)
         self.optimizer._step_count += 1
+        bump_param_version()   # compiled caches baking params go stale
         if hasattr(self.optimizer._learning_rate, "step"):
             pass  # scheduler stepping stays caller-controlled (Paddle parity)
+        _monitor.counter("train_step_calls", "TrainStep invocations",
+                         labels=("step",)) \
+            .labels(step=self.telemetry_name).inc()
+        # HBM watermark gauges at the step boundary (no-op on backends
+        # without allocator stats)
+        _monitor.sample_device_memory(step=self._step_idx - 1)
         from ..framework.core import _nan_check_enabled
         if _nan_check_enabled():
             val = float(np.asarray(loss))

@@ -26,14 +26,25 @@ from __future__ import annotations
 import functools
 from typing import Any, Dict, Optional
 
-from .opcode_translator import (SotUnsupported, TensorVar, _Simulator,
-                                _bind_args)
-from ...framework.core import Tensor, as_jax, _wrap_out
+from .opcode_translator import (GradFallback, SotUnsupported, TensorVar,
+                                _Simulator, _bind_args)
+from ...framework.core import (Tensor, as_jax, is_grad_enabled,
+                               param_version, _wrap_out)
+from ... import monitor as _monitor
 
-__all__ = ["symbolic_translate", "SotUnsupported", "sot_report"]
+__all__ = ["symbolic_translate", "SotUnsupported", "GradFallback",
+           "sot_report"]
 
 
 _TRANSLATORS = []
+
+_sot_events = _monitor.counter(
+    "sot_events", "SOT dispatch-tier decisions per call",
+    labels=("fn", "event"))
+_sot_breaks = _monitor.counter(
+    "sot_graph_breaks", "SOT graph-break events", labels=("reason",))
+
+_PV_GUARD = "__param_version__"
 
 
 def _guard_values(fn):
@@ -73,10 +84,11 @@ class SymbolicTranslator:
         self._stats = {"simulations": 0, "segments_compiled": 0,
                        "segments_executed": 0, "graph_breaks": 0,
                        "eager_calls": 0, "fast_hits": 0,
-                       "fallback_calls": 0}
+                       "fallback_calls": 0, "grad_fallbacks": 0}
         self._unsupported: Optional[str] = None
         self._sim_errors = 0        # generic simulator-error count
         self._fast_plan = None      # (guards, sig, key, sources, tmpl)
+        self._grad_latch: Optional[str] = None   # grad-mode eager latch
         _TRANSLATORS.append(self)
 
     def stats(self):
@@ -103,12 +115,25 @@ class SymbolicTranslator:
                     sig_items.append((k, "v", object()))  # never match
         return bound, tensors, tuple(sig_items)
 
+    def _current_guards(self, plan_guards):
+        """Live guard tuple comparable against a recorded plan's: the
+        scalar guards plus — when the plan captured Layer parameters —
+        the global parameter version (so optimizer steps and
+        train()/eval() flips miss the fast path and retrace)."""
+        cur = _guard_values(self.fn)
+        if any(k == _PV_GUARD for k, _ in plan_guards):
+            cur = cur + ((_PV_GUARD, param_version()),)
+        return cur
+
     def _try_fast(self, args, kwargs):
         if self._fast_plan is None:
             return _MISS
         guards, sig, key, sources, template = self._fast_plan
-        if _guard_values(self.fn) != guards:
+        if self._current_guards(guards) != guards:
             self._fast_plan = None      # guard invalidation -> retrace
+            _sot_events.labels(
+                fn=getattr(self.fn, "__qualname__", "?"),
+                event="guard_invalidation").inc()
             return _MISS
         bound, tensors, cur_sig = self._arg_tensors(args, kwargs)
         if cur_sig != sig:
@@ -122,6 +147,8 @@ class SymbolicTranslator:
         except Exception:
             return _MISS
         self._stats["fast_hits"] += 1
+        _sot_events.labels(fn=getattr(self.fn, "__qualname__", "?"),
+                           event="fast_hit").inc()
 
         def build(t):
             if isinstance(t, tuple) and len(t) == 2 and t[0] == "__o__":
@@ -172,10 +199,61 @@ class SymbolicTranslator:
 
     # ----------------------------------------------------------- call
 
+    def _grad_fallback(self, reason, args, kwargs):
+        """Eager execution because capture would sever the autograd
+        tape (replayed segments return stop_gradient=True outputs).
+        Counted in the registry + dy2static break report; NOT latched
+        as ``_unsupported`` — under ``no_grad`` the function still
+        captures."""
+        self._stats["grad_fallbacks"] += 1
+        self._stats["fallback_calls"] += 1
+        qual = getattr(self.fn, "__qualname__", "?")
+        _sot_events.labels(fn=qual, event="grad_fallback").inc()
+        _sot_breaks.labels(reason="grad_fallback").inc()
+        if not getattr(self, "_grad_break_recorded", False):
+            self._grad_break_recorded = True
+            from .. import dy2static as _d2s
+            _d2s.record_break(
+                qual, getattr(self.fn.__code__, "co_firstlineno", 0),
+                f"GradFallback: {reason}")
+        return self.fn(*args, **kwargs)
+
+    def _grad_mode_block(self, args, kwargs) -> Optional[str]:
+        """Reason the call must run eagerly under grad mode, or None.
+        Checks the latched mid-simulation verdict, grad-requiring
+        tensor arguments, and (for bound Layer methods) trainable
+        parameters of the receiver."""
+        if not is_grad_enabled():
+            return None
+        if self._grad_latch is not None:
+            return self._grad_latch
+        for v in list(args) + list(kwargs.values()):
+            if isinstance(v, Tensor) and v.stop_gradient is False:
+                return "inputs require grad"
+        recv = getattr(self.fn, "__self__", None)
+        if recv is not None and hasattr(recv, "named_parameters"):
+            try:
+                if any(not p.stop_gradient for p in recv.parameters()):
+                    reason = ("captures trainable parameters of "
+                              f"{type(recv).__name__}")
+                    # latch: the receiver is fixed for a bound method,
+                    # so don't re-walk parameters() on every call of
+                    # the hot training path (no_grad calls still
+                    # capture — the latch is only consulted under grad)
+                    self._grad_latch = reason
+                    return reason
+            except Exception:
+                pass
+        return None
+
     def __call__(self, *args, **kwargs):
         if self._unsupported is not None:
             self._stats["fallback_calls"] += 1
             return self.fn(*args, **kwargs)
+        reason = self._grad_mode_block(args, kwargs)
+        if reason is not None:     # BEFORE the fast path: a cached
+            # replay would also return stop_gradient=True outputs
+            return self._grad_fallback(reason, args, kwargs)
         fast = self._try_fast(args, kwargs)
         if fast is not _MISS:
             return fast
@@ -183,11 +261,19 @@ class SymbolicTranslator:
         _, _, sig = self._arg_tensors(args, kwargs)
         sim = _Simulator(self.fn, self.segment_cache, self._stats)
         self._stats["simulations"] += 1
+        _sot_events.labels(fn=getattr(self.fn, "__qualname__", "?"),
+                           event="simulate").inc()
         try:
             result = sim.run(args, kwargs)
+        except GradFallback as exc:
+            # latch: while grads stay enabled, later calls skip the
+            # (wasted) partial re-simulation and go straight eager
+            self._grad_latch = str(exc)
+            return self._grad_fallback(str(exc), args, kwargs)
         except SotUnsupported as exc:
             self._unsupported = str(exc)
             self._stats["fallback_calls"] += 1
+            _sot_breaks.labels(reason=str(exc)[:80] or "?").inc()
             from .. import dy2static as _d2s
             _d2s.record_break(
                 getattr(self.fn, "__qualname__", "?"),
@@ -206,12 +292,20 @@ class SymbolicTranslator:
             if self._sim_errors >= 2:
                 self._unsupported = f"simulator error: {exc!r}"
             self._stats["fallback_calls"] += 1
+            _sot_breaks.labels(
+                reason=f"simulator error: {type(exc).__name__}").inc()
             from .. import dy2static as _d2s
             _d2s.record_break(
                 getattr(self.fn, "__qualname__", "?"),
                 getattr(self.fn.__code__, "co_firstlineno", 0),
                 f"simulator error: {exc!r}")
             return self.fn(*args, **kwargs)
+        if sim.captures_params:
+            # Layer-capturing segments bake parameter values/mode into
+            # their compiled replays: guard the fast plan on the global
+            # param version so optimizer steps and train()/eval() flips
+            # re-simulate instead of replaying stale weights
+            guards = guards + ((_PV_GUARD, param_version()),)
         self._record_fast_plan(sim, result, guards, sig)
         return result
 

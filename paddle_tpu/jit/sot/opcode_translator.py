@@ -33,8 +33,16 @@ from __future__ import annotations
 
 import dis
 import operator
+import sys
 import types
 from typing import Any, Dict, List, Optional, Tuple
+
+# The VM was written against the 3.12 opcode set; the compatibility
+# branches below (legacy BINARY_*/CALL_FUNCTION*/LOAD_METHOD/ROT_*,
+# FOR_ITER exhaustion, LOAD_GLOBAL/LOAD_ATTR flag bits) extend capture
+# to the 3.10/3.11 images the TPU containers still ship.
+_PY311 = sys.version_info >= (3, 11)
+_PY312 = sys.version_info >= (3, 12)
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +54,16 @@ from ...framework.core import Tensor, as_jax, _wrap_out
 class SotUnsupported(Exception):
     """Construct the simulator does not model — caller must run the
     whole frame eagerly (clean graph-break-to-eager semantics)."""
+
+
+class GradFallback(Exception):
+    """The segment would bake grad-carrying state into a jax.jit replay
+    whose outputs come back ``stop_gradient=True`` — silently severing
+    the autograd tape. Raised while gradients are enabled when a
+    recorded op's input requires grad or its receiver is a Layer with
+    trainable parameters; the caller runs the frame eagerly and records
+    the graph-break reason (under ``no_grad`` capture proceeds, keyed
+    by the parameter version)."""
 
 
 class _GraphBreak(Exception):
@@ -100,6 +118,22 @@ _CMPOPS = {
     "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
 }
 
+# pre-3.11 dedicated binary/inplace opcodes (3.11 folded them into
+# BINARY_OP); inplace forms degrade to the plain operator like above
+_LEGACY_BINOPS = {
+    "BINARY_ADD": operator.add, "BINARY_SUBTRACT": operator.sub,
+    "BINARY_MULTIPLY": operator.mul,
+    "BINARY_TRUE_DIVIDE": operator.truediv,
+    "BINARY_FLOOR_DIVIDE": operator.floordiv,
+    "BINARY_MODULO": operator.mod, "BINARY_POWER": operator.pow,
+    "BINARY_MATRIX_MULTIPLY": operator.matmul,
+    "BINARY_AND": operator.and_, "BINARY_OR": operator.or_,
+    "BINARY_XOR": operator.xor, "BINARY_LSHIFT": operator.lshift,
+    "BINARY_RSHIFT": operator.rshift,
+}
+_LEGACY_BINOPS.update({k.replace("BINARY_", "INPLACE_"): v
+                       for k, v in list(_LEGACY_BINOPS.items())})
+
 _UNSUPPORTED_OPS = {
     "RETURN_GENERATOR", "YIELD_VALUE", "SEND",            # generators
     "SETUP_FINALLY", "PUSH_EXC_INFO", "POP_EXCEPT",       # try/except
@@ -137,10 +171,49 @@ class _Simulator:
         self.flush_records = []       # (cache_key, sources, out ids)
         self.stats_run = {"graph_breaks": 0, "eager_calls": 0,
                           "py_effects": 0}
+        self.captures_params = False  # any Layer captured by a segment
+        self._layer_grad_cache = {}   # id(layer) -> has trainable param
 
     # ---------------------------------------------------------- tape
 
+    def _check_grad_capture(self, fn, args):
+        """SOT correctness gate (see :class:`GradFallback`): replayed
+        segments return ``stop_gradient=True`` tensors, so while grads
+        are enabled nothing grad-carrying may be recorded."""
+        recv = getattr(fn, "__self__", fn)
+        is_layer = hasattr(recv, "_parameters") \
+            and hasattr(recv, "named_parameters")
+        if is_layer:
+            self.captures_params = True
+        from ...framework.core import is_grad_enabled
+        if not is_grad_enabled():
+            return
+        if isinstance(recv, Tensor) and recv.stop_gradient is False:
+            # a concrete bound-method receiver (baked into the node,
+            # not visible in args) carrying grad
+            raise GradFallback("segment captures a grad-requiring "
+                               "tensor")
+        if is_layer:
+            has_trainable = self._layer_grad_cache.get(id(recv))
+            if has_trainable is None:
+                try:
+                    has_trainable = any(not p.stop_gradient
+                                        for p in recv.parameters())
+                except Exception:
+                    has_trainable = False
+                self._layer_grad_cache[id(recv)] = has_trainable
+            if has_trainable:
+                raise GradFallback(
+                    "segment captures trainable parameters of "
+                    f"{type(recv).__name__}")
+        for a in args:
+            if isinstance(a, TensorVar) and a.concrete is not None \
+                    and getattr(a.concrete, "stop_gradient", True) \
+                    is False:
+                raise GradFallback("segment input requires grad")
+
     def record(self, fn, args, kwargs, key):
+        self._check_grad_capture(fn, args)
         node = _Node(fn, list(args), dict(kwargs or {}), key)
         self.tape.append(node)
         out = TensorVar(node=node, out_pos=0)
@@ -202,9 +275,30 @@ class _Simulator:
         struct_key = (tuple(
             (n.key, tuple(_const_key(d) for d in p[1]), p[2])
             for n, p in zip(tape, plan)), outs_desc, sig)
-        cache_key = (id(self.code), self.seg_start_offset, struct_key)
+        # parameter-staleness guard: segments that captured a Layer bake
+        # its parameter VALUES (and training-mode flag) into the jit
+        # replay as constants — key them on the global param version so
+        # optimizer steps and train()/eval() flips retrace instead of
+        # replaying stale weights. Param-free segments use a constant.
+        if self.captures_params:
+            from ...framework.core import param_version
+            pv = param_version()
+        else:
+            pv = -1
+        cache_key = (id(self.code), self.seg_start_offset, pv,
+                     struct_key)
 
         compiled = self.segment_cache.get(cache_key)
+        if compiled is None and pv != -1:
+            # evict superseded param versions of this segment before
+            # compiling the new one — each stale entry pins a compiled
+            # executable with old weights baked in, and pv bumps every
+            # optimizer step (unbounded growth otherwise)
+            stale = [k for k in self.segment_cache
+                     if k[0] == cache_key[0] and k[1] == cache_key[1]
+                     and k[2] not in (-1, pv) and k[3] == struct_key]
+            for k in stale:
+                del self.segment_cache[k]
         if compiled is None:
             def replay(in_arrays):
                 from ...framework.core import functional_mode
@@ -233,6 +327,11 @@ class _Simulator:
             compiled = jax.jit(replay)
             self.segment_cache[cache_key] = compiled
             self.stats["segments_compiled"] += 1
+            from ... import monitor as _monitor
+            _monitor.counter(
+                "sot_segment_compiles", "SOT sub-graph compilations",
+                labels=("fn",)).labels(
+                fn=getattr(self.fn, "__qualname__", "?")).inc()
 
         arrays = compiled([as_jax(t) for t in inputs])
         self.stats["segments_executed"] += 1
@@ -407,7 +506,9 @@ class _Simulator:
             elif op == "DELETE_FAST":
                 self.locals_.pop(ins.argval, None)
             elif op == "LOAD_GLOBAL":
-                if ins.arg & 1:
+                # the "push NULL" flag bit exists only on 3.11+ (on
+                # 3.10 arg is the plain name index)
+                if _PY311 and ins.arg & 1:
                     self.stack.append(_NULL)
                 name = ins.argval
                 if name in globals_:
@@ -420,7 +521,8 @@ class _Simulator:
             elif op == "LOAD_ATTR":
                 obj = self.stack.pop()
                 name = ins.argval
-                method_form = bool(ins.arg & 1)
+                # the method-form flag bit is 3.12 encoding
+                method_form = _PY312 and bool(ins.arg & 1)
                 v = self._getattr(obj, name)
                 if method_form:
                     self.stack.append(_NULL)
@@ -536,7 +638,12 @@ class _Simulator:
                 try:
                     self.stack.append(self._wrap(next(it)))
                 except StopIteration:
-                    self.stack.append(_ITER_END)
+                    if _PY312:
+                        # 3.12: jump to END_FOR with iter + sentinel
+                        self.stack.append(_ITER_END)
+                    else:
+                        # 3.10/3.11: pop the iterator, jump past loop
+                        self.stack.pop()
                     idx = self.offset_index[ins.argval]
                     continue
             elif op == "END_FOR":
@@ -579,9 +686,64 @@ class _Simulator:
                 self.stack.append(self._call_dispatch(fn, args_v,
                                                       kwargs_v))
             elif op in ("JUMP_FORWARD", "JUMP_BACKWARD",
-                        "JUMP_BACKWARD_NO_INTERRUPT"):
+                        "JUMP_BACKWARD_NO_INTERRUPT", "JUMP_ABSOLUTE"):
                 idx = self.offset_index[ins.argval]
                 continue
+            # ---- pre-3.12 compatibility opcodes -----------------------
+            elif op in _LEGACY_BINOPS:
+                rhs = self.stack.pop()
+                lhs = self.stack.pop()
+                self.stack.append(self._binary(_LEGACY_BINOPS[op],
+                                               lhs, rhs))
+            elif op == "LOAD_METHOD":
+                # _getattr always yields a BOUND callable (concrete
+                # bound method or _BoundLazyMethod), so no NULL/self
+                # pair is needed — CALL_METHOD pops args then it
+                obj = self.stack.pop()
+                self.stack.append(self._getattr(obj, ins.argval))
+            elif op in ("CALL_METHOD", "CALL_FUNCTION"):
+                args_v = self._popn(ins.arg)
+                fn = self.stack.pop()
+                self.stack.append(self._call_dispatch(fn, args_v, {}))
+            elif op == "CALL_FUNCTION_KW":
+                kwn = self._concrete(self.stack.pop())
+                args_v = self._popn(ins.arg)
+                kwargs_v = dict(zip(kwn, args_v[-len(kwn):]))
+                args_v = args_v[:-len(kwn)]
+                fn = self.stack.pop()
+                self.stack.append(self._call_dispatch(fn, args_v,
+                                                      kwargs_v))
+            elif op in ("JUMP_IF_TRUE_OR_POP", "JUMP_IF_FALSE_OR_POP"):
+                t = self._truth(self.stack[-1])
+                if (op == "JUMP_IF_TRUE_OR_POP") == bool(t):
+                    idx = self.offset_index[ins.argval]
+                    continue
+                self.stack.pop()
+            elif op == "DUP_TOP":
+                self.stack.append(self.stack[-1])
+            elif op == "DUP_TOP_TWO":
+                self.stack.extend([self.stack[-2], self.stack[-1]])
+            elif op == "ROT_TWO":
+                s = self.stack
+                s[-1], s[-2] = s[-2], s[-1]
+            elif op == "ROT_THREE":
+                v = self.stack.pop()
+                self.stack.insert(len(self.stack) - 2, v)
+            elif op == "ROT_FOUR":
+                v = self.stack.pop()
+                self.stack.insert(len(self.stack) - 3, v)
+            elif op == "UNARY_POSITIVE":
+                v = self.stack.pop()
+                self.stack.append(self._unary(operator.pos, v))
+            elif op == "LIST_TO_TUPLE":
+                self.stack.append(tuple(self.stack.pop()))
+            elif op == "BUILD_CONST_KEY_MAP":
+                keys = self._concrete(self.stack.pop())
+                vals = self._popn(ins.arg)
+                self.stack.append(dict(zip(keys, vals)))
+            elif op == "GET_LEN":
+                self.stack.append(len(self._concrete(self.stack[-1])))
+            # -----------------------------------------------------------
             elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
                 v = self.stack.pop()
                 t = self._truth(v)
@@ -650,6 +812,11 @@ class _Simulator:
             self._flush(self._live_vars() + [v])
             self.stats["graph_breaks"] += 1
             self.stats_run["graph_breaks"] += 1
+            from ... import monitor as _monitor
+            _monitor.counter(
+                "sot_graph_breaks", "SOT graph-break events",
+                labels=("reason",)).labels(
+                reason="data_dependent_branch").inc()
             self.seg_start_offset += 1   # next segment gets a new key
             return bool(np.asarray(as_jax(v.concrete)))
         return bool(v)

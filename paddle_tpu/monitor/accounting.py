@@ -1,0 +1,276 @@
+"""Compiled-step cost/memory accounting and collective census.
+
+GSPMD (PAPERS.md) partitioned programs live or die by communication
+placement, and XLA's cost model is how compiled-step time is attributed
+to compute vs bytes — this module surfaces both from INSIDE the
+framework at compile time instead of from offline trace parses:
+
+- ``record_compiled_step``: for every ``TrainStep``/jit compile, pull
+  ``compiled.cost_analysis()`` FLOPs/bytes and ``memory_analysis()``
+  peak HBM into registry gauges, and walk the jaxpr for a census of
+  collective ops (all_reduce/all_to_all/all_gather/... counts + payload
+  bytes per mesh axis).
+- ``collective_census``: the jaxpr walk itself — recurses through
+  pjit/shard_map/scan/cond sub-jaxprs, so shard_map-placed collectives
+  (MoE EP all-to-alls, 1F1B ppermutes, ring attention) are counted
+  with their per-shard payloads. GSPMD-inferred collectives only
+  materialize in HLO post-partitioning; their jaxpr-level proxy here is
+  the ``sharding_constraint`` count.
+- ``sample_device_memory``: HBM watermark gauges at step boundaries.
+- ``analytic_mfu``: the cost-model MFU — recorded FLOPs/step over
+  measured step time over the chip's peak.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .registry import get_registry
+
+__all__ = ["record_compiled_step", "collective_census", "step_report",
+           "step_reports", "sample_device_memory", "analytic_mfu",
+           "device_peak_flops"]
+
+# jaxpr primitive -> census op family
+_COLLECTIVE_PRIMS = {
+    "psum": "all_reduce",
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "all_to_all": "all_to_all",
+    "all_gather": "all_gather",
+    "ppermute": "ppermute",
+    "pshuffle": "ppermute",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+}
+
+_STEP_REPORTS: Dict[str, dict] = {}
+
+
+def _walk_jaxpr(jaxpr, visit):
+    """Depth-first over every eqn including sub-jaxprs hidden in params
+    (pjit ``jaxpr``, shard_map ``jaxpr``, scan/while bodies, cond
+    ``branches``, custom_vjp ``call_jaxpr``...)."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)     # ClosedJaxpr -> Jaxpr
+    for eqn in getattr(core, "eqns", ()):
+        visit(eqn)
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (list, tuple)) else (v,)
+            for e in vs:
+                inner = getattr(e, "jaxpr", e)
+                if hasattr(inner, "eqns"):
+                    _walk_jaxpr(e, visit)
+
+
+def _payload_bytes(eqn) -> int:
+    total = 0
+    for var in eqn.invars:
+        aval = getattr(var, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        try:
+            total += int(np.prod(shape)) * np.dtype(dtype).itemsize
+        except Exception:
+            pass
+    return total
+
+
+def _axis_label(eqn) -> str:
+    ax = eqn.params.get("axis_name", eqn.params.get("axes", ()))
+    if not isinstance(ax, (list, tuple)):
+        ax = (ax,)
+    names = [str(a) for a in ax if isinstance(a, (str,))]
+    return ",".join(names) or "?"
+
+
+def collective_census(jaxpr) -> List[dict]:
+    """[{op, axis, count, bytes}] over the whole (closed) jaxpr,
+    including sub-jaxprs, plus one ``sharding_constraint`` row when
+    GSPMD annotations are present (their collectives are inserted by
+    the SPMD partitioner and only visible in HLO)."""
+    agg: Dict[tuple, List[int]] = {}
+    n_constraint = [0]
+
+    def visit(eqn):
+        name = eqn.primitive.name
+        fam = _COLLECTIVE_PRIMS.get(name)
+        if fam is not None:
+            key = (fam, _axis_label(eqn))
+            cnt_b = agg.setdefault(key, [0, 0])
+            cnt_b[0] += 1
+            cnt_b[1] += _payload_bytes(eqn)
+        elif name == "sharding_constraint":
+            n_constraint[0] += 1
+
+    _walk_jaxpr(jaxpr, visit)
+    out = [{"op": op, "axis": axis, "count": c, "bytes": b}
+           for (op, axis), (c, b) in sorted(agg.items())]
+    if n_constraint[0]:
+        out.append({"op": "sharding_constraint", "axis": "",
+                    "count": n_constraint[0], "bytes": 0})
+    return out
+
+
+def _cost_dict(compiled) -> dict:
+    """Normalized ``cost_analysis()``: {'flops': f, 'bytes_accessed': b}
+    across jax versions (dict vs list-of-dict per program)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    out = {}
+    for f in fields:
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f] = int(v)
+    if out:
+        # peak HBM the executable pins: live arguments + temporaries +
+        # the program itself (outputs alias into temp space)
+        out["peak_hbm_bytes"] = (out.get("argument_size_in_bytes", 0)
+                                 + out.get("temp_size_in_bytes", 0)
+                                 + out.get(
+                                     "generated_code_size_in_bytes", 0))
+    return out
+
+
+def record_compiled_step(name: str, jaxpr=None, compiled=None) -> dict:
+    """Account one compiled step program under ``name``. Fills the
+    step gauges + census counters and returns (and stores) the report
+    dict that ``step_report(name)`` serves."""
+    reg = get_registry()
+    report: dict = {"step": name}
+    if compiled is not None:
+        cost = _cost_dict(compiled)
+        mem = _memory_dict(compiled)
+        report.update(cost)
+        report["memory"] = mem
+        if "flops" in cost:
+            reg.gauge("step_flops",
+                      "cost_analysis FLOPs of the compiled step",
+                      labels=("step",)).labels(step=name) \
+                .set(cost["flops"])
+        if "bytes_accessed" in cost:
+            reg.gauge("step_bytes_accessed",
+                      "cost_analysis bytes accessed per step",
+                      labels=("step",)).labels(step=name) \
+                .set(cost["bytes_accessed"])
+        if "peak_hbm_bytes" in mem:
+            reg.gauge("step_peak_hbm_bytes",
+                      "memory_analysis peak HBM of the compiled step",
+                      labels=("step",)).labels(step=name) \
+                .set(mem["peak_hbm_bytes"])
+    census = collective_census(jaxpr) if jaxpr is not None else []
+    report["collective_census"] = census
+    cc = reg.counter("step_collectives",
+                     "collective ops in the step jaxpr",
+                     labels=("step", "op", "axis"))
+    cb = reg.counter("step_collective_bytes",
+                     "per-shard payload bytes of step collectives",
+                     labels=("step", "op", "axis"))
+    for row in census:
+        cc.labels(step=name, op=row["op"], axis=row["axis"]) \
+            .inc(row["count"])
+        cb.labels(step=name, op=row["op"], axis=row["axis"]) \
+            .inc(row["bytes"])
+    # always-present summary keys (a zero is information: no explicit
+    # collectives in this program's jaxpr)
+    reg.gauge("step_collective_ops",
+              "total collective-op count in the step jaxpr",
+              labels=("step",)).labels(step=name).set(
+        sum(r["count"] for r in census
+            if r["op"] != "sharding_constraint"))
+    reg.info("step_report", "full per-step accounting report",
+             labels=("step",)).labels(step=name).set(report)
+    _STEP_REPORTS[name] = report
+    return report
+
+
+def step_report(name: str) -> Optional[dict]:
+    return _STEP_REPORTS.get(name)
+
+
+def step_reports() -> Dict[str, dict]:
+    return dict(_STEP_REPORTS)
+
+
+def device_peak_flops() -> float:
+    """Peak bf16 FLOP/s of the local chip (mirrors bench.py's table;
+    CPU returns a nominal 1 TF/s so analytic MFU stays defined)."""
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return 1e12
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind:
+        return 918e12
+    if "v5" in kind or "lite" in kind:
+        return 197e12
+    if getattr(dev, "platform", "") == "cpu":
+        return 1e12
+    return 197e12
+
+
+def analytic_mfu(name: str, step_time_s: float,
+                 peak_flops: Optional[float] = None) -> Optional[float]:
+    """Cost-model MFU: recorded FLOPs/step over measured step time over
+    chip peak. None when the step has no recorded FLOPs."""
+    rep = _STEP_REPORTS.get(name) or {}
+    flops = rep.get("flops")
+    if not flops or step_time_s <= 0:
+        return None
+    return float(flops) / step_time_s / (peak_flops
+                                         or device_peak_flops())
+
+
+def sample_device_memory(step: Optional[int] = None) -> dict:
+    """HBM watermark gauges from the device allocator, sampled at step
+    boundaries. Returns the raw stats dict ({} where the backend has no
+    allocator stats, e.g. CPU)."""
+    import jax
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    if not stats:
+        return {}
+    reg = get_registry()
+    keep = {"bytes_in_use": "device_bytes_in_use",
+            "peak_bytes_in_use": "device_peak_bytes_in_use",
+            "bytes_limit": "device_bytes_limit",
+            "largest_alloc_size": "device_largest_alloc_bytes"}
+    for src, gname in keep.items():
+        if src in stats:
+            reg.gauge(gname, "device allocator watermark",
+                      labels=("device",)) \
+                .labels(device="0").set(int(stats[src]))
+    return stats

@@ -97,15 +97,19 @@ class Layer:
 
     # -- modes ----------------------------------------------------------
     def train(self):
+        from ...framework.core import bump_param_version
         self.training = True
         for layer in self.sublayers():
             layer.training = True
+        bump_param_version()   # invalidate mode-baked compiled caches
         return self
 
     def eval(self):
+        from ...framework.core import bump_param_version
         self.training = False
         for layer in self.sublayers():
             layer.training = False
+        bump_param_version()   # invalidate mode-baked compiled caches
         return self
 
     # -- registration ---------------------------------------------------

@@ -47,7 +47,62 @@ _KERNEL_NOTES = [
     "  values bound memory and report overflow in `drop_rate`.",
     "- `MOE_STATS` / `moe_stats()` — trace-time path counters",
     "  (grouped_mm_calls, grouped_mm_kernel, ep_shard_map_calls,",
-    "  padded_einsum_calls) for asserting kernel selection.",
+    "  padded_einsum_calls) for asserting kernel selection. Served by",
+    "  the `paddle_tpu.monitor` registry (`moe_path_calls{path=...}`)",
+    "  — the dict is a thin alias.",
+    "",
+    "## Telemetry (`paddle_tpu.monitor`)",
+    "",
+    "Framework-wide runtime telemetry: a labeled metrics registry",
+    "(Counter/Gauge/Histogram/Info), compiled-step cost/memory",
+    "accounting, and hot-path instrumentation. See also BENCH",
+    "`bench_detail.json`'s `telemetry` block.",
+    "",
+    "Environment variables:",
+    "",
+    "- `PADDLE_TPU_METRICS_DIR=<dir>` — export every metric as JSONL to",
+    "  `<dir>/metrics-<pid>.jsonl` at interpreter exit (and on demand",
+    "  via `monitor.export_jsonl()`). One JSON record per",
+    "  (metric, labelset): `{name, kind, labels, value, ts}`.",
+    "- `PADDLE_TPU_METRICS_DUMP=stdout|stderr` — print the text table",
+    "  (`monitor.report()`) at exit.",
+    "- `PADDLE_TPU_METRICS=1` — enable the heavier opt-in accounting",
+    "  (per-specialization `to_static` cost records) without exporting.",
+    "- `GLOG_v=<n>` — verbose runtime logging (framework/log.py), the",
+    "  reference's glog knob; orthogonal to metrics but usually read",
+    "  together when debugging a step.",
+    "",
+    "Reading the step report: every `TrainStep` AOT-compiles on its",
+    "first call and records `cost_analysis()` FLOPs/bytes,",
+    "`memory_analysis()` peak HBM, and a jaxpr-walk collective census",
+    "(op counts + per-shard payload bytes per mesh axis) under",
+    "`monitor.step_report(step.telemetry_name)`. Key metrics:",
+    "",
+    "- `step_flops{step=}` / `step_bytes_accessed{step=}` /",
+    "  `step_peak_hbm_bytes{step=}` — the XLA cost model's view of one",
+    "  compiled step.",
+    "- `step_collectives{step=,op=,axis=}` (+ `step_collective_bytes`)",
+    "  — all_reduce / all_to_all / all_gather / ppermute /",
+    "  reduce_scatter counts per mesh axis. GSPMD-inferred collectives",
+    "  only exist post-partitioning; their jaxpr proxy is the",
+    "  `sharding_constraint` row.",
+    "- `jit_cache_events{fn=,event=hit|miss|recompile}`,",
+    "  `jit_guard_invalidations{fn=,reason=}`, `sot_events{fn=,event=}`,",
+    "  `sot_graph_breaks{reason=}` — compile-cache behavior with reason",
+    "  strings (a recompile-per-step loop shows up here first).",
+    "- `device_peak_bytes_in_use{device=}` — HBM watermark sampled at",
+    "  step boundaries.",
+    "- `record_event_ms{name=}` — RecordEvent span histograms (MoE",
+    "  dispatch/expert_mm/combine, pipeline 1F1B, PS push/pull).",
+    "",
+    "Analytic vs bench MFU: `monitor.analytic_mfu(name, step_time_s)`",
+    "= recorded FLOPs/step ÷ measured step time ÷ chip peak. The bench",
+    "MFU uses the 6N+attention FLOPs/token closed form; the analytic",
+    "number uses XLA's per-op cost model on the exact compiled program,",
+    "so it additionally counts remat recompute, optimizer/elementwise",
+    "FLOPs, and non-matmul work — expect it to sit ABOVE the bench MFU",
+    "at equal throughput, and read their RATIO as the compiled",
+    "program's overhead factor rather than comparing either to 1.0.",
 ]
 
 

@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Parameter, Tensor, as_jax, _wrap_out, no_grad
+from ..framework.core import (Parameter, Tensor, as_jax,
+                              bump_param_version, _wrap_out, no_grad)
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW",
@@ -96,6 +97,7 @@ class Optimizer:
     @no_grad()
     def step(self):
         self._step_count += 1
+        bump_param_version()
         params_grads = []
         for p in self._parameter_list:
             if p.stop_gradient or p.grad is None:
@@ -397,6 +399,7 @@ class AdamW(Adam):
     def step(self):
         # track param identity for apply_decay_param_fun
         self._step_count += 1
+        bump_param_version()
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
@@ -616,6 +619,7 @@ class LBFGS(Optimizer):
         self._max_iter = max_iter
 
     def step(self, closure=None):
+        bump_param_version()
         if closure is None:
             # fall back to a plain gradient step
             for p in self._parameter_list:

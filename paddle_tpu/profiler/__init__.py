@@ -53,11 +53,18 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 class RecordEvent:
-    """Host-side trace annotation (``platform::RecordEvent`` parity)."""
+    """Host-side trace annotation (``platform::RecordEvent`` parity).
+
+    Besides the ``jax.profiler.TraceAnnotation`` (visible in the
+    TensorBoard/Chrome trace), every span also lands in the metrics
+    registry as a ``record_event_ms{name=...}`` histogram — so span
+    counts and wall time are observable without a trace capture (spans
+    inside a jit trace measure TRACE time, not device time)."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def __enter__(self):
         self.begin()
@@ -68,6 +75,7 @@ class RecordEvent:
         return False
 
     def begin(self):
+        self._t0 = time.perf_counter()
         try:
             self._ctx = jax.profiler.TraceAnnotation(self.name)
             self._ctx.__enter__()
@@ -78,6 +86,18 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._t0 is not None:
+            dt_ms = (time.perf_counter() - self._t0) * 1000.0
+            self._t0 = None
+            try:
+                from ..monitor import get_registry
+                get_registry().histogram(
+                    "record_event_ms",
+                    "RecordEvent span wall time (host side)",
+                    labels=("name",)).labels(name=self.name) \
+                    .observe(dt_ms)
+            except Exception:
+                pass
 
 
 class Profiler:
@@ -251,10 +271,80 @@ def _find_chrome_trace(log_dir):
     return hits[-1] if hits else None
 
 
+def _op_base_category(name):
+    """Shared normalization: strip the SSA %-prefix / numeric suffixes
+    off an op name to get its category."""
+    import re
+    base = re.sub(r"\.\d+$", "", name.split(" ")[0].lstrip("%"))
+    return re.sub(r"\.\d+$", "", base.split("=")[0]).strip()
+
+
 def _parse_xplane_ops(log_dir):
     """Aggregate the trace's device-op events into
-    [(name, category, calls, total_ms)]. Uses the xplane proto bundled
-    with tensorflow's tsl; returns [] when unavailable."""
+    [(name, category, calls, total_ms)]. Primary source is the xplane
+    proto bundled with tensorflow's tsl; on TPU images without TF the
+    decompressed Chrome ``trace.json.gz`` serves the same table (its
+    thread names mirror the xplane lines), so ``summary()`` is never
+    empty for lack of the proto."""
+    ops = _parse_xplane_proto(log_dir)
+    if ops:
+        return ops
+    return _parse_chrome_trace_ops(log_dir)
+
+
+def _parse_chrome_trace_ops(log_dir):
+    """Device-op table from the Chrome trace: complete ("X") events on
+    device-process threads, aggregated by op name. Durations are in
+    microseconds in the Chrome format."""
+    src = _find_chrome_trace(log_dir)
+    if src is None:
+        return []
+    import gzip
+    import json as _json
+    try:
+        with gzip.open(src, "rt") as f:
+            data = _json.load(f)
+    except Exception:
+        return []
+    events = data.get("traceEvents", []) or []
+    pnames, tnames = {}, {}
+    for ev in events:
+        if ev.get("ph") != "M":
+            continue
+        if ev.get("name") == "process_name":
+            pnames[ev.get("pid")] = ev.get("args", {}).get("name", "")
+        elif ev.get("name") == "thread_name":
+            tnames[(ev.get("pid"), ev.get("tid"))] = \
+                ev.get("args", {}).get("name", "")
+    agg = {}
+
+    def _consume(pred):
+        found = False
+        for ev in events:
+            if ev.get("ph") != "X" or not pred(ev):
+                continue
+            name = ev.get("name", "?")
+            cat = _op_base_category(name)
+            calls, ms = agg.get((name, cat), (0, 0.0))
+            agg[(name, cat)] = (calls + 1,
+                                ms + float(ev.get("dur", 0)) / 1e3)
+            found = True
+        return found
+
+    def _device(ev):
+        pn = pnames.get(ev.get("pid"), "")
+        tn = tnames.get((ev.get("pid"), ev.get("tid")), "")
+        return (("TPU" in pn or "GPU" in pn)
+                and (not tn or "XLA Ops" in tn or "Steps" not in tn))
+
+    got = _consume(_device)
+    if not got:                      # CPU backend: take host events
+        _consume(lambda ev: True)
+    return [(name, cat, calls, ms)
+            for (name, cat), (calls, ms) in agg.items()]
+
+
+def _parse_xplane_proto(log_dir):
     import glob
     import re
     try:

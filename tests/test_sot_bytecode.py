@@ -190,7 +190,10 @@ def test_kwargs_and_methods():
 
 def test_to_static_layer_sot_tier():
     """full_graph=False on a Layer routes its forward through the SOT
-    bytecode tier (bound-method simulation)."""
+    bytecode tier. With trainable parameters and grads ENABLED the call
+    must fall back to eager (a replayed segment would return
+    stop_gradient=True outputs, silently severing autograd); under
+    no_grad the bound-method simulation captures."""
     paddle.seed(0)
 
     class M(paddle.nn.Layer):
@@ -210,9 +213,14 @@ def test_to_static_layer_sot_tier():
     np.testing.assert_allclose(out, ref, rtol=1e-5)
     st = m2.forward
     s = st.stats()
+    # grad mode + trainable params: recorded grad fallback, not capture
+    assert s["grad_fallbacks"] >= 1
+    # under no_grad capture proceeds (or breaks cleanly — never crashes)
+    with paddle.no_grad():
+        out2 = float(m2(x).numpy())
+    np.testing.assert_allclose(out2, ref, rtol=1e-5)
+    s = st.stats()
     assert s["simulations"] >= 1
-    # either captured (segments compiled) or clean eager fallback —
-    # NEVER a crash; with the bound-method path it should capture
     assert s["segments_compiled"] >= 1 or st._unsupported is not None
 
 
@@ -282,6 +290,101 @@ def test_tensors_nested_in_list_survive_mid_function_flush():
     # and again (exercises whatever plan the first call recorded)
     np.testing.assert_allclose(st(x).numpy(), [3.0, 6.0, 9.0],
                                rtol=1e-6)
+
+
+def test_grad_requiring_inputs_fall_back_to_eager():
+    """ADVICE-high correctness: a grad-carrying input must NOT flow
+    through a captured segment (its replay returns stop_gradient=True
+    outputs, silently severing autograd). The call runs eagerly, the
+    break reason is recorded, and backward works."""
+    def f(x):
+        return (x * 2.0).sum()
+
+    st = symbolic_translate(f)
+    x = _t([1.0, 2.0, 3.0])
+    x.stop_gradient = False
+    y = st(x)
+    assert y.stop_gradient is False        # tape survived
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0],
+                               rtol=1e-6)
+    s = st.stats()
+    assert s["grad_fallbacks"] >= 1
+    assert s["simulations"] == 0           # never even simulated
+    from paddle_tpu.jit import dy2static as d2s
+    assert any("GradFallback" in b["reason"]
+               for b in d2s.graph_break_report())
+    # and the registry counted it
+    from paddle_tpu import monitor
+    assert monitor.counter("sot_graph_breaks", labels=("reason",)) \
+        .labels(reason="grad_fallback").value() >= 1
+
+    # plain stop_gradient inputs still go through the capture tier
+    x2 = _t([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(st(x2).numpy(), 12.0, rtol=1e-6)
+    assert st.stats()["simulations"] == 1
+
+
+def test_trainable_layer_capture_falls_back_under_grad():
+    """A bound Layer method with trainable parameters is a grad
+    fallback while grads are enabled — gradients must reach the
+    parameters through the eager path."""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 2)
+    st = symbolic_translate(lin.forward)
+    x = _t(np.random.RandomState(0).randn(3, 4))
+    y = st(x)
+    loss = (y * y).sum()
+    loss.backward()
+    w = dict(lin.named_parameters())["weight"]
+    assert w.grad is not None              # autograd NOT severed
+    assert st.stats()["grad_fallbacks"] >= 1
+
+
+def test_param_version_bumps_on_step_and_mode_flip():
+    from paddle_tpu.framework.core import param_version
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    v0 = param_version()
+    lin.eval()
+    assert param_version() == v0 + 1
+    lin.train()
+    assert param_version() == v0 + 2
+    x = _t(np.random.RandomState(0).randn(3, 4))
+    out = lin(x)
+    (out * out).sum().backward()
+    opt.step()
+    assert param_version() == v0 + 3
+
+
+def test_param_version_invalidates_cached_segments():
+    """Optimizer steps / train-eval flips must invalidate cached
+    Layer-capturing segments: a replay after the weights changed has to
+    produce the NEW output, not the stale baked constants. (Skipped
+    where the bytecode VM cannot capture on this Python version — the
+    guard plumbing is then unreachable.)"""
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 2)
+    x = _t(np.random.RandomState(0).randn(3, 4))
+
+    with paddle.no_grad():
+        st = symbolic_translate(lin.forward)
+        out1 = st(x)
+        if st.stats()["segments_compiled"] == 0:
+            pytest.skip("bytecode VM does not capture on this "
+                        "Python version")
+        np.testing.assert_allclose(out1.numpy(), lin(x).numpy(),
+                                   rtol=1e-5)
+        # mutate weights the way TrainStep does, bump the version
+        from paddle_tpu.framework.core import bump_param_version
+        for _, p in lin.named_parameters():
+            p._data = p._data + 1.0
+        bump_param_version()
+        out2 = st(x)
+        np.testing.assert_allclose(out2.numpy(), lin(x).numpy(),
+                                   rtol=1e-5)
+        assert not np.allclose(out1.numpy(), out2.numpy())
 
 
 def test_simulator_errors_fall_back_to_eager():
