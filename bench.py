@@ -10,7 +10,9 @@ Headline value = the 8B-SHAPED config (hidden 4096 / ffn 14336 / 32
 heads / GQA 8 / seq 4096, AdamW fp32 master weights) — the per-layer
 shape of Llama-3-8B at the layer count that fits one chip's HBM.
 ``summary`` also covers the 500M base, the remat/depth regimes (16- and
-32-layer anchors), MoE capacity + dropless, and KV-cache decode. Every
+32-layer anchors), MoE capacity + dropless, KV-cache decode, and the
+continuous-batching serving engine (paged KV + ragged decode, aggregate
+tok/s + p50/p99 per-token latency, bf16 and int8). Every
 knob is env-tunable (BENCH_* vars). Training batches vary per step (a
 4-batch rotating pool), so reported losses are real training signal.
 """
@@ -466,6 +468,109 @@ def _decode_bench():
             "batch": batch, "prompt_len": prompt, "new_tokens": new}
 
 
+def _serving_bench():
+    """Continuous-batching serving throughput (the ISSUE-3 serving bar):
+    a mixed-length request workload through ``ServingEngine`` — paged
+    KV block pool, ragged decode attention, fixed-slot batched decode
+    compiled once — reported as aggregate tok/s + p50/p99 per-token
+    latency (a decode step IS one token for every active slot), against
+    a single-stream (batch-1) ``generate()`` baseline, bf16 and
+    weight-only int8 (fused mixed-dtype dot). ``recompiles_measured``
+    must be 0: the steady-state decode executable never changes."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.nn.quant import quantize_for_inference
+
+    # the decode-bench model shape, so serving aggregate tok/s compares
+    # directly against decode_tokens_per_sec
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_SERVE_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_SERVE_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_SERVE_FFN", 5632)),
+        num_hidden_layers=int(os.environ.get("BENCH_SERVE_LAYERS", 8)),
+        num_attention_heads=16,
+        num_key_value_heads=8, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    new = int(os.environ.get("BENCH_SERVE_NEW", 128))
+    n_req = int(os.environ.get("BENCH_SERVE_REQS", 24))
+    # mixed prompt lengths spanning prefill buckets + block boundaries
+    plens = [32, 64, 96, 160, 224, 128, 48, 192]
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (plens[i % len(plens)],))
+               for i in range(n_req)]
+
+    def run_engine(m):
+        eng = ServingEngine(m, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=512,
+            max_new_tokens=new, min_prefill_bucket=32))
+        # warmup: compile the decode step + every prefill bucket
+        eng.serve([rng.randint(1, cfg.vocab_size, (p,))
+                   for p in plens], max_new_tokens=4)
+        compiles0 = eng.stats()["decode_compiles"]
+        tokens0 = eng.stats()["tokens_total"]
+        for p in prompts:
+            eng.submit(p, new)
+        step_ms = []
+        t0 = time.perf_counter()
+        while eng.num_queued or eng.num_active:
+            s0 = time.perf_counter()
+            eng.step()
+            step_ms.append(1000 * (time.perf_counter() - s0))
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        lat = np.sort(np.asarray(step_ms))
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "p50_token_latency_ms": round(float(
+                lat[len(lat) // 2]), 2),
+            "p99_token_latency_ms": round(float(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 2),
+            "decode_steps": st["decode_steps"],
+            "recompiles_measured":
+                st["decode_compiles"] - compiles0,
+            "requests": n_req, "num_slots": slots,
+            "max_new_tokens": new,
+        }
+
+    # single-stream baseline: one sequence end-to-end at a time
+    ids1 = paddle.to_tensor(
+        rng.randint(1, cfg.vocab_size, (1, 128)).astype(np.int64))
+    for _ in range(2):
+        model.generate(ids1, max_new_tokens=new)
+    ss = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out, _ = model.generate(ids1, max_new_tokens=new)
+        _ = out.numpy()
+        ss.append(new / (time.perf_counter() - t0))
+    single = round(sorted(ss)[1], 1)
+
+    bf16 = run_engine(model)
+    n_conv = quantize_for_inference(model)
+    int8 = run_engine(model)
+    out = {
+        "single_stream_tokens_per_sec": single,
+        "bf16": bf16,
+        "int8": int8,
+        "int8_layers_converted": n_conv,
+        "batch_speedup_vs_single_stream": round(
+            bf16["aggregate_tokens_per_sec"] / max(single, 1e-9), 2),
+        "workload_prompt_lens": plens,
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -560,6 +665,10 @@ def main():
     except Exception as exc:
         decode = {"error": repr(exc)}
     try:
+        serving = _serving_bench()
+    except Exception as exc:
+        serving = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -569,6 +678,7 @@ def main():
               "deep32": deep32, "moe": moe,
               "moe_dropless": moe_dropless,
               "moe_profile": moe_profile, "decode": decode,
+              "serving": serving,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -584,10 +694,17 @@ def main():
         "summary": {
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
-            if k not in ("decode", "flashmask", "moe_profile")
+            if k not in ("decode", "serving", "flashmask",
+                         "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
+             "serving_tokens_per_sec":
+             serving.get("bf16", {}).get("aggregate_tokens_per_sec")
+             if isinstance(serving, dict) else None,
+             "serving_int8_tokens_per_sec":
+             serving.get("int8", {}).get("aggregate_tokens_per_sec")
+             if isinstance(serving, dict) else None,
              "flashmask_16k_block_skip_speedup":
              flashmask.get("block_skip_speedup")
              if isinstance(flashmask, dict) else None},

@@ -19,8 +19,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, as_jax, _wrap_out
+from .. import monitor as _monitor
 
 __all__ = ["GenerationConfig", "GenerationMixin", "LoadedGeneration", "load_generation"]
+
+# decode-loop compile-cache observability: varied prompt lengths should
+# HIT via the power-of-two bucketing below, not compile fresh
+# executables (the serving bar is zero steady-state recompiles)
+_gen_cache_events = _monitor.counter(
+    "generate_jit_cache", "generate() decode-loop compile-cache decisions",
+    labels=("model", "event"))
 
 
 @dataclass
@@ -39,6 +47,21 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: Optional[int] = None
     seed: Optional[int] = None
+    # dense | paged — paged decodes through the serving block-pool KV
+    # layout (ops/paged_cache.py + the ragged paged-attention kernel)
+    cache_impl: str = "dense"
+    kv_block_size: int = 16            # paged cache block size
+    # left-pad prompts up to power-of-two length buckets so varied
+    # prompt lengths reuse ONE compiled decode loop per bucket
+    pad_prompt_to_bucket: bool = True
+
+
+def _prompt_bucket(n: int, minimum: int = 8) -> int:
+    """Smallest power-of-two bucket >= n (floor ``minimum``)."""
+    b = int(minimum)
+    while b < n:
+        b *= 2
+    return b
 
 
 def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
@@ -88,6 +111,22 @@ class GenerationMixin:
                 f"prompt ({prompt_len}) + max_new_tokens ({max_new}) "
                 f"exceeds max_position_embeddings ({max_pos})")
 
+    def _bucket_eligible(self):
+        """Prompt bucketing rides the left-padded (mask + per-row rope)
+        path, so the model's forward must accept it; capacity-routed MoE
+        is excluded because pad tokens would compete for expert capacity
+        and perturb the real tokens' outputs."""
+        import inspect
+        sig = inspect.signature(type(self).forward).parameters
+        if "attention_mask" not in sig or "position_ids" not in sig:
+            return False
+        cfg = getattr(self, "config", None)
+        n_experts = getattr(cfg, "num_experts", 0) \
+            or getattr(cfg, "n_routed_experts", 0)   # DeepSeek naming
+        if n_experts and not getattr(cfg, "dropless", False):
+            return False
+        return True
+
     @staticmethod
     def _resolve_strategy(strategy):
         if strategy not in ("greedy_search", "sampling", "beam_search",
@@ -99,13 +138,19 @@ class GenerationMixin:
 
     def _build_model_step(self, binder, buffers):
         def model_step(params_a, tok_ids, caches, off, mask=None,
-                      pos=None):
+                      pos=None, block_tables=None, cache_lens=None):
             t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
-            kwargs = {"caches": t_caches, "offset": _wrap_out(off)}
+            kwargs = {"caches": t_caches}
+            if off is not None:
+                kwargs["offset"] = _wrap_out(off)
             if mask is not None:
                 kwargs["attention_mask"] = _wrap_out(mask)
             if pos is not None:
                 kwargs["position_ids"] = _wrap_out(pos)
+            if block_tables is not None:
+                # paged decode: caches are the shared (k_pool, v_pool)
+                kwargs["block_tables"] = _wrap_out(block_tables)
+                kwargs["cache_lens"] = _wrap_out(cache_lens)
             out, _ = binder.call(
                 params_a, buffers, (_wrap_out(tok_ids),), kwargs)
             logits, new_caches = out
@@ -173,6 +218,64 @@ class GenerationMixin:
             return state[3]
         return run
 
+    def _build_run_paged(self, binder, buffers, b, prompt_len, max_new,
+                         select, eos, pad, with_scores, block_size):
+        """Paged-KV twin of ``_build_run``: prefill goes through the
+        dense cached path (bit-identical numerics), its K/V scatter into
+        a block pool (contiguous static block tables — generate() owns
+        the whole pool, so no allocator), and the while-loop decodes
+        through the ragged paged-attention path. Exercises the exact
+        cache layout + kernels the serving engine runs, which is what
+        the paged-vs-dense parity tests pin down."""
+        from ..ops import paged_cache as _pc
+
+        model_step = self._build_model_step(binder, buffers)
+        mb = _pc.blocks_for(prompt_len + max_new, block_size)
+        tables_np = (1 + np.arange(b * mb, dtype=np.int32)) \
+            .reshape(b, mb)                    # block 0 stays null
+        num_blocks = 1 + b * mb
+
+        def run(params_a, ids_a, key):
+            tables = jnp.asarray(tables_np)
+            pools = self.init_paged_caches(num_blocks, block_size)
+            dense = self.init_caches(b, prompt_len)
+            logits, dense = model_step(params_a, ids_a, dense,
+                                       jnp.zeros((), jnp.int32))
+            pools = [_pc.write_prefill(kp, vp, tables, dk, dv)
+                     for (kp, vp), (dk, dv) in zip(pools, dense)]
+            key, sub = jax.random.split(key)
+            tok, logp = select(logits[:, -1, :], sub)
+            done = tok == eos
+            out = jnp.full((b, max_new), pad, jnp.int32)
+            out = out.at[:, 0].set(jnp.where(done, eos, tok))
+            score = logp
+
+            def cond(c):
+                return (c[0] < max_new) & jnp.logical_not(jnp.all(c[4]))
+
+            def body(c):
+                i, tok, pools, out, done, score, key = c
+                off = jnp.asarray(prompt_len - 1, jnp.int32) + i
+                lens = jnp.full((b,), off, jnp.int32)
+                logits, pools = model_step(params_a, tok[:, None], pools,
+                                           None, block_tables=tables,
+                                           cache_lens=lens)
+                key, sub = jax.random.split(key)
+                ntok, logp = select(logits[:, -1, :], sub)
+                ntok = jnp.where(done, jnp.int32(pad), ntok)
+                score = score + jnp.where(done, 0.0, logp)
+                out = jax.lax.dynamic_update_slice(
+                    out, ntok[:, None], (jnp.int32(0), i))
+                done = done | (ntok == eos)
+                return (i + 1, ntok, pools, out, done, score, key)
+
+            state = (jnp.int32(1), tok, pools, out, done, score, key)
+            state = jax.lax.while_loop(cond, body, state)
+            if with_scores:
+                return state[3], state[5]
+            return state[3]
+        return run
+
 
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  max_new_tokens=None, max_length=None,
@@ -181,6 +284,7 @@ class GenerationMixin:
                  diversity_rate=None, length_penalty=None,
                  early_stopping=None, eos_token_id=None,
                  pad_token_id=None, seed=None, attention_mask=None,
+                 cache_impl=None, pad_prompt_to_bucket=None,
                  **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
@@ -196,7 +300,8 @@ class GenerationMixin:
                 "(greedy_search|sampling|beam_search|group_beam_search), "
                 "temperature, top_k, top_p, num_beams, num_beam_groups, "
                 "diversity_rate, length_penalty, early_stopping, "
-                "eos_token_id, pad_token_id, seed")
+                "eos_token_id, pad_token_id, seed, cache_impl "
+                "(dense|paged), pad_prompt_to_bucket")
         cfg = generation_config or GenerationConfig()
         if max_length is not None and max_new_tokens is None:
             max_new_tokens = max_length  # PaddleNLP: length of generation
@@ -223,6 +328,13 @@ class GenerationMixin:
         seed = cfg.seed if seed is None else seed
         if seed is None:
             seed = int(np.random.randint(0, 2 ** 31 - 1))
+        cache_impl = cache_impl or getattr(cfg, "cache_impl", "dense")
+        if cache_impl not in ("dense", "paged"):
+            raise ValueError(
+                f"cache_impl {cache_impl!r}; supported: dense, paged")
+        if pad_prompt_to_bucket is None:
+            pad_prompt_to_bucket = getattr(cfg, "pad_prompt_to_bucket",
+                                           True)
 
         ids = as_jax(input_ids).astype(jnp.int32)
         if ids.ndim == 1:
@@ -284,6 +396,42 @@ class GenerationMixin:
                 f"num_beams={num_beams} requires decode_strategy="
                 "'beam_search' or 'group_beam_search' "
                 f"(got {strategy!r})")
+        if cache_impl == "paged":
+            if is_beam:
+                raise NotImplementedError(
+                    "cache_impl='paged' does not support beam search — "
+                    "use the dense cache")
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "cache_impl='paged' with left-padded prompts "
+                    "(attention_mask) — use the dense cache, or the "
+                    "serving engine (paddle_tpu.inference.ServingEngine)"
+                    " which prefills each prompt at its own length")
+            if not hasattr(self, "init_paged_caches"):
+                raise NotImplementedError(
+                    f"{type(self).__name__} does not implement "
+                    "init_paged_caches (paged-KV decode)")
+        # power-of-two prompt bucketing: left-pad the prompt (masked,
+        # per-row rope rebase — the proven padded path) so every prompt
+        # length in a bucket reuses ONE compiled decode loop; verify
+        # via the generate_jit_cache hit counters
+        import os as _os
+        if pad_prompt_to_bucket and not is_beam \
+                and cache_impl == "dense" \
+                and _os.environ.get("PADDLE_TPU_GENERATE_BUCKETS",
+                                    "1") != "0" \
+                and self._bucket_eligible():
+            pb = _prompt_bucket(prompt_len)
+            if pb != prompt_len:
+                padc = pb - prompt_len
+                ids = jnp.concatenate(
+                    [jnp.full((b, padc), pad, jnp.int32), ids], axis=1)
+                base = mask_np if attention_mask is not None \
+                    else np.ones((b, prompt_len), np.int64)
+                mask_np = np.concatenate(
+                    [np.zeros((b, padc), base.dtype), base], axis=1)
+                attention_mask = mask_np
+                prompt_len = pb
         if is_beam:
             from .beam import build_beam_run
             groups = num_beam_groups if strategy == "group_beam_search" \
@@ -303,19 +451,31 @@ class GenerationMixin:
             select = lambda lg, k: _select_token(
                 lg, k, do_sample=do_sample, temperature=temperature,
                 top_k=top_k, top_p=top_p)
-            run = self._build_run(binder, buffers, b, prompt_len, max_new,
-                                  select, eos, pad, with_scores=True,
-                                  with_mask=attention_mask is not None)
+            if cache_impl == "paged":
+                run = self._build_run_paged(
+                    binder, buffers, b, prompt_len, max_new, select,
+                    eos, pad, with_scores=True,
+                    block_size=int(getattr(cfg, "kv_block_size", 16)))
+            else:
+                run = self._build_run(binder, buffers, b, prompt_len,
+                                      max_new, select, eos, pad,
+                                      with_scores=True,
+                                      with_mask=attention_mask
+                                      is not None)
             jit_key = (b, prompt_len, max_new, do_sample, temperature,
                        top_k, top_p, eos, pad,
-                       attention_mask is not None)
+                       attention_mask is not None, cache_impl)
 
         if not hasattr(self, "_generate_jit_cache"):
             self._generate_jit_cache = {}
         jitted = self._generate_jit_cache.get(jit_key)
+        _label = type(self).__name__
         if jitted is None:
+            _gen_cache_events.labels(model=_label, event="miss").inc()
             jitted = jax.jit(run)
             self._generate_jit_cache[jit_key] = jitted
+        else:
+            _gen_cache_events.labels(model=_label, event="hit").inc()
         if attention_mask is not None:
             mask_arr = as_jax(attention_mask).astype(jnp.int32)
             out, score = jitted(params, ids, mask_arr,

@@ -20,7 +20,17 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "GenerationPredictor", "create_generation_predictor",
+           "ServingConfig", "ServingEngine", "ServingRequest",
            "PrecisionType", "PlaceType", "get_version"]
+
+
+def __getattr__(name):
+    # lazy: the serving engine pulls in jax/model machinery that plain
+    # Predictor users never need
+    if name in ("ServingConfig", "ServingEngine", "ServingRequest"):
+        from . import serving
+        return getattr(serving, name)
+    raise AttributeError(name)
 
 
 def get_version() -> str:

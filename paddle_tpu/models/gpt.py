@@ -57,9 +57,29 @@ class GPTAttention(Layer):
             input_is_parallel=True)
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x, kv_cache=None, offset=None):
+    def forward(self, x, kv_cache=None, offset=None, block_tables=None,
+                cache_lens=None):
         b, l, d = x.shape
         qkv = self.qkv_proj(x)
+
+        if kv_cache is not None and block_tables is not None:
+            # paged decode: kv_cache is the shared (k_pool, v_pool)
+            def attn_p(a, kp, vp, tables, lens):
+                from .llama import paged_attention_decode
+                q, k, v = jnp.split(a, 3, axis=-1)
+                qh = q.reshape(b, l, self.num_heads, self.head_dim)
+                kh = k.reshape(b, l, self.num_heads, self.head_dim)
+                vh = v.reshape(b, l, self.num_heads, self.head_dim)
+                out, kp2, vp2 = paged_attention_decode(
+                    qh, kh, vh, kp, vp, tables, lens, self.head_dim)
+                return out.reshape(b, l, d), kp2, vp2
+
+            ctx, kp2, vp2 = apply_jax("gpt_attention_paged", attn_p,
+                                      qkv, kv_cache[0], kv_cache[1],
+                                      block_tables, cache_lens,
+                                      n_outputs=3)
+            ctx = constraint(ctx, None, None, "mp")
+            return self.out_proj(ctx), (kp2, vp2)
 
         if kv_cache is not None:
             def attn_c(a, kc, vc, off):
@@ -107,10 +127,13 @@ class GPTDecoderLayer(Layer):
             input_is_parallel=True)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x, kv_cache=None, offset=None):
+    def forward(self, x, kv_cache=None, offset=None, block_tables=None,
+                cache_lens=None):
         new_cache = None
         if kv_cache is not None:
-            a, new_cache = self.attn(self.ln_1(x), kv_cache, offset)
+            a, new_cache = self.attn(self.ln_1(x), kv_cache, offset,
+                                     block_tables=block_tables,
+                                     cache_lens=cache_lens)
         else:
             a = self.attn(self.ln_1(x))
         x = x + self.dropout(a)
@@ -137,21 +160,30 @@ class GPTModel(Layer):
                               config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                offset=None):
+                offset=None, block_tables=None, cache_lens=None):
         input_ids = batch_shard(input_ids)
         l = input_ids.shape[1]
         if position_ids is None:
-            from ..ops.creation import arange
-            position_ids = arange(l, dtype="int64")
-            if offset is not None:
-                position_ids = position_ids + offset
+            if cache_lens is not None:
+                # paged decode: each slot sits at its own position
+                from ..framework.core import _wrap_out as _w
+                from ..framework.core import as_jax as _aj
+                position_ids = _w(
+                    _aj(cache_lens).astype(jnp.int32)[:, None])
+            else:
+                from ..ops.creation import arange
+                position_ids = arange(l, dtype="int64")
+                if offset is not None:
+                    position_ids = position_ids + offset
         h = self.embeddings(input_ids) + \
             self.position_embeddings(position_ids)
         h = self.dropout(h)
         if caches is not None:
             new_caches = []
             for layer, kv in zip(self.h, caches):
-                h, kv2 = layer(h, kv_cache=kv, offset=offset)
+                h, kv2 = layer(h, kv_cache=kv, offset=offset,
+                               block_tables=block_tables,
+                               cache_lens=cache_lens)
                 new_caches.append(kv2)
             return self.ln_f(h), new_caches
         for layer in self.h:
@@ -191,11 +223,26 @@ class GPTForCausalLM(Layer, GenerationMixin):
             for _ in range(cfg.num_hidden_layers)
         ]
 
-    def forward(self, input_ids, labels=None, caches=None, offset=None):
+    def init_paged_caches(self, num_blocks: int, block_size: int):
+        """Per-layer paged (k_pool, v_pool) for serving (MHA: kv head
+        count equals the query head count)."""
+        from ..ops.paged_cache import init_pool
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [
+            init_pool(num_blocks, block_size, cfg.num_attention_heads,
+                      head_dim, jnp.float32)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def forward(self, input_ids, labels=None, caches=None, offset=None,
+                block_tables=None, cache_lens=None):
         from ..ops.linalg import matmul
         if caches is not None:
             h, new_caches = self.gpt(input_ids, caches=caches,
-                                     offset=offset)
+                                     offset=offset,
+                                     block_tables=block_tables,
+                                     cache_lens=cache_lens)
             logits = matmul(h, self.gpt.embeddings.weight,
                             transpose_y=True)
             return logits, new_caches

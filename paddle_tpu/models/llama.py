@@ -142,6 +142,30 @@ def cached_attention(qh, kh, vh, kc, vc, off, head_dim,
     return out.reshape(b, l, h, d), kc2, vc2
 
 
+def paged_attention_decode(qh, kh, vh, k_pool, v_pool, block_tables,
+                           cache_lens, head_dim):
+    """Shared paged-KV decode step (Llama/GPT families): write this
+    token's K/V heads [S, 1, H_kv, D] into the shared block pool at each
+    slot's position ``cache_lens[s]``, then attend q against the slot's
+    length-bounded block list through the ragged paged kernel
+    (``ops/pallas/paged_attention.py``; gather fallback off-TPU).
+    Returns (out [S, 1, H, D], new_k_pool, new_v_pool)."""
+    if qh.shape[1] != 1:
+        raise ValueError(
+            f"paged attention is a decode step (one token per slot); "
+            f"got chunk length {qh.shape[1]} — prefill goes through the "
+            f"dense cached path + ops.paged_cache.write_prefill")
+    from ..ops.paged_cache import write_decode
+    from ..ops.pallas.paged_attention import paged_decode_attention
+    lens = cache_lens.astype(jnp.int32)
+    kp2, vp2 = write_decode(k_pool, v_pool, block_tables, lens,
+                            kh[:, 0], vh[:, 0])
+    out = paged_decode_attention(qh[:, 0], kp2, vp2, block_tables,
+                                 lens + 1,
+                                 sm_scale=1.0 / math.sqrt(head_dim))
+    return out[:, None], kp2, vp2
+
+
 def _rope_rotate(x, c, s):
     """Shared neox-halves rotation; c/s arrive pre-broadcast against
     [B, L, H, D/2]. Tables stay fp32 for precision; output is cast back
@@ -197,12 +221,17 @@ class LlamaAttention(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None):
+                position_ids=None, block_tables=None, cache_lens=None):
         b, l, _ = hidden_states.shape
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
 
+        if kv_cache is not None and block_tables is not None:
+            # paged decode: kv_cache is the shared (k_pool, v_pool)
+            return self._forward_paged(q, k, v, rope_cos, rope_sin,
+                                       kv_cache, block_tables,
+                                       cache_lens, b, l)
         if kv_cache is not None:
             # attention_mask here is the [B, S] cache-length pad mask
             # (left-padded batches); position_ids [B, L] give each row
@@ -238,6 +267,34 @@ class LlamaAttention(Layer):
                         rope_sin)
         ctx = constraint(ctx, None, None, "mp")
         return self.o_proj(ctx)
+
+    def _forward_paged(self, q, k, v, rope_cos, rope_sin, kv_cache,
+                       block_tables, cache_lens, b, l):
+        """Continuous-batching decode attention over the paged block
+        pool: per-slot rope positions come from ``cache_lens`` (each
+        slot sits at its own sequence position), the K/V write and the
+        ragged attention run through ``paged_attention_decode``."""
+
+        def attn_p(q_a, k_a, v_a, cos_t, sin_t, kp, vp, tables, lens):
+            qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
+            kh = k_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
+            pos = lens.astype(jnp.int32)[:, None]        # [S, 1]
+            cos = cos_t[pos]                             # [S, 1, D/2]
+            sin = sin_t[pos]
+            qh = _apply_rope_rows(qh, cos, sin)
+            kh = _apply_rope_rows(kh, cos, sin)
+            out, kp2, vp2 = paged_attention_decode(
+                qh, kh, vh, kp, vp, tables, lens, self.head_dim)
+            return (out.reshape(b, l, self.num_heads * self.head_dim),
+                    kp2, vp2)
+
+        ctx, kp2, vp2 = apply_jax(
+            "llama_attention_paged", attn_p, q, k, v, rope_cos, rope_sin,
+            kv_cache[0], kv_cache[1], block_tables, cache_lens,
+            n_outputs=3)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx), (kp2, vp2)
 
     def _forward_cached(self, q, k, v, rope_cos, rope_sin, kv_cache,
                         offset, b, l, attention_mask=None,
@@ -323,14 +380,16 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None):
+                position_ids=None, block_tables=None, cache_lens=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         new_cache = None
         if kv_cache is not None:
             h, new_cache = self.self_attn(h, rope_cos, rope_sin,
                                           attention_mask, kv_cache, offset,
-                                          position_ids=position_ids)
+                                          position_ids=position_ids,
+                                          block_tables=block_tables,
+                                          cache_lens=cache_lens)
         else:
             h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
             # tag for the "save_attn" selective remat policy: keep the
@@ -366,17 +425,22 @@ class LlamaModel(Layer):
         self._rope_sin = Tensor(sin)
 
     def forward(self, input_ids, attention_mask=None, position_ids=None,
-                caches=None, offset=None):
+                caches=None, offset=None, block_tables=None,
+                cache_lens=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
         if caches is not None:
             # decode path: full rope tables + per-layer kv caches
+            # (dense [B, S, H, D] pairs, or — with block_tables — the
+            # shared paged (k_pool, v_pool) per layer)
             cos, sin = self._rope_cos, self._rope_sin
             new_caches = []
             for layer, kv in zip(self.layers, caches):
                 h, kv2 = layer(h, cos, sin, attention_mask,
                                kv_cache=kv, offset=offset,
-                               position_ids=position_ids)
+                               position_ids=position_ids,
+                               block_tables=block_tables,
+                               cache_lens=cache_lens)
                 new_caches.append(kv2)
             return self.norm(h), new_caches
         l = h.shape[1]
@@ -441,11 +505,14 @@ class LlamaForCausalLM(Layer, GenerationMixin):
         self.criterion = LlamaPretrainingCriterion(config)
 
     def forward(self, input_ids, labels=None, attention_mask=None,
-                position_ids=None, caches=None, offset=None):
+                position_ids=None, caches=None, offset=None,
+                block_tables=None, cache_lens=None):
         if caches is not None:
             h, new_caches = self.llama(input_ids, attention_mask,
                                        position_ids, caches=caches,
-                                       offset=offset)
+                                       offset=offset,
+                                       block_tables=block_tables,
+                                       cache_lens=cache_lens)
             return self._head_and_loss(h, None), new_caches
         h = self.llama(input_ids, attention_mask, position_ids)
         return self._head_and_loss(h, labels)
@@ -460,6 +527,19 @@ class LlamaForCausalLM(Layer, GenerationMixin):
                         head_dim), dtype),
              jnp.zeros((batch_size, max_length, cfg.num_key_value_heads,
                         head_dim), dtype))
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
+    def init_paged_caches(self, num_blocks: int, block_size: int):
+        """Zeroed per-layer paged (k_pool, v_pool), each
+        [num_blocks, block_size, H_kv, D] — the shared serving cache
+        (block 0 is the null block; see ``ops/paged_cache.py``)."""
+        from ..ops.paged_cache import init_pool
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [
+            init_pool(num_blocks, block_size, cfg.num_key_value_heads,
+                      head_dim, jnp.dtype(cfg.dtype))
             for _ in range(cfg.num_hidden_layers)
         ]
 

@@ -7,8 +7,10 @@ the q/k/v projections (``qkv_bias=True``), a larger default rope theta
 (1e6), and tied embeddings on the small checkpoints. The TPU-first
 build shares the Llama module bodies (same GQA attention over the
 Pallas flash kernel, same RMSNorm/SwiGLU) and expresses the deltas as
-config, so the whole 4D-parallel + generation surface (pp pipe class
-included) carries over without re-implementation."""
+config, so the whole 4D-parallel + generation + serving surface (pp
+pipe class, paged-KV continuous-batching decode via
+``init_paged_caches``/``block_tables`` — see ``inference/serving.py``)
+carries over without re-implementation."""
 from __future__ import annotations
 
 from dataclasses import dataclass

@@ -57,7 +57,10 @@ def gauge(name, help="", labels=()) -> Gauge:
     return get_registry().gauge(name, help, labels)
 
 
-def histogram(name, help="", labels=()) -> Histogram:
+def histogram(name, help="", labels=(), buckets=None) -> Histogram:
+    if buckets is not None:
+        return get_registry().histogram(name, help, labels,
+                                        buckets=buckets)
     return get_registry().histogram(name, help, labels)
 
 

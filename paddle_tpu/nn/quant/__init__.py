@@ -61,8 +61,16 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
-    """y = x @ dequant(weight) + bias with the dequant fused into the
-    matmul operand read (no dense high-precision weight in HBM)."""
+    """y = x @ dequant(weight) + bias with the weight kept NARROW all
+    the way into the matmul: for int8 the weight operand feeds
+    ``lax.dot_general`` as int8 against the bf16/f16/f32 activations
+    (mixed-dtype dot, f32 accumulation via ``preferred_element_type``)
+    and the per-channel scale lands on the f32 product AFTER the
+    contraction. No widened weight array ever exists — not in HBM, not
+    in VMEM — which is the whole ceiling at decode batch sizes, where
+    the matmul is weight-bandwidth-bound. int4 has no mixed-dot
+    lowering, so it widens the operand in-register (the previous
+    recipe)."""
     if weight_scale is None:
         raise ValueError("weight_only_linear requires weight_scale")
 
@@ -73,8 +81,14 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
         # both defeats weight-only storage AND takes minutes at
         # compile time for a full model's worth of weights
         w_q = jax.lax.optimization_barrier(w_q)
-        y = jnp.matmul(x_a, w_q.astype(x_a.dtype))
-        y = y * s[None, :].astype(x_a.dtype)
+        if w_q.dtype == jnp.int8:
+            y = jax.lax.dot_general(
+                x_a, w_q, (((x_a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            y = (y * s[None, :]).astype(x_a.dtype)
+        else:                          # int4: widen on read
+            y = jnp.matmul(x_a, w_q.astype(x_a.dtype))
+            y = y * s[None, :].astype(x_a.dtype)
         if rest:
             y = y + rest[0].astype(x_a.dtype)
         return y

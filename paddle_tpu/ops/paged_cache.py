@@ -1,0 +1,138 @@
+"""Paged KV cache: block pool + per-slot block tables.
+
+The serving-side cache layout (reference: *Ragged Paged Attention*,
+arxiv 2604.15464, and vLLM's PagedAttention block tables): instead of
+one dense ``[B, S, H, D]`` cache per sequence, all sequences share one
+pool of fixed-size blocks ``[num_blocks, block_size, H_kv, D]`` and each
+serving slot owns an int32 row of block ids (its *block table*). A
+sequence of length ``n`` holds ``ceil(n / block_size)`` blocks; token
+position ``p`` lives at ``(table[p // block_size], p % block_size)``.
+
+Why this layout on TPU (arxiv 2603.09555: design the cache for the
+compiler's static-shape world): every array here is FIXED shape — the
+pool, the tables, the per-slot lengths — so one compiled decode step
+serves every mix of sequence lengths with zero recompiles; raggedness
+lives in the *values* of the tables/lengths, never in shapes. Block 0
+is reserved as the null block: retired/inactive slots point at it, so
+their (masked, discarded) reads and writes stay in-bounds without any
+dynamic shape or host-side branch.
+
+Device ops (pure jax, jit-safe) live here next to a host-side
+``BlockAllocator`` (plain free-list) that the serving scheduler uses to
+admit/retire slots. The ragged decode attention that READS this layout
+is ``ops/pallas/paged_attention.py``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
+           "write_prefill", "write_decode", "gather_dense"]
+
+# block id 0 is never allocated: inactive slots' tables point here, so
+# their scatter/gather indices stay valid while their data is garbage
+NULL_BLOCK = 0
+
+
+def blocks_for(n_tokens: int, block_size: int) -> int:
+    """Blocks needed to hold ``n_tokens`` cache positions."""
+    return -(-int(n_tokens) // int(block_size))
+
+
+class BlockAllocator:
+    """Host-side free-list over block ids ``1..num_blocks-1`` (block 0
+    is the reserved null block). The serving scheduler allocates at
+    admission/growth and frees at retirement; the device never sees
+    this object — only the int32 tables it fills in."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 null + 1 usable), got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # LIFO reuse keeps hot blocks hot in HBM-side caches
+        self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1):
+        """Pop ``n`` block ids; raises when the pool is exhausted (the
+        scheduler's admission reservation should make this unreachable
+        in steady state)."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"paged KV pool exhausted: want {n} blocks, "
+                f"{len(self._free)} free of {self.num_blocks - 1}")
+        out = self._free[-n:][::-1]
+        del self._free[-n:]
+        return out
+
+    def free(self, block_ids):
+        for b in block_ids:
+            b = int(b)
+            if not (NULL_BLOCK < b < self.num_blocks):
+                raise ValueError(f"freeing invalid block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+def init_pool(num_blocks: int, block_size: int, num_kv_heads: int,
+              head_dim: int, dtype) -> tuple:
+    """Zeroed (k_pool, v_pool), each [num_blocks, block_size, H_kv, D]."""
+    shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def write_prefill(k_pool, v_pool, block_tables, k_new, v_new,
+                  n_real=None):
+    """Scatter a dense prefill's K/V into the pool.
+
+    k_new/v_new: [B, P, H_kv, D] (the dense cached-prefill output for B
+    slots); block_tables: [B, MB] int32. Rows with position >= n_real
+    ([B] or scalar; default all P) are routed to the null block so a
+    right-padded prompt's garbage tail never lands in live blocks."""
+    b, p = k_new.shape[0], k_new.shape[1]
+    bs = k_pool.shape[1]
+    pos = jnp.arange(p, dtype=jnp.int32)                     # [P]
+    bi = jnp.take_along_axis(
+        block_tables.astype(jnp.int32),
+        jnp.broadcast_to(pos // bs, (b, p)), axis=1)         # [B, P]
+    if n_real is not None:
+        valid = pos[None, :] < jnp.reshape(
+            jnp.asarray(n_real, jnp.int32), (-1, 1))
+        bi = jnp.where(valid, bi, NULL_BLOCK)
+    off = jnp.broadcast_to(pos % bs, (b, p))                 # [B, P]
+    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def write_decode(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
+    """Write ONE token per slot at position ``cache_lens[s]``.
+
+    k_new/v_new: [S, H_kv, D]; block_tables: [S, MB]; cache_lens: [S]
+    (valid length BEFORE this token — i.e. the write position).
+    Inactive slots' tables hold the null block, so their writes are
+    harmless by construction."""
+    bs = k_pool.shape[1]
+    lens = cache_lens.astype(jnp.int32)
+    bi = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                             (lens // bs)[:, None], axis=1)[:, 0]  # [S]
+    off = lens % bs
+    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def gather_dense(pool, block_tables):
+    """[S, MB*BS, H_kv, D] dense view of each slot's cache (positions
+    beyond the slot's length read whatever the pooled blocks hold — the
+    caller masks by length). The jnp fallback attention and tests use
+    this; the TPU kernel never materializes it."""
+    s, mb = block_tables.shape
+    g = pool[block_tables.astype(jnp.int32)]    # [S, MB, BS, H, D]
+    return g.reshape(s, mb * pool.shape[1], pool.shape[2], pool.shape[3])

@@ -31,6 +31,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5;
+# support both so the kernels load on either line
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 # measured on v5e fwd+bwd with the GQA-native kernels: at [4, 2048,
 # 16/8, 64] (1024, 1024) 4.72 ms vs (512, 1024) 5.76 / (512, 512)
 # 6.32; at the 8B shape [2, 4096, 32/8, 64] (1024, 1024) also wins
@@ -182,7 +187,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, h, h_kv):
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )
@@ -320,7 +325,7 @@ def _bwd(scale, causal, block_q, block_k, h, h_kv, res, do):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )
@@ -363,7 +368,7 @@ def _bwd(scale, causal, block_q, block_k, h, h_kv, res, do):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )

@@ -34,7 +34,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention_kernel import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
-                                     NEG_INF, _block_sizes, _interpret,
+                                     NEG_INF, _block_sizes,
+                                     _CompilerParams, _interpret,
                                      _kv_row, disable_x64)
 
 
@@ -226,7 +227,7 @@ def _fm_fwd(q, k, v, start, end, scale, causal, block_q, block_k,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )
@@ -270,7 +271,7 @@ def _fm_bwd(scale, causal, block_q, block_k, h, h_kv, h_m, res, do):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )
@@ -320,7 +321,7 @@ def _fm_bwd(scale, causal, block_q, block_k, h, h_kv, h_m, res, do):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )

@@ -1,0 +1,237 @@
+"""Ragged paged decode attention for TPU.
+
+Reads the block-pool KV layout of ``ops/paged_cache.py`` for ONE query
+token per serving slot (the continuous-batching decode step). Reference
+pattern: *Ragged Paged Attention* (arxiv 2604.15464) — per-slot
+length-bounded iteration over the slot's block table, so compute and
+HBM traffic scale with each sequence's ACTUAL length while every array
+shape stays static.
+
+TPU path: a Pallas kernel gridded ``(slot, kv_head, block)`` with the
+block dimension innermost and sequential. The block tables and context
+lengths ride in as scalar-prefetch operands, so the K/V BlockSpec index
+maps chase the table — each grid step DMAs exactly the pooled block the
+slot owns (out-of-range steps fetch the null block and are predicated
+off with ``pl.when``, paying one dead DMA but no FLOPs). Online softmax
+state accumulates in VMEM scratch across block steps, flash-attention
+style. GQA is native: the kernel routes the ``rep = H / H_kv`` query
+heads of one kv group together and reads each K/V block once.
+
+Off TPU (or for kernel-ineligible shapes) the jnp fallback gathers the
+slot's blocks into a dense view and runs the same masked softmax — the
+numerics twin of ``models.llama.cached_attention``, so paged-vs-dense
+parity holds token-for-token on CPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["paged_decode_attention", "pallas_paged_attention"]
+
+NEG_INF = np.float32(-1e30)
+
+_FORCE_INTERPRET = False  # tests flip this to run the kernel on CPU
+
+
+def _interpret() -> bool:
+    if _FORCE_INTERPRET:
+        return True
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, block_size,
+                   n_blocks):
+    s = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = lens_ref[s]
+    # ragged bound: blocks at/after the slot's length hold no live
+    # tokens — predicate off their FLOPs entirely
+    @pl.when(j * block_size < ctx)
+    def _compute():
+        q = q_ref[0, 0]                       # [rep, D]
+        k = k_ref[0, :, 0, :]                 # [BS, D]
+        v = v_ref[0, :, 0, :]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [rep, BS]
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(cols < ctx, sc, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = alpha * acc_scr[:] + pv
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, np.float32(1.0), l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+try:  # pallas/tpu lowering may be absent on this jax build
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .flash_attention_kernel import _CompilerParams
+
+    def pallas_paged_attention(q, k_pool, v_pool, block_tables,
+                               context_lens, sm_scale=None,
+                               interpret=None):
+        """q: [S, H, D]; pools: [NB, BS, H_kv, D]; block_tables:
+        [S, MB] int32; context_lens: [S] int32 (valid positions per
+        slot, current token included). Returns [S, H, D]."""
+        s, h, d = q.shape
+        nb, bs, hkv, _ = k_pool.shape
+        mb = block_tables.shape[1]
+        rep = h // hkv
+        scale = np.float32(sm_scale if sm_scale is not None
+                           else 1.0 / math.sqrt(d))
+        q4 = q.reshape(s, hkv, rep, d)
+        kernel = functools.partial(
+            _decode_kernel, scale=scale, block_size=bs, n_blocks=mb)
+
+        def kv_block(si, g, j, tables, lens):
+            # chase the slot's block table; out-of-range grid steps read
+            # the null block (tables are null-filled past the slot's
+            # allocation) and are predicated off in the kernel
+            return (tables[si, j], 0, g, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s, hkv, mb),
+            in_specs=[
+                pl.BlockSpec((1, 1, rep, d),
+                             lambda si, g, j, tables, lens:
+                             (si, g, 0, 0)),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rep, d),
+                                   lambda si, g, j, tables, lens:
+                                   (si, g, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rep, 128), jnp.float32),
+                pltpu.VMEM((rep, 128), jnp.float32),
+                pltpu.VMEM((rep, d), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((s, hkv, rep, d), q.dtype),
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=_interpret() if interpret is None else interpret,
+        )(block_tables.astype(jnp.int32),
+          context_lens.astype(jnp.int32), q4, k_pool, v_pool)
+        return out.reshape(s, h, d)
+
+    _kernel_import_error = None
+except Exception as _e:  # pragma: no cover - environment dependent
+    pallas_paged_attention = None
+    _kernel_import_error = _e
+
+
+# ---------------------------------------------------------------------------
+# jnp fallback + dispatcher
+# ---------------------------------------------------------------------------
+
+def _xla_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                         sm_scale=None):
+    """Gather-based fallback: dense per-slot view of the pooled blocks,
+    masked by length. Mirrors ``cached_attention``'s dtype recipe
+    (f32 score accumulation, input-dtype PV contraction) so greedy
+    decode matches the dense path token-for-token."""
+    s, h, d = q.shape
+    hkv = k_pool.shape[2]
+    rep = h // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    from ..paged_cache import gather_dense
+    k = gather_dense(k_pool, block_tables)      # [S, L, Hkv, D]
+    v = gather_dense(v_pool, block_tables)
+    lens = context_lens.astype(jnp.int32)
+    q5 = q.reshape(s, hkv, rep, d)
+    scores = jnp.einsum(
+        "sgrd,slgd->sgrl", q5, k.astype(q.dtype),
+        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+    bias = jnp.where(pos[None, :] < lens[:, None], 0.0, -1e9)
+    scores = scores + bias[:, None, None, :]
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("sgrl,slgd->sgrd", w, v.astype(q.dtype))
+    return out.reshape(s, h, d)
+
+
+def _kernel_eligible(q, k_pool):
+    # block_size must be a whole number of sublane tiles for the pool
+    # dtype: 8 for f32, 16 for bf16/f16, 32 for int8/fp8
+    sublanes = 32 // max(jnp.dtype(k_pool.dtype).itemsize, 1)
+    return (q.shape[-1] in (64, 128, 256)
+            and k_pool.shape[1] % sublanes == 0
+            and q.shape[1] % k_pool.shape[2] == 0)
+
+
+_fallback_logged = False
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           sm_scale=None):
+    """Ragged paged decode attention; q: [S, H, D] (one token per slot).
+    Routes to the Pallas kernel on TPU, the gather fallback elsewhere."""
+    use_kernel = False
+    try:
+        use_kernel = jax.default_backend() == "tpu" \
+            and pallas_paged_attention is not None \
+            and _kernel_eligible(q, k_pool)
+    except Exception:
+        use_kernel = False
+    if jax.default_backend() == "tpu" and not use_kernel:
+        global _fallback_logged
+        if not _fallback_logged:
+            _fallback_logged = True
+            import warnings
+            if pallas_paged_attention is None:
+                reason = "kernel unavailable on this jax build (%r)" \
+                    % (_kernel_import_error,)
+            else:
+                reason = ("shape %s / pool %s not kernel-eligible "
+                          "(head_dim must be 64/128/256, block_size a "
+                          "sublane-tile multiple for the pool dtype)"
+                          % (tuple(q.shape), tuple(k_pool.shape)))
+            warnings.warn("paged_decode_attention: %s; using the "
+                          "gather fallback" % reason)
+    if use_kernel:
+        return pallas_paged_attention(q, k_pool, v_pool, block_tables,
+                                      context_lens, sm_scale=sm_scale)
+    return _xla_paged_attention(q, k_pool, v_pool, block_tables,
+                                context_lens, sm_scale=sm_scale)
