@@ -571,6 +571,109 @@ def _serving_bench():
     return out
 
 
+def _spec_serving_bench():
+    """Speculative serving throughput (the ISSUE-4 bar): a mixed-length
+    REPETITIVE-text workload (tiled phrases — the prompt-lookup regime:
+    code, quotes, retrieval) through ``ServingEngine`` at gamma in
+    {2, 4}, n-gram and draft-model drafters, against the PR-3
+    single-token serving baseline on the SAME workload and model.
+    Reports aggregate tok/s, mean accepted length (emitted tokens per
+    verify window — the >1.0 bar), acceptance rate, and
+    ``recompiles_measured`` (must be 0: one verify executable serves
+    every accept/reject mix)."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_SPEC_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_SPEC_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_SPEC_FFN", 5632)),
+        num_hidden_layers=int(os.environ.get("BENCH_SPEC_LAYERS", 8)),
+        num_attention_heads=16,
+        num_key_value_heads=8, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+    # 2-layer draft at a quarter the width — the "small compatible
+    # model drafting for a larger one" mode (same vocab)
+    dcfg = LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size // 4,
+        intermediate_size=cfg.intermediate_size // 4,
+        num_hidden_layers=2, num_attention_heads=8,
+        num_key_value_heads=4, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(1)
+    draft = LlamaForCausalLM(dcfg)
+    draft.to(dtype="bfloat16")
+    draft.eval()
+
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", 8))
+    new = int(os.environ.get("BENCH_SPEC_NEW", 64))
+    n_req = int(os.environ.get("BENCH_SPEC_REQS", 16))
+    plens = [32, 64, 96, 160, 224, 128, 48, 192]
+    rng = np.random.RandomState(0)
+
+    def rep_prompt(n):
+        phrase = rng.randint(1, cfg.vocab_size, (8,))
+        return np.tile(phrase, n // 8)
+
+    prompts = [rep_prompt(plens[i % len(plens)]) for i in range(n_req)]
+
+    def run_engine(gamma, drafter="ngram", dm=None):
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=512,
+            max_new_tokens=new, min_prefill_bucket=32,
+            num_speculative_tokens=gamma, drafter=drafter),
+            draft_model=dm)
+        # warmup: compile the verify/decode step + prefill buckets
+        eng.serve([rep_prompt(p) for p in plens], max_new_tokens=4)
+        compiles0 = eng.stats()["decode_compiles"]
+        tokens0 = eng.stats()["tokens_total"]
+        steps0 = eng.stats()["decode_steps"]
+        for p in prompts:
+            eng.submit(p, new)
+        t0 = time.perf_counter()
+        while eng.num_queued or eng.num_active:
+            eng.step()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        out = {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "decode_steps": st["decode_steps"] - steps0,
+            "recompiles_measured": st["decode_compiles"] - compiles0,
+        }
+        if gamma:
+            out["mean_accepted_len"] = round(
+                st["spec_mean_accepted_len"], 3)
+            out["acceptance_rate"] = round(
+                st["spec_acceptance_rate"], 4)
+        return out
+
+    base = run_engine(0)
+    results = {
+        "baseline_single_token": base,
+        "num_slots": slots, "max_new_tokens": new,
+        "requests": n_req, "workload_prompt_lens": plens,
+    }
+    for gamma in (2, 4):
+        for name, drafter, dm in ((f"ngram_g{gamma}", "ngram", None),
+                                  (f"draft_model_g{gamma}", "model",
+                                   draft)):
+            r = run_engine(gamma, drafter, dm)
+            r["speedup_vs_single_token"] = round(
+                r["aggregate_tokens_per_sec"]
+                / max(base["aggregate_tokens_per_sec"], 1e-9), 3)
+            results[name] = r
+    del model, draft
+    gc.collect()
+    return results
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -669,6 +772,10 @@ def main():
     except Exception as exc:
         serving = {"error": repr(exc)}
     try:
+        speculative = _spec_serving_bench()
+    except Exception as exc:
+        speculative = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -679,6 +786,7 @@ def main():
               "moe_dropless": moe_dropless,
               "moe_profile": moe_profile, "decode": decode,
               "serving": serving,
+              "speculative": speculative,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -694,8 +802,8 @@ def main():
         "summary": {
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
-            if k not in ("decode", "serving", "flashmask",
-                         "moe_profile")
+            if k not in ("decode", "serving", "speculative",
+                         "flashmask", "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -705,6 +813,13 @@ def main():
              "serving_int8_tokens_per_sec":
              serving.get("int8", {}).get("aggregate_tokens_per_sec")
              if isinstance(serving, dict) else None,
+             "spec_serving_tokens_per_sec":
+             speculative.get("ngram_g4", {}).get(
+                 "aggregate_tokens_per_sec")
+             if isinstance(speculative, dict) else None,
+             "spec_mean_accepted_len":
+             speculative.get("ngram_g4", {}).get("mean_accepted_len")
+             if isinstance(speculative, dict) else None,
              "flashmask_16k_block_skip_speedup":
              flashmask.get("block_skip_speedup")
              if isinstance(flashmask, dict) else None},

@@ -54,6 +54,14 @@ class GenerationConfig:
     # left-pad prompts up to power-of-two length buckets so varied
     # prompt lengths reuse ONE compiled decode loop per bucket
     pad_prompt_to_bucket: bool = True
+    # speculative decoding (gamma > 0): draft gamma tokens per step and
+    # verify them in one multi-token paged forward, emitting 1..gamma+1
+    # tokens. 0 = off. Rides the paged cache; see
+    # ``generation/speculative.py`` + docs/OPS.md "Speculative
+    # decoding". Kill switch: PADDLE_TPU_SPECULATIVE=0.
+    num_speculative_tokens: int = 0
+    # longest suffix n-gram the model-free prompt-lookup drafter matches
+    spec_ngram_max: int = 3
 
 
 def _prompt_bucket(n: int, minimum: int = 8) -> int:
@@ -64,8 +72,11 @@ def _prompt_bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
-def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
-    """(token, logprob-of-token) for one step. logits: [B, V]."""
+def _filter_logits(logits, *, do_sample, temperature, top_k, top_p):
+    """The temperature/top-k/top-p logits pipeline, factored out so the
+    speculative verify step can apply the SAME modification to draft
+    and target logits (the rejection-sampling soundness requirement).
+    Works on any [..., V] shape; returns f32 filtered logits."""
     logits = logits.astype(jnp.float32)
     if temperature != 1.0 and do_sample:
         logits = logits / max(temperature, 1e-6)
@@ -82,6 +93,14 @@ def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
         kept = jnp.where(drop, jnp.inf, sorted_logits)
         thresh = jnp.min(kept, axis=-1, keepdims=True)
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
+def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
+    """(token, logprob-of-token) for one step. logits: [B, V]."""
+    logits = _filter_logits(logits, do_sample=do_sample,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p)
     logp = jax.nn.log_softmax(logits, axis=-1)
     if do_sample:
         tok = jax.random.categorical(key, logits)
@@ -285,7 +304,8 @@ class GenerationMixin:
                  early_stopping=None, eos_token_id=None,
                  pad_token_id=None, seed=None, attention_mask=None,
                  cache_impl=None, pad_prompt_to_bucket=None,
-                 **kwargs):
+                 num_speculative_tokens=None, draft_model=None,
+                 spec_ngram_max=None, **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
         log-probability of the chosen tokens per sequence (for beam
@@ -301,7 +321,8 @@ class GenerationMixin:
                 "temperature, top_k, top_p, num_beams, num_beam_groups, "
                 "diversity_rate, length_penalty, early_stopping, "
                 "eos_token_id, pad_token_id, seed, cache_impl "
-                "(dense|paged), pad_prompt_to_bucket")
+                "(dense|paged), pad_prompt_to_bucket, "
+                "num_speculative_tokens, draft_model, spec_ngram_max")
         cfg = generation_config or GenerationConfig()
         if max_length is not None and max_new_tokens is None:
             max_new_tokens = max_length  # PaddleNLP: length of generation
@@ -328,6 +349,7 @@ class GenerationMixin:
         seed = cfg.seed if seed is None else seed
         if seed is None:
             seed = int(np.random.randint(0, 2 ** 31 - 1))
+        _explicit_cache_impl = cache_impl     # None unless caller-passed
         cache_impl = cache_impl or getattr(cfg, "cache_impl", "dense")
         if cache_impl not in ("dense", "paged"):
             raise ValueError(
@@ -411,6 +433,74 @@ class GenerationMixin:
                 raise NotImplementedError(
                     f"{type(self).__name__} does not implement "
                     "init_paged_caches (paged-KV decode)")
+        # -- speculative decoding (rides the paged cache) -------------
+        from .speculative import (SpecGenerator, draft_exclusion_reason,
+                                  spec_exclusion_reason,
+                                  speculative_enabled)
+        gamma = int(cfg.num_speculative_tokens
+                    if num_speculative_tokens is None
+                    else num_speculative_tokens)
+        if gamma < 0:
+            raise ValueError(
+                f"num_speculative_tokens must be >= 0, got {gamma}")
+        if draft_model is not None and gamma == 0:
+            raise ValueError(
+                "draft_model requires num_speculative_tokens > 0")
+        if not speculative_enabled():        # PADDLE_TPU_SPECULATIVE=0
+            gamma = 0
+            draft_model = None
+        if gamma:
+            if is_beam:
+                raise NotImplementedError(
+                    "speculative decoding does not support beam search")
+            if attention_mask is not None:
+                raise NotImplementedError(
+                    "speculative decoding with left-padded prompts "
+                    "(attention_mask) — pad to equal length, or use "
+                    "the serving engine")
+            if _explicit_cache_impl == "dense":
+                # same policy as the other inapplicable-option guards:
+                # the speculative loop RIDES the paged layout, so an
+                # explicit dense-cache request cannot be honored
+                raise ValueError(
+                    "num_speculative_tokens requires the paged cache; "
+                    "it cannot run with an explicit cache_impl='dense'")
+            reason = spec_exclusion_reason(self)
+            if reason is None and draft_model is not None:
+                reason = draft_exclusion_reason(self, draft_model)
+            if reason is not None:
+                raise NotImplementedError(
+                    f"speculative decoding unavailable: {reason}")
+            # speculated positions may overhang the final token by
+            # up to gamma — they need rope/position-table room too
+            self._check_lengths(prompt_len, max_new + gamma)
+            ngram_max = int(cfg.spec_ngram_max if spec_ngram_max
+                            is None else spec_ngram_max)
+            if not hasattr(self, "_generate_jit_cache"):
+                self._generate_jit_cache = {}
+            jit_key = ("spec", b, prompt_len, max_new, gamma,
+                       do_sample, temperature, top_k, top_p, eos, pad,
+                       id(draft_model) if draft_model is not None
+                       else None, ngram_max,
+                       int(getattr(cfg, "kv_block_size", 16)))
+            runner = self._generate_jit_cache.get(jit_key)
+            _label = type(self).__name__
+            if runner is None:
+                _gen_cache_events.labels(model=_label,
+                                         event="miss").inc()
+                runner = SpecGenerator(
+                    self, binder, buffers, b, prompt_len, max_new,
+                    gamma, do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p, eos=eos, pad=pad,
+                    block_size=int(getattr(cfg, "kv_block_size", 16)),
+                    draft_model=draft_model, ngram_max=ngram_max)
+                self._generate_jit_cache[jit_key] = runner
+            else:
+                _gen_cache_events.labels(model=_label,
+                                         event="hit").inc()
+            out, score = runner.run(params, ids, seed)
+            return (_wrap_out(jnp.asarray(out)),
+                    _wrap_out(jnp.asarray(score)))
         # power-of-two prompt bucketing: left-pad the prompt (masked,
         # per-row rope rebase — the proven padded path) so every prompt
         # length in a bucket reuses ONE compiled decode loop; verify

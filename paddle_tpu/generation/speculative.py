@@ -1,0 +1,447 @@
+"""Speculative decoding over the paged KV cache.
+
+Breaks decode's 1:1 target-forward-per-token ratio: a cheap drafter
+proposes ``gamma`` tokens and the target model verifies the whole
+window in ONE batched multi-query forward (the ragged-paged-attention
+verify kernel, ``ops/pallas/paged_attention.py``), emitting 1 to
+``gamma + 1`` tokens per step. Two drafters:
+
+- **n-gram / prompt-lookup** (``ngram_propose``, the zero-extra-weights
+  default): propose the continuation of the most recent earlier
+  occurrence of the current suffix n-gram — free on repetitive text
+  (code, retrieval, summarization quotes).
+- **draft model** (``build_draft_loop``): any smaller paged-KV-capable
+  causal LM free-runs ``gamma`` single-token steps inside one compiled
+  ``lax.scan``; its cache shares the target's block tables, so
+  rollback is the same O(1) length decrement.
+
+Acceptance (``build_verify_step``):
+
+- greedy: accept while the draft token equals the target argmax —
+  emitted tokens are BY CONSTRUCTION the target's own greedy chain, so
+  speculative greedy is token-exact vs plain ``generate()``.
+- sampling: standard speculative rejection sampling (Leviathan et al.;
+  Chen et al.) — accept draft ``d_i`` w.p. ``min(1, p(d_i)/q(d_i))``,
+  on rejection resample from ``normalize(max(p - q, 0))``. Both ``p``
+  and ``q`` run through the SAME ``_filter_logits``
+  temperature/top-k/top-p pipeline as non-speculative sampling, which
+  is exactly the condition under which the scheme provably preserves
+  the (modified) target distribution. The n-gram drafter is the
+  degenerate one-hot ``q``.
+
+Everything here is fixed-shape: the verify window is always
+``gamma + 1`` tokens, rejected tokens are rolled back by decrementing
+length bookkeeping (``ops/paged_cache.write_tokens`` docstring), so
+one compiled verify executable serves every accept/reject mix — the
+zero-steady-state-recompile bar of the serving engine extends to
+speculative mode unchanged. Kill switch: ``PADDLE_TPU_SPECULATIVE=0``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["speculative_enabled", "ngram_propose", "spec_exclusion_reason",
+           "draft_exclusion_reason", "build_verify_step",
+           "build_draft_loop", "SpecGenerator"]
+
+
+def speculative_enabled() -> bool:
+    """Kill switch: ``PADDLE_TPU_SPECULATIVE=0`` disables speculative
+    decoding everywhere (generate() and the serving engine fall back to
+    plain single-token decode)."""
+    return os.environ.get("PADDLE_TPU_SPECULATIVE", "1") != "0"
+
+
+def spec_exclusion_reason(model) -> Optional[str]:
+    """Why speculative decoding cannot run for ``model`` (None = it
+    can). Capacity-routed MoE is excluded for the prompt-bucketing
+    reason of PR 3: the gamma+1 window tokens would compete with each
+    other for expert capacity, so the verify logits would differ from
+    sequential decode and acceptance would be unsound."""
+    if not hasattr(model, "init_paged_caches"):
+        return (f"{type(model).__name__} does not implement "
+                "init_paged_caches (paged-KV decode)")
+    cfg = getattr(model, "config", None)
+    n_experts = getattr(cfg, "num_experts", 0) \
+        or getattr(cfg, "n_routed_experts", 0)   # DeepSeek naming
+    if n_experts and not getattr(cfg, "dropless", False):
+        return ("capacity-routed MoE: window tokens would compete for "
+                "expert capacity, changing logits vs sequential decode")
+    return None
+
+
+def draft_exclusion_reason(target, draft) -> Optional[str]:
+    """Why ``draft`` cannot draft for ``target`` (None = it can) —
+    the shared gate of ``generate(draft_model=...)`` and
+    ``ServingEngine(draft_model=...)``."""
+    reason = spec_exclusion_reason(draft)
+    if reason is not None:
+        return reason
+    dv = getattr(getattr(draft, "config", None), "vocab_size", None)
+    tv = getattr(getattr(target, "config", None), "vocab_size", None)
+    if dv is not None and tv is not None and dv != tv:
+        return f"draft vocab ({dv}) != target vocab ({tv})"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# drafters
+# ---------------------------------------------------------------------------
+
+def ngram_propose(history, gamma: int, max_ngram: int = 3) -> List[int]:
+    """Model-free prompt-lookup drafter: find the most recent earlier
+    occurrence of the longest suffix n-gram (n <= ``max_ngram``) of
+    ``history`` (prompt + everything emitted) and propose the ``gamma``
+    tokens that followed it; pad short continuations by repeating the
+    last proposal, and fall back to repeating the last history token
+    when nothing matches. Deterministic, host-side, O(len * max_ngram)."""
+    n = len(history)
+    g = int(gamma)
+    for k in range(min(int(max_ngram), n - 1), 0, -1):
+        suf = history[n - k:]
+        for start in range(n - k - 1, -1, -1):
+            if history[start:start + k] == suf:
+                out = list(history[start + k: start + k + g])
+                while len(out) < g:
+                    out.append(out[-1])
+                return out
+    return [history[-1]] * g
+
+
+def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
+                     top_k, top_p, want_probs):
+    """Compiled draft proposal loop: ``gamma + 1`` single-token decode
+    steps of the draft model inside one ``lax.scan`` (the extra step
+    emits nothing — it writes the last draft token's K/V so a fully
+    accepted window leaves the draft cache gap-free and the next
+    proposal starts exactly at the target's new length).
+
+    Returns ``loop(dparams, dpools, tables, lens, cur, key) ->
+    (proposals [S, gamma], q_probs [S, gamma, V] | None, dpools)``.
+    ``q_probs`` are the draft distributions AFTER the shared
+    temperature/top-k/top-p pipeline (``want_probs`` — sampling mode
+    needs them for rejection sampling; greedy verifies by token id
+    only)."""
+    from . import _filter_logits
+
+    def loop(dparams, dpools, tables, lens, cur, key):
+        def body(carry, _):
+            tok, pools, l, k = carry
+            logits, pools = draft_step(dparams, tok[:, None], pools,
+                                       None, block_tables=tables,
+                                       cache_lens=l)
+            f = _filter_logits(logits[:, -1, :], do_sample=do_sample,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p)
+            k, sub = jax.random.split(k)
+            if do_sample:
+                nt = jax.random.categorical(sub, f).astype(jnp.int32)
+            else:
+                nt = jnp.argmax(f, axis=-1).astype(jnp.int32)
+            q = jax.nn.softmax(f, axis=-1) if want_probs \
+                else jnp.zeros((f.shape[0], 0), jnp.float32)
+            return (nt, pools, l + 1, k), (nt, q)
+
+        init = (cur.astype(jnp.int32), dpools,
+                lens.astype(jnp.int32), key)
+        (_, dpools, _, _), (props, qp) = jax.lax.scan(
+            body, init, None, length=gamma + 1)
+        props = jnp.swapaxes(props[:gamma], 0, 1)        # [S, gamma]
+        qp = jnp.swapaxes(qp[:gamma], 0, 1) if want_probs else None
+        return props, qp, dpools
+
+    return loop
+
+
+# ---------------------------------------------------------------------------
+# verify step
+# ---------------------------------------------------------------------------
+
+def build_verify_step(model_step, *, gamma, do_sample, temperature,
+                      top_k, top_p, onehot_draft=True):
+    """Build the fixed-gamma multi-token verify step.
+
+    The returned function runs ONE target forward over the window
+    ``toks = [cur, d_1..d_gamma]`` (shapes [S, gamma+1], K/V written at
+    ``lens + t`` through the paged path) and returns
+
+    ``(out [S, gamma+1], accept [S, gamma], logp [S, gamma+1], pools)``
+
+    where ``accept[s, i]`` says draft ``d_{i+1}`` was accepted and
+    ``out[s, t]`` is the token the sequence continues with after ``t``
+    accepted drafts — so the host emits exactly
+    ``out[s, :n_accepted + 1]`` (the last one is the rejection
+    correction, or the free bonus token when everything was accepted)
+    and ``logp`` rides along for generate()'s score output.
+
+    Greedy (``do_sample=False``): ``out`` is the target argmax chain —
+    signature ``verify(params, pools, tables, lens, toks)`` (no
+    randomness). Sampling: rejection sampling against the draft
+    distribution — one-hot of ``toks[:, 1:]`` when ``onehot_draft``
+    (n-gram drafter), else the explicit ``dq`` operand — signature
+    ``verify(params, pools, tables, lens, toks[, dq], key)``."""
+    from . import _filter_logits
+
+    def _target(params, pools, tables, lens, toks):
+        logits, pools = model_step(params, toks, pools, None,
+                                   block_tables=tables,
+                                   cache_lens=lens)
+        f = _filter_logits(logits, do_sample=do_sample,
+                           temperature=temperature, top_k=top_k,
+                           top_p=top_p)                 # [S, G+1, V]
+        return f, pools
+
+    if not do_sample:
+        def verify(params, pools, tables, lens, toks):
+            f, pools = _target(params, pools, tables, lens, toks)
+            logp = jax.nn.log_softmax(f, axis=-1)
+            out = jnp.argmax(f, axis=-1).astype(jnp.int32)
+            accept = out[:, :-1] == toks[:, 1:]
+            picked = jnp.take_along_axis(
+                logp, out[..., None], axis=-1)[..., 0]
+            return out, accept, picked, pools
+        return verify
+
+    if onehot_draft:
+        def verify(params, pools, tables, lens, toks, key):
+            return _sample_accept(params, pools, tables, lens, toks,
+                                  None, key)
+    else:
+        def verify(params, pools, tables, lens, toks, dq, key):
+            return _sample_accept(params, pools, tables, lens, toks,
+                                  dq, key)
+
+    def _sample_accept(params, pools, tables, lens, toks, dq, key):
+        f, pools = _target(params, pools, tables, lens, toks)
+        p = jax.nn.softmax(f, axis=-1)                  # [S, G+1, V]
+        s, _, v = p.shape
+        d = toks[:, 1:].astype(jnp.int32)               # [S, G]
+        pd = jnp.take_along_axis(
+            p[:, :gamma], d[..., None], axis=-1)[..., 0]
+        if dq is None:
+            # one-hot draft: q(d_i) = 1, residual = p with d_i removed
+            qd = jnp.ones_like(pd)
+            hit = jax.lax.broadcasted_iota(
+                jnp.int32, (s, gamma, v), 2) == d[..., None]
+            res = jnp.where(hit, 0.0, p[:, :gamma])
+        else:
+            qd = jnp.take_along_axis(dq, d[..., None], axis=-1)[..., 0]
+            res = jnp.maximum(p[:, :gamma] - dq, 0.0)
+        ku, kr, kb = jax.random.split(key, 3)
+        u = jax.random.uniform(ku, (s, gamma))
+        accept = u * qd < pd            # u < p/q without dividing by 0
+        rs = jnp.sum(res, axis=-1, keepdims=True)
+        # degenerate residual (q == p exactly): resample from p
+        res = jnp.where(rs > 0.0, res / jnp.maximum(rs, 1e-37),
+                        p[:, :gamma])
+        rtok = jax.random.categorical(
+            kr, jnp.log(jnp.maximum(res, 1e-37))
+            + jnp.where(res > 0.0, 0.0, -jnp.inf)).astype(jnp.int32)
+        bonus = jax.random.categorical(kb, f[:, gamma]) \
+            .astype(jnp.int32)
+        out = jnp.concatenate(
+            [jnp.where(accept, d, rtok), bonus[:, None]], axis=1)
+        logp = jax.nn.log_softmax(f, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, out[..., None], axis=-1)[..., 0]
+        return out, accept, picked, pools
+
+    return verify
+
+
+def leading_accepts(accept_row) -> int:
+    """Number of leading True in one slot's accept vector (the
+    accepted draft count; the step then emits that many + 1 tokens)."""
+    n = 0
+    for a in accept_row:
+        if not a:
+            break
+        n += 1
+    return n
+
+
+def commit_window(out_row, accept_row, room: int, eos: int):
+    """Shared host-side window commit (``SpecGenerator.run`` AND the
+    serving engine's ``_step_spec`` — one implementation so the two
+    entry points can never diverge on the same token stream): from one
+    slot's verify outputs, the tokens to emit this step and the
+    accepted-draft count.
+
+    Emits ``out_row[:n_acc + 1]`` truncated to ``room`` remaining
+    tokens and cut after an EOS found anywhere inside the window.
+    Returns ``(kept, n_acc)``; ``kept`` is non-empty (``room >= 1`` for
+    any live slot/row). The caller commits ``cache_len += n_acc + 1``
+    only when the window was NOT truncated (truncation always
+    retires/freezes the sequence, so its cache state is moot)."""
+    n_acc = leading_accepts(accept_row)
+    kept = []
+    for tok in out_row[:n_acc + 1][:room]:
+        kept.append(int(tok))
+        if int(tok) == eos:
+            break
+    return kept, n_acc
+
+
+# ---------------------------------------------------------------------------
+# generate()-level driver
+# ---------------------------------------------------------------------------
+
+class SpecGenerator:
+    """Compiled-step bundle + host acceptance loop behind
+    ``generate(num_speculative_tokens=gamma)``.
+
+    Same paged layout as ``_build_run_paged`` (generate() owns the
+    whole pool, contiguous static block tables, prefill through the
+    dense cached path scattered into the blocks) but the decode loop is
+    host-driven: every iteration drafts gamma tokens (n-gram host-side,
+    or the compiled draft-model scan), verifies the window in one
+    fixed-shape compiled forward, and commits 1..gamma+1 tokens by
+    advancing per-row lengths — rejection rollback IS the non-advance.
+    All device steps are shape-stable, so each compiles exactly once
+    and is cached on the model across generate() calls."""
+
+    def __init__(self, model, binder, buffers, b, prompt_len, max_new,
+                 gamma, *, do_sample, temperature, top_k, top_p, eos,
+                 pad, block_size, draft_model=None, ngram_max=3):
+        from ..ops import paged_cache as _pc
+        from . import _select_token
+
+        self.b, self.max_new, self.gamma = b, int(max_new), int(gamma)
+        self.eos, self.pad = int(eos), int(pad)
+        self.do_sample = do_sample
+        self.ngram_max = int(ngram_max)
+        self.prompt_len = prompt_len
+        self._draft_model = draft_model
+
+        # +gamma headroom: the last verify window may overhang the
+        # final emitted token by up to gamma speculated positions
+        mb = _pc.blocks_for(prompt_len + max_new + gamma, block_size)
+        self._tables_np = (1 + np.arange(b * mb, dtype=np.int32)) \
+            .reshape(b, mb)
+        num_blocks = 1 + b * mb
+
+        model_step = model._build_model_step(binder, buffers)
+        select = lambda lg, k: _select_token(
+            lg, k, do_sample=do_sample, temperature=temperature,
+            top_k=top_k, top_p=top_p)
+
+        def prefill(params, ids, key):
+            tables = jnp.asarray(self._tables_np)
+            pools = model.init_paged_caches(num_blocks, block_size)
+            dense = model.init_caches(b, prompt_len)
+            logits, dense = model_step(params, ids, dense,
+                                       jnp.zeros((), jnp.int32))
+            pools = [_pc.write_prefill(kp, vp, tables, dk, dv)
+                     for (kp, vp), (dk, dv) in zip(pools, dense)]
+            key, sub = jax.random.split(key)
+            tok, logp = select(logits[:, -1, :], sub)
+            return tok, logp, pools
+
+        self._prefill = jax.jit(prefill)
+        self._verify = jax.jit(
+            build_verify_step(
+                model_step, gamma=gamma, do_sample=do_sample,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                onehot_draft=draft_model is None),
+            donate_argnums=(1,))
+
+        if draft_model is not None:
+            from ..jit import _LayerBinder
+            self._dbinder = _LayerBinder(draft_model)
+            draft_step = draft_model._build_model_step(
+                self._dbinder, self._dbinder.buffer_arrays())
+
+            def dprefill(dparams, ids):
+                tables = jnp.asarray(self._tables_np)
+                pools = draft_model.init_paged_caches(num_blocks,
+                                                      block_size)
+                dense = draft_model.init_caches(b, prompt_len)
+                _, dense = draft_step(dparams, ids, dense,
+                                      jnp.zeros((), jnp.int32))
+                return [_pc.write_prefill(kp, vp, tables, dk, dv)
+                        for (kp, vp), (dk, dv) in zip(pools, dense)]
+
+            self._dprefill = jax.jit(dprefill)
+            self._dloop = jax.jit(
+                build_draft_loop(draft_step, gamma=gamma,
+                                 do_sample=do_sample,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, want_probs=do_sample),
+                donate_argnums=(1,))
+
+    def run(self, params, ids, seed):
+        """(out [B, max_new] int64 pad-filled-after-EOS, scores [B])."""
+        b, g, eos = self.b, self.gamma, self.eos
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok0, logp0, pools = self._prefill(params, ids, sub)
+        tok0 = np.asarray(tok0)
+        ids_np = np.asarray(ids)
+        if self._draft_model is not None:
+            dparams = self._dbinder.param_arrays()
+            dpools = self._dprefill(dparams, ids)
+        tables = jnp.asarray(self._tables_np)
+
+        emitted = [[int(t)] for t in tok0]
+        scores = [float(v) for v in np.asarray(logp0)]
+        hist = [list(map(int, ids_np[r])) + [int(tok0[r])]
+                for r in range(b)]
+        lens = np.full((b,), self.prompt_len, np.int32)
+        cur = tok0.astype(np.int32)
+        done = [int(t) == eos or self.max_new <= 1 for t in tok0]
+
+        while not all(done):
+            toks = np.empty((b, g + 1), np.int32)
+            toks[:, 0] = cur
+            dq = None
+            if self._draft_model is None:
+                for r in range(b):
+                    toks[r, 1:] = ngram_propose(hist[r], g,
+                                                self.ngram_max) \
+                        if not done[r] else self.pad
+            else:
+                key, sub = jax.random.split(key)
+                props, dq, dpools = self._dloop(
+                    dparams, dpools, tables, jnp.asarray(lens),
+                    jnp.asarray(cur), sub)
+                toks[:, 1:] = np.asarray(props)
+            if self.do_sample:
+                key, sub = jax.random.split(key)
+                args = (params, pools, tables, jnp.asarray(lens),
+                        jnp.asarray(toks))
+                args += (dq, sub) if dq is not None else (sub,)
+                out, accept, logp, pools = self._verify(*args)
+            else:
+                out, accept, logp, pools = self._verify(
+                    params, pools, tables, jnp.asarray(lens),
+                    jnp.asarray(toks))
+            out = np.asarray(out)
+            accept = np.asarray(accept)
+            logp = np.asarray(logp)
+            for r in range(b):
+                if done[r]:
+                    continue
+                kept, n_acc = commit_window(
+                    out[r], accept[r], self.max_new - len(emitted[r]),
+                    eos)
+                emitted[r].extend(kept)
+                hist[r].extend(kept)
+                scores[r] += float(logp[r, :len(kept)].sum())
+                if kept[-1] == eos or len(emitted[r]) >= self.max_new:
+                    done[r] = True      # rows stay batched but frozen
+                else:
+                    # commit cur + the accepted drafts; the rejected
+                    # tail is rolled back by simply NOT advancing over
+                    # it (paged_cache.write_tokens: no data movement)
+                    lens[r] += n_acc + 1
+                    cur[r] = kept[-1]
+
+        out_np = np.full((b, self.max_new), self.pad, np.int64)
+        for r in range(b):
+            out_np[r, :len(emitted[r])] = emitted[r]
+        return out_np, np.asarray(scores, np.float32)

@@ -26,6 +26,21 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   on TPU (``ops/pallas/paged_attention.py``) and the gather fallback on
   CPU, behind the models' ordinary cached-attention path — the same
   code ``generate(cache_impl="paged")`` rides.
+- **Speculative decoding** (``num_speculative_tokens = gamma > 0``): a
+  drafter (model-free n-gram prompt lookup, or a smaller draft model
+  sharing the block tables) proposes gamma tokens per slot and ONE
+  fixed-shape multi-token verify forward (the multi-query paged
+  kernel) accepts 1..gamma+1 of them — still exactly one compiled
+  executable in steady state, because accept/reject lives in the
+  LENGTH values: rejected tokens roll back by decrementing
+  ``cache_lens`` and returning overhang blocks to the allocator (no
+  data movement). The scheduler reserves ``prompt + max_new + gamma``
+  blocks worst-case (the speculated window may overhang the final
+  token), retires EOS found anywhere inside the window, and streams
+  every accepted token through the ordinary callback. Kill switch:
+  ``PADDLE_TPU_SPECULATIVE=0``; capacity-routed MoE is excluded (the
+  window tokens would compete for expert capacity — same reasoning as
+  prompt bucketing). See docs/OPS.md "Speculative decoding".
 
 Admission is worst-case reserved: a request is admitted only when the
 pool can cover ``prompt + max_new`` blocks for it PLUS the outstanding
@@ -87,6 +102,11 @@ class ServingConfig:
     top_p: float = 1.0
     seed: int = 0
     min_prefill_bucket: int = 16        # smallest prompt bucket
+    # speculative decoding: draft gamma tokens per slot per step and
+    # verify them in one multi-token forward (0 = off)
+    num_speculative_tokens: int = 0
+    drafter: str = "ngram"              # ngram | model (pass draft_model)
+    spec_ngram_max: int = 3             # longest prompt-lookup n-gram
 
 
 @dataclass
@@ -99,10 +119,10 @@ class ServingRequest:
 
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
-                 "last_token", "n_emitted", "max_new")
+                 "last_token", "n_emitted", "max_new", "history")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
-                 max_new):
+                 max_new, history=None):
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -110,6 +130,7 @@ class _Slot:
         self.last_token = last_token
         self.n_emitted = 1              # prefill emitted the first token
         self.max_new = max_new
+        self.history = history          # prompt + emitted (spec drafter)
 
 
 class ServingEngine:
@@ -128,8 +149,10 @@ class ServingEngine:
     """
 
     def __init__(self, model, config: Optional[ServingConfig] = None,
-                 stream_callback: Optional[Callable] = None):
+                 stream_callback: Optional[Callable] = None,
+                 draft_model=None):
         from ..generation import GenerationMixin, _select_token
+        from ..generation import speculative as _spec
         if not isinstance(model, GenerationMixin):
             raise TypeError(
                 f"{type(model).__name__} does not support generation "
@@ -143,12 +166,45 @@ class ServingEngine:
             raise NotImplementedError(
                 f"serving decode_strategy {cfg.decode_strategy!r}; "
                 "supported: greedy_search, sampling")
+        gamma = int(cfg.num_speculative_tokens or 0)
+        if gamma < 0:
+            raise ValueError(
+                f"num_speculative_tokens must be >= 0, got {gamma}")
+        if draft_model is not None and \
+                (gamma == 0 or cfg.drafter != "model"):
+            # silently drafting via n-gram while the caller handed over
+            # a draft model would measure the wrong configuration
+            raise ValueError(
+                "draft_model requires num_speculative_tokens > 0 and "
+                "drafter='model' "
+                f"(got gamma={gamma}, drafter={cfg.drafter!r})")
+        if not _spec.speculative_enabled():  # PADDLE_TPU_SPECULATIVE=0
+            gamma = 0
+            draft_model = None
+        if gamma:
+            if cfg.drafter not in ("ngram", "model"):
+                raise ValueError(f"drafter {cfg.drafter!r}; "
+                                 "supported: ngram, model")
+            if cfg.drafter == "model" and draft_model is None:
+                raise ValueError(
+                    "drafter='model' requires a draft_model")
+            reason = _spec.spec_exclusion_reason(model)
+            if reason is not None:
+                raise NotImplementedError(
+                    f"speculative serving unavailable: {reason}")
+            if cfg.drafter == "model":
+                reason = _spec.draft_exclusion_reason(model, draft_model)
+                if reason is not None:
+                    raise NotImplementedError(
+                        f"draft model unusable: {reason}")
         max_pos = getattr(getattr(model, "config", None),
                           "max_position_embeddings", None)
-        if max_pos is not None and cfg.max_model_len > max_pos:
+        if max_pos is not None and cfg.max_model_len + gamma > max_pos:
             raise ValueError(
-                f"max_model_len ({cfg.max_model_len}) exceeds the "
-                f"model's max_position_embeddings ({max_pos})")
+                f"max_model_len ({cfg.max_model_len})"
+                + (f" + speculative window ({gamma})" if gamma else "")
+                + f" exceeds the model's max_position_embeddings "
+                f"({max_pos})")
         self.model = model
         self.config = cfg
         self._stream = stream_callback
@@ -166,11 +222,29 @@ class ServingEngine:
             top_k=cfg.top_k, top_p=cfg.top_p)
 
         self._bs = int(cfg.block_size)
-        self._mb = _pc.blocks_for(cfg.max_model_len, self._bs)
+        # +gamma: the speculative verify window may overhang the last
+        # emitted token by up to gamma written-then-rolled-back slots
+        self._gamma = gamma
+        self._ngram_max = int(cfg.spec_ngram_max)
+        self._mb = _pc.blocks_for(cfg.max_model_len + gamma, self._bs)
         nb = (1 + cfg.num_slots * self._mb) if cfg.num_blocks is None \
             else int(cfg.num_blocks)
         self._alloc = _pc.BlockAllocator(nb)
         self._pools = model.init_paged_caches(nb, self._bs)
+        self._draft_model = draft_model \
+            if gamma and cfg.drafter == "model" else None
+        if self._draft_model is not None:
+            self._draft_model.eval()
+            dbinder = _LayerBinder(self._draft_model)
+            self._dbinder = dbinder
+            self._dparams = dbinder.param_arrays()
+            self._draft_step = self._draft_model._build_model_step(
+                dbinder, dbinder.buffer_arrays())
+            self._dpools = self._draft_model.init_paged_caches(
+                nb, self._bs)
+            self._draft_prefill_execs = {}
+        self._verify_exec = None
+        self._draft_exec = None
         self._tables = np.zeros((cfg.num_slots, self._mb), np.int32)
         self._slots: List[Optional[_Slot]] = [None] * cfg.num_slots
         self._reserved = 0              # blocks promised to active slots
@@ -192,6 +266,10 @@ class ServingEngine:
         self._n_decode_steps = 0
         self._n_tokens = 0
         self._n_completed = 0
+        self._n_spec_proposed = 0
+        self._n_spec_accepted = 0
+        self._n_spec_verifies = 0       # per-slot verify windows
+        self._n_spec_emitted = 0
 
         # -- telemetry ------------------------------------------------
         self._m_occupancy = monitor.gauge(
@@ -215,6 +293,19 @@ class ServingEngine:
             labels=("bucket",))
         self._m_completed = monitor.counter(
             "serving_requests_completed", "requests fully served")
+        if gamma:
+            self._m_spec_len = monitor.histogram(
+                "serving_spec_accepted_len",
+                "tokens emitted per slot verify window "
+                "(accepted drafts + the correction/bonus token)",
+                buckets=(1, 2, 3, 4, 5, 6, 7, 8, 9))
+            self._m_spec_proposed = monitor.counter(
+                "spec_tokens_proposed", "draft tokens proposed")
+            self._m_spec_accepted = monitor.counter(
+                "spec_tokens_accepted", "draft tokens accepted")
+            self._m_spec_rate = monitor.gauge(
+                "serving_spec_acceptance_rate",
+                "accepted / proposed draft tokens (cumulative)")
 
     # -- public API ---------------------------------------------------
 
@@ -233,7 +324,8 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({ids.size}) + max_new_tokens ({max_new}) "
                 f"exceeds max_model_len ({self.config.max_model_len})")
-        worst = _pc.blocks_for(ids.size + max_new, self._bs)
+        worst = _pc.blocks_for(ids.size + max_new + self._gamma,
+                               self._bs)
         if worst > self._alloc.num_blocks - 1:
             raise ValueError(
                 f"request needs {worst} blocks; pool has only "
@@ -252,9 +344,12 @@ class ServingEngine:
         return len(self._queue)
 
     def step(self) -> List[tuple]:
-        """One engine tick: admit what fits, decode one token for every
-        active slot, retire finished sequences. Returns this tick's
+        """One engine tick: admit what fits, decode one token (or
+        verify a speculative window) for every active slot, retire
+        finished sequences. Returns this tick's
         ``[(request_id, token), ...]`` (admission prefills included)."""
+        if self._gamma:
+            return self._step_spec()
         emitted = self._admit()
         active = [i for i, s in enumerate(self._slots) if s is not None]
         if not active:
@@ -293,6 +388,102 @@ class ServingEngine:
                 self._retire(i)
         return emitted
 
+    def _step_spec(self) -> List[tuple]:
+        """Speculative engine tick: draft gamma tokens per active slot,
+        verify the whole window in ONE fixed-shape target forward, and
+        commit 1..gamma+1 tokens per slot. The verify executable is
+        AOT-compiled once — accept/reject never changes a shape, only
+        the ``cache_lens`` values — so steady state stays at zero
+        recompiles exactly like the plain decode step. Rollback of a
+        rejected tail is ``cache_len`` simply not advancing over it,
+        plus ``_trim_blocks`` returning overhang blocks."""
+        from ..generation import speculative as _spec
+        emitted = self._admit()
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active:
+            return emitted
+        g = self._gamma
+        # room for the full window: positions cache_len .. cache_len+g
+        self._ensure_blocks(active, horizon=g + 1)
+
+        cfg = self.config
+        lens = np.zeros(cfg.num_slots, np.int32)
+        toks = np.full((cfg.num_slots, g + 1), self._pad, np.int32)
+        for i in active:
+            lens[i] = self._slots[i].cache_len
+            toks[i, 0] = self._slots[i].last_token
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        lens_dev = jnp.asarray(lens)
+
+        dq = None
+        if self._draft_model is not None:
+            sub = self._next_key()
+            if self._draft_exec is None:
+                self._draft_exec = self._compile_draft(lens, toks, sub)
+            with _quiet_donation():
+                props, dq, self._dpools = self._draft_exec(
+                    self._dparams, self._dpools, self._tables_dev,
+                    lens_dev, jnp.asarray(toks[:, 0]), sub)
+            toks[:, 1:] = np.asarray(props)
+        else:
+            for i in active:
+                toks[i, 1:] = _spec.ngram_propose(
+                    self._slots[i].history, g, self._ngram_max)
+
+        sub = self._next_key()
+        if self._verify_exec is None:
+            self._verify_exec = self._compile_verify(lens, toks, dq,
+                                                     sub)
+        args = [self._params, self._pools, self._tables_dev, lens_dev,
+                jnp.asarray(toks)]
+        if self._do_sample:
+            if dq is not None:
+                args.append(dq)
+            args.append(sub)
+        with _quiet_donation():
+            out, accept, _logp, self._pools = self._verify_exec(*args)
+        out = np.asarray(out)
+        accept = np.asarray(accept)
+
+        self._m_steps.inc()
+        self._n_decode_steps += 1
+        self._m_util.observe(len(active) / cfg.num_slots)
+        for i in active:
+            slot = self._slots[i]
+            # EOS inside the window and max_new room both truncate
+            kept, n_acc = _spec.commit_window(
+                out[i], accept[i], slot.max_new - slot.n_emitted,
+                self._eos)
+            slot.n_emitted += len(kept)
+            slot.history.extend(kept)
+            for tok in kept:
+                self._emit(slot.rid, tok)
+                emitted.append((slot.rid, tok))
+            # accepted drafts that were actually USED: EOS-inside-window
+            # or max_new room can truncate the emission below n_acc+1,
+            # and the metrics must agree with what clients received
+            n_used = min(n_acc, len(kept))
+            self._n_spec_proposed += g
+            self._n_spec_accepted += n_used
+            self._n_spec_verifies += 1
+            self._n_spec_emitted += len(kept)
+            self._m_spec_len.observe(len(kept))
+            self._m_spec_proposed.inc(g)
+            self._m_spec_accepted.inc(n_used)
+            if kept[-1] == self._eos or slot.n_emitted >= slot.max_new:
+                self._retire(i)
+            else:
+                # commit the window prefix [cur, accepted drafts]; the
+                # rejected tail rolls back by NOT advancing over it
+                slot.cache_len += n_acc + 1
+                slot.last_token = kept[-1]
+                self._trim_blocks(i)
+        if self._n_spec_proposed:
+            self._m_spec_rate.set(
+                self._n_spec_accepted / self._n_spec_proposed)
+        return emitted
+
     def run(self) -> Dict[int, np.ndarray]:
         """Drive ``step()`` until queue and slots drain; returns (and
         drains) the tokens of every request completed since the last
@@ -311,8 +502,10 @@ class ServingEngine:
         return [done[r] for r in rids]
 
     def stats(self) -> dict:
-        """Scheduler/counter snapshot (tests + ops dashboards)."""
-        return {
+        """Scheduler/counter snapshot (tests + ops dashboards). In
+        speculative mode ``decode_steps``/``decode_compiles`` count the
+        verify executable (the spec-mode decode step)."""
+        out = {
             "active": self.num_active,
             "queued": self.num_queued,
             "free_blocks": self._alloc.free_blocks,
@@ -322,6 +515,18 @@ class ServingEngine:
             "tokens_total": self._n_tokens,
             "requests_completed": self._n_completed,
         }
+        if self._gamma:
+            out.update({
+                "spec_tokens_proposed": self._n_spec_proposed,
+                "spec_tokens_accepted": self._n_spec_accepted,
+                "spec_acceptance_rate":
+                    self._n_spec_accepted / self._n_spec_proposed
+                    if self._n_spec_proposed else 0.0,
+                "spec_mean_accepted_len":
+                    self._n_spec_emitted / self._n_spec_verifies
+                    if self._n_spec_verifies else 0.0,
+            })
+        return out
 
     # -- scheduler internals ------------------------------------------
 
@@ -351,7 +556,8 @@ class ServingEngine:
                 break
             req = self._queue[0]
             n_real = int(req.prompt.size)
-            worst = _pc.blocks_for(n_real + req.max_new_tokens, self._bs)
+            worst = _pc.blocks_for(
+                n_real + req.max_new_tokens + self._gamma, self._bs)
             init = _pc.blocks_for(n_real, self._bs)
             # worst-case reservation: admit only what can NEVER run the
             # pool dry mid-decode (FIFO — no head-of-line bypass, which
@@ -371,8 +577,11 @@ class ServingEngine:
                 1000.0 * (time.monotonic() - req.submit_time))
             self._results[req.request_id] = []
             tok = self._prefill(i, req, n_real)
+            history = list(map(int, req.prompt)) + [tok] \
+                if self._gamma else None
             self._slots[i] = _Slot(req.request_id, blocks, worst,
-                                   n_real, tok, req.max_new_tokens)
+                                   n_real, tok, req.max_new_tokens,
+                                   history=history)
             self._emit(req.request_id, tok)
             emitted.append((req.request_id, tok))
             self._m_occupancy.set(self.num_active)
@@ -398,20 +607,55 @@ class ServingEngine:
                 self._params, jnp.asarray(ids),
                 jnp.asarray(n_real, jnp.int32), self._pools,
                 jnp.asarray(self._tables[i]), sub)
+        if self._draft_model is not None:
+            # prime the draft model's cache with the same prompt K/V
+            # (its pools share the slot's block table)
+            dexec = self._draft_prefill_execs.get(bucket)
+            if dexec is None:
+                dexec = self._compile_draft_prefill(bucket)
+                self._draft_prefill_execs[bucket] = dexec
+            with _quiet_donation():
+                self._dpools = dexec(
+                    self._dparams, jnp.asarray(ids),
+                    jnp.asarray(n_real, jnp.int32), self._dpools,
+                    jnp.asarray(self._tables[i]))
         return int(tok)
 
-    def _ensure_blocks(self, active):
-        """Grow any slot whose next write position crosses into an
-        unallocated block (covered by the admission reservation)."""
+    def _ensure_blocks(self, active, horizon=1):
+        """Grow any slot whose next ``horizon`` write positions cross
+        into unallocated blocks (covered by the admission reservation;
+        speculative mode needs ``gamma + 1`` positions of headroom for
+        the verify window)."""
         for i in active:
             slot = self._slots[i]
-            bi = slot.cache_len // self._bs
-            if bi >= len(slot.blocks):
+            need = _pc.blocks_for(slot.cache_len + horizon, self._bs)
+            while len(slot.blocks) < need:
                 (blk,) = self._alloc.alloc(1)
+                self._tables[i, len(slot.blocks)] = blk
                 slot.blocks.append(blk)
-                self._tables[i, bi] = blk
                 self._tables_dev = None
                 self._reserved -= 1
+
+    def _trim_blocks(self, i):
+        """Speculative rollback, block side: return blocks only the
+        rejected window tail reached to the allocator (back under the
+        slot's admission reservation; no cache data moves). Blocks
+        within the NEXT window's reach (``cache_len + gamma + 1``
+        positions) are kept: freeing them would be reservation-neutral
+        (``free - reserved`` is invariant under trim, so admission
+        capacity cannot improve) yet the very next `_ensure_blocks`
+        would re-allocate them and re-upload the device block table —
+        pure hot-loop churn. With a fixed gamma that makes mid-flight
+        trims rare; retirement frees everything regardless."""
+        slot = self._slots[i]
+        need = _pc.blocks_for(slot.cache_len + self._gamma + 1,
+                              self._bs)
+        while len(slot.blocks) > need:
+            blk = slot.blocks.pop()
+            self._alloc.free([blk])
+            self._tables[i, len(slot.blocks)] = 0
+            self._reserved += 1
+            self._tables_dev = None
 
     def _retire(self, i):
         slot = self._slots[i]
@@ -475,4 +719,71 @@ class ServingEngine:
                 jnp.zeros((), jnp.int32), self._pools,
                 jnp.zeros((self._mb,), jnp.int32), key).compile()
         self._m_prefill_compiles.labels(bucket=bucket).inc()
+        return exec_
+
+    def _compile_verify(self, lens, toks, dq, key):
+        """AOT-compile the fixed-gamma multi-token verify step ONCE
+        (the speculative decode executable — counted in
+        ``decode_compiles`` so the zero-steady-state-recompile
+        assertion covers speculative mode too)."""
+        from ..generation import speculative as _spec
+        cfg = self.config
+        verify = _spec.build_verify_step(
+            self._model_step, gamma=self._gamma,
+            do_sample=self._do_sample, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p,
+            onehot_draft=self._draft_model is None)
+        jitted = jax.jit(verify, donate_argnums=(1,))
+        args = [self._params, self._pools, jnp.asarray(self._tables),
+                jnp.asarray(lens), jnp.asarray(toks)]
+        if self._do_sample:
+            if dq is not None:
+                args.append(dq)
+            args.append(key)
+        with _quiet_donation():
+            exec_ = jitted.lower(*args).compile()
+        self._m_decode_compiles.inc()
+        self._n_decode_compiles += 1
+        return exec_
+
+    def _compile_draft(self, lens, toks, key):
+        """AOT-compile the draft model's gamma+1-step proposal scan
+        ONCE (drafter='model')."""
+        from ..generation import speculative as _spec
+        cfg = self.config
+        loop = _spec.build_draft_loop(
+            self._draft_step, gamma=self._gamma,
+            do_sample=self._do_sample, temperature=cfg.temperature,
+            top_k=cfg.top_k, top_p=cfg.top_p,
+            want_probs=self._do_sample)
+        jitted = jax.jit(loop, donate_argnums=(1,))
+        with _quiet_donation():
+            exec_ = jitted.lower(
+                self._dparams, self._dpools, jnp.asarray(self._tables),
+                jnp.asarray(lens), jnp.asarray(toks[:, 0]),
+                key).compile()
+        return exec_
+
+    def _compile_draft_prefill(self, bucket):
+        """Draft-cache twin of ``_compile_prefill``: scatter the draft
+        model's prompt K/V into its pools through the SAME block table
+        row (no token is selected — the target picks the first
+        token)."""
+        def dprefill(dparams, ids, n_real, dpools, table_row):
+            dense = self._draft_model.init_caches(1, bucket)
+            _, dense = self._draft_step(dparams, ids, dense,
+                                        jnp.zeros((), jnp.int32))
+            return [
+                _pc.write_prefill(kp, vp, table_row[None], dk, dv,
+                                  n_real=n_real)
+                for (kp, vp), (dk, dv) in zip(dpools, dense)]
+
+        jitted = jax.jit(dprefill, donate_argnums=(3,))
+        with _quiet_donation():
+            exec_ = jitted.lower(
+                self._dparams, jnp.zeros((1, bucket), jnp.int32),
+                jnp.zeros((), jnp.int32), self._dpools,
+                jnp.zeros((self._mb,), jnp.int32)).compile()
+        self._m_prefill_compiles.labels(
+            bucket=f"draft-{bucket}").inc()
         return exec_

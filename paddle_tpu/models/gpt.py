@@ -166,10 +166,13 @@ class GPTModel(Layer):
         if position_ids is None:
             if cache_lens is not None:
                 # paged decode: each slot sits at its own position
+                # (window token t of a speculative verify chunk at
+                # cache_lens + t)
                 from ..framework.core import _wrap_out as _w
                 from ..framework.core import as_jax as _aj
                 position_ids = _w(
-                    _aj(cache_lens).astype(jnp.int32)[:, None])
+                    _aj(cache_lens).astype(jnp.int32)[:, None]
+                    + jnp.arange(l, dtype=jnp.int32)[None, :])
             else:
                 from ..ops.creation import arange
                 position_ids = arange(l, dtype="int64")

@@ -145,25 +145,30 @@ def cached_attention(qh, kh, vh, kc, vc, off, head_dim,
 def paged_attention_decode(qh, kh, vh, k_pool, v_pool, block_tables,
                            cache_lens, head_dim):
     """Shared paged-KV decode step (Llama/GPT families): write this
-    token's K/V heads [S, 1, H_kv, D] into the shared block pool at each
-    slot's position ``cache_lens[s]``, then attend q against the slot's
+    chunk's K/V heads [S, T, H_kv, D] into the shared block pool at
+    positions ``cache_lens[s] + t``, then attend q against each slot's
     length-bounded block list through the ragged paged kernel
     (``ops/pallas/paged_attention.py``; gather fallback off-TPU).
-    Returns (out [S, 1, H, D], new_k_pool, new_v_pool)."""
-    if qh.shape[1] != 1:
-        raise ValueError(
-            f"paged attention is a decode step (one token per slot); "
-            f"got chunk length {qh.shape[1]} — prefill goes through the "
-            f"dense cached path + ops.paged_cache.write_prefill")
-    from ..ops.paged_cache import write_decode
-    from ..ops.pallas.paged_attention import paged_decode_attention
+    ``T = 1`` is the continuous-batching decode step; ``T > 1`` is the
+    speculative verify window (causal within the window — token ``t``
+    sees ``cache_lens[s] + t + 1`` positions). Prefill goes through
+    the dense cached path + ``ops.paged_cache.write_prefill``.
+    Returns (out [S, T, H, D], new_k_pool, new_v_pool)."""
+    from ..ops.paged_cache import write_decode, write_tokens
+    from ..ops.pallas.paged_attention import (paged_decode_attention,
+                                              paged_verify_attention)
     lens = cache_lens.astype(jnp.int32)
-    kp2, vp2 = write_decode(k_pool, v_pool, block_tables, lens,
-                            kh[:, 0], vh[:, 0])
-    out = paged_decode_attention(qh[:, 0], kp2, vp2, block_tables,
-                                 lens + 1,
+    if qh.shape[1] == 1:
+        kp2, vp2 = write_decode(k_pool, v_pool, block_tables, lens,
+                                kh[:, 0], vh[:, 0])
+        out = paged_decode_attention(qh[:, 0], kp2, vp2, block_tables,
+                                     lens + 1,
+                                     sm_scale=1.0 / math.sqrt(head_dim))
+        return out[:, None], kp2, vp2
+    kp2, vp2 = write_tokens(k_pool, v_pool, block_tables, lens, kh, vh)
+    out = paged_verify_attention(qh, kp2, vp2, block_tables, lens + 1,
                                  sm_scale=1.0 / math.sqrt(head_dim))
-    return out[:, None], kp2, vp2
+    return out, kp2, vp2
 
 
 def _rope_rotate(x, c, s):
@@ -272,15 +277,18 @@ class LlamaAttention(Layer):
                        block_tables, cache_lens, b, l):
         """Continuous-batching decode attention over the paged block
         pool: per-slot rope positions come from ``cache_lens`` (each
-        slot sits at its own sequence position), the K/V write and the
-        ragged attention run through ``paged_attention_decode``."""
+        slot sits at its own sequence position; window token ``t`` of a
+        speculative verify chunk at ``cache_lens + t``), the K/V write
+        and the ragged attention run through
+        ``paged_attention_decode``."""
 
         def attn_p(q_a, k_a, v_a, cos_t, sin_t, kp, vp, tables, lens):
             qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
             kh = k_a.reshape(b, l, self.num_kv_heads, self.head_dim)
             vh = v_a.reshape(b, l, self.num_kv_heads, self.head_dim)
-            pos = lens.astype(jnp.int32)[:, None]        # [S, 1]
-            cos = cos_t[pos]                             # [S, 1, D/2]
+            pos = lens.astype(jnp.int32)[:, None] \
+                + jnp.arange(l, dtype=jnp.int32)[None, :]   # [S, L]
+            cos = cos_t[pos]                             # [S, L, D/2]
             sin = sin_t[pos]
             qh = _apply_rope_rows(qh, cos, sin)
             kh = _apply_rope_rows(kh, cos, sin)

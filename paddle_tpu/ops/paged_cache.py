@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
-           "write_prefill", "write_decode", "gather_dense"]
+           "write_prefill", "write_decode", "write_tokens",
+           "gather_dense"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -123,6 +124,31 @@ def write_decode(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
     bi = jnp.take_along_axis(block_tables.astype(jnp.int32),
                              (lens // bs)[:, None], axis=1)[:, 0]  # [S]
     off = lens % bs
+    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def write_tokens(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
+    """Append T tokens per slot: token ``t`` of slot ``s`` lands at
+    position ``cache_lens[s] + t`` (the speculative-verify window
+    write — the multi-token generalization of ``write_decode``).
+
+    k_new/v_new: [S, T, H_kv, D]; block_tables: [S, MB]; cache_lens:
+    [S] (valid length BEFORE this window, i.e. the first write
+    position). Rollback of rejected speculated tokens is O(1) and
+    needs NO cache edit: the caller simply decrements its length
+    bookkeeping — positions at/after ``cache_lens`` are masked out of
+    every attention read and are overwritten by the next append at the
+    same positions. Inactive slots' tables hold the null block, so
+    their writes are harmless by construction."""
+    t = k_new.shape[1]
+    bs = k_pool.shape[1]
+    lens = cache_lens.astype(jnp.int32)
+    pos = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    bi = jnp.take_along_axis(block_tables.astype(jnp.int32),
+                             pos // bs, axis=1)               # [S, T]
+    off = pos % bs
     k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool

@@ -21,6 +21,16 @@ Off TPU (or for kernel-ineligible shapes) the jnp fallback gathers the
 slot's blocks into a dense view and runs the same masked softmax — the
 numerics twin of ``models.llama.cached_attention``, so paged-vs-dense
 parity holds token-for-token on CPU.
+
+Speculative decoding adds the MULTI-QUERY variant
+(``paged_verify_attention``): each slot carries ``T = gamma + 1``
+query tokens (the draft window plus the committed token), causal
+WITHIN the window — query ``t`` sits at cache position
+``context_lens[s] - 1 + t`` and may attend to every position before or
+at its own. Same grid, same scalar-prefetch block-table chasing; the
+only kernel delta is ``t_q * rep`` softmax rows with a per-row length
+bound instead of ``rep`` rows with one shared bound (the single-token
+decode kernel is the ``t_q = 1`` instantiation of the same body).
 """
 from __future__ import annotations
 
@@ -31,7 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["paged_decode_attention", "pallas_paged_attention"]
+__all__ = ["paged_decode_attention", "pallas_paged_attention",
+           "paged_verify_attention", "pallas_paged_verify_attention"]
 
 NEG_INF = np.float32(-1e30)
 
@@ -53,7 +64,13 @@ def _interpret() -> bool:
 
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, scale, block_size,
-                   n_blocks):
+                   n_blocks, t_q=1, rep=None):
+    """Shared body for single-token decode (``t_q=1``) and the
+    speculative multi-query verify window (``t_q=gamma+1``): the
+    ``t_q * rep`` softmax rows carry a per-row causal bound — row
+    ``r`` belongs to window token ``t = r // rep`` and may see cache
+    positions ``< lens_ref[s] + t`` (``lens_ref`` counts positions
+    visible to window token 0, that token itself included)."""
     s = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -64,19 +81,24 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     ctx = lens_ref[s]
-    # ragged bound: blocks at/after the slot's length hold no live
-    # tokens — predicate off their FLOPs entirely
-    @pl.when(j * block_size < ctx)
+    # ragged bound: blocks at/after the slot's LAST window token's
+    # reach hold no live tokens — predicate off their FLOPs entirely
+    @pl.when(j * block_size < ctx + (t_q - 1))
     def _compute():
-        q = q_ref[0, 0]                       # [rep, D]
+        q = q_ref[0, 0]                       # [t_q * rep, D]
         k = k_ref[0, :, 0, :]                 # [BS, D]
         v = v_ref[0, :, 0, :]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [rep, BS]
+            preferred_element_type=jnp.float32) * scale
         cols = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, sc.shape, 1)
-        sc = jnp.where(cols < ctx, sc, NEG_INF)
+        if t_q == 1:
+            bound = ctx
+        else:   # causal within the window: row r is window token r//rep
+            bound = ctx + jax.lax.broadcasted_iota(
+                jnp.int32, sc.shape, 0) // rep
+        sc = jnp.where(cols < bound, sc, NEG_INF)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
@@ -156,9 +178,68 @@ try:  # pallas/tpu lowering may be absent on this jax build
           context_lens.astype(jnp.int32), q4, k_pool, v_pool)
         return out.reshape(s, h, d)
 
+    def pallas_paged_verify_attention(q, k_pool, v_pool, block_tables,
+                                      context_lens, sm_scale=None,
+                                      interpret=None):
+        """Multi-query (speculative verify) variant. q: [S, T, H, D]
+        (T = gamma + 1 window tokens per slot, already written to the
+        pool); context_lens: [S] int32 — positions visible to window
+        token 0, itself included (token ``t`` sees ``context_lens + t``
+        positions). Returns [S, T, H, D]."""
+        s, t, h, d = q.shape
+        nb, bs, hkv, _ = k_pool.shape
+        mb = block_tables.shape[1]
+        rep = h // hkv
+        scale = np.float32(sm_scale if sm_scale is not None
+                           else 1.0 / math.sqrt(d))
+        # rows grouped kv-head-major: [S, hkv, T*rep, D] so one K/V
+        # block DMA feeds every window token of the kv group
+        q4 = q.reshape(s, t, hkv, rep, d).transpose(0, 2, 1, 3, 4) \
+            .reshape(s, hkv, t * rep, d)
+        kernel = functools.partial(
+            _decode_kernel, scale=scale, block_size=bs, n_blocks=mb,
+            t_q=t, rep=rep)
+
+        def kv_block(si, g, j, tables, lens):
+            return (tables[si, j], 0, g, 0)
+
+        rows = t * rep
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s, hkv, mb),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, d),
+                             lambda si, g, j, tables, lens:
+                             (si, g, 0, 0)),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, d),
+                                   lambda si, g, j, tables, lens:
+                                   (si, g, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, d), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((s, hkv, rows, d), q.dtype),
+            compiler_params=_CompilerParams(
+                dimension_semantics=("parallel", "parallel",
+                                     "arbitrary")),
+            interpret=_interpret() if interpret is None else interpret,
+        )(block_tables.astype(jnp.int32),
+          context_lens.astype(jnp.int32), q4, k_pool, v_pool)
+        return out.reshape(s, hkv, t, rep, d).transpose(0, 2, 1, 3, 4) \
+            .reshape(s, t, h, d)
+
     _kernel_import_error = None
 except Exception as _e:  # pragma: no cover - environment dependent
     pallas_paged_attention = None
+    pallas_paged_verify_attention = None
     _kernel_import_error = _e
 
 
@@ -192,6 +273,35 @@ def _xla_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     return out.reshape(s, h, d)
 
 
+def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
+                      sm_scale=None):
+    """Multi-query gather fallback (speculative verify window): same
+    dtype recipe as ``_xla_paged_attention`` with a per-window-token
+    causal bound, so the verify forward is the numerics twin of T
+    sequential single-token decode steps — greedy acceptance stays
+    token-exact on CPU."""
+    s, t, h, d = q.shape
+    hkv = k_pool.shape[2]
+    rep = h // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    from ..paged_cache import gather_dense
+    k = gather_dense(k_pool, block_tables)      # [S, L, Hkv, D]
+    v = gather_dense(v_pool, block_tables)
+    lens = context_lens.astype(jnp.int32)
+    q6 = q.reshape(s, t, hkv, rep, d)
+    scores = jnp.einsum(
+        "stgrd,slgd->sgtrl", q6, k.astype(q.dtype),
+        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(k.shape[1], dtype=jnp.int32)
+    bound = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    bias = jnp.where(pos[None, None, :] < bound[:, :, None],
+                     0.0, -1e9)                  # [S, T, L]
+    scores = scores + bias[:, None, :, None, :]
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("sgtrl,slgd->stgrd", w, v.astype(q.dtype))
+    return out.reshape(s, t, h, d)
+
+
 def _kernel_eligible(q, k_pool):
     # block_size must be a whole number of sublane tiles for the pool
     # dtype: 8 for f32, 16 for bf16/f16, 32 for int8/fp8
@@ -201,7 +311,26 @@ def _kernel_eligible(q, k_pool):
             and q.shape[1] % k_pool.shape[2] == 0)
 
 
-_fallback_logged = False
+_fallback_warned = set()    # paths that already logged their fallback
+
+
+def _warn_fallback(kind, q_shape, pool_shape, kernel_missing):
+    """One-time (per entry point) TPU diagnostic: running the gather
+    fallback in production means the decode/verify hot loop lost the
+    kernel — say why, once for each path (the reasons can differ)."""
+    if kind in _fallback_warned:
+        return
+    _fallback_warned.add(kind)
+    import warnings
+    if kernel_missing:
+        reason = "kernel unavailable on this jax build (%r)" \
+            % (_kernel_import_error,)
+    else:
+        reason = ("shape %s / pool %s not kernel-eligible "
+                  "(head_dim must be 64/128/256, block_size a "
+                  "sublane-tile multiple for the pool dtype)"
+                  % (tuple(q_shape), tuple(pool_shape)))
+    warnings.warn("%s: %s; using the gather fallback" % (kind, reason))
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
@@ -216,22 +345,40 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
     except Exception:
         use_kernel = False
     if jax.default_backend() == "tpu" and not use_kernel:
-        global _fallback_logged
-        if not _fallback_logged:
-            _fallback_logged = True
-            import warnings
-            if pallas_paged_attention is None:
-                reason = "kernel unavailable on this jax build (%r)" \
-                    % (_kernel_import_error,)
-            else:
-                reason = ("shape %s / pool %s not kernel-eligible "
-                          "(head_dim must be 64/128/256, block_size a "
-                          "sublane-tile multiple for the pool dtype)"
-                          % (tuple(q.shape), tuple(k_pool.shape)))
-            warnings.warn("paged_decode_attention: %s; using the "
-                          "gather fallback" % reason)
+        _warn_fallback("paged_decode_attention", q.shape, k_pool.shape,
+                       pallas_paged_attention is None)
     if use_kernel:
         return pallas_paged_attention(q, k_pool, v_pool, block_tables,
                                       context_lens, sm_scale=sm_scale)
     return _xla_paged_attention(q, k_pool, v_pool, block_tables,
                                 context_lens, sm_scale=sm_scale)
+
+
+def paged_verify_attention(q, k_pool, v_pool, block_tables,
+                           context_lens, sm_scale=None):
+    """Multi-query ragged paged attention for the speculative verify
+    window; q: [S, T, H, D] (T = gamma + 1 tokens per slot, causal
+    within the window). ``context_lens[s]`` = positions visible to the
+    slot's FIRST window token, itself included. Routes to the Pallas
+    kernel on TPU, the gather fallback elsewhere."""
+    import types
+    # shape-only stand-in for one window token so the shared
+    # eligibility predicate applies without building a traced slice
+    q_tok = types.SimpleNamespace(
+        shape=(q.shape[0], q.shape[2], q.shape[3]))
+    use_kernel = False
+    try:
+        use_kernel = jax.default_backend() == "tpu" \
+            and pallas_paged_verify_attention is not None \
+            and _kernel_eligible(q_tok, k_pool)
+    except Exception:
+        use_kernel = False
+    if jax.default_backend() == "tpu" and not use_kernel:
+        _warn_fallback("paged_verify_attention", q.shape, k_pool.shape,
+                       pallas_paged_verify_attention is None)
+    if use_kernel:
+        return pallas_paged_verify_attention(
+            q, k_pool, v_pool, block_tables, context_lens,
+            sm_scale=sm_scale)
+    return _xla_paged_verify(q, k_pool, v_pool, block_tables,
+                             context_lens, sm_scale=sm_scale)
