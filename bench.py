@@ -674,6 +674,115 @@ def _spec_serving_bench():
     return results
 
 
+def _prefix_serving_bench():
+    """Prefix-cached serving throughput (the ISSUE-5 bar): N requests
+    sharing one long system prompt (distinct short suffixes — the
+    multi-tenant chat / few-shot-header regime) through the content-
+    addressed block cache + the ONE fixed-chunk prefill executable,
+    against the cold-cache baseline (prefix caching off, same engine
+    otherwise). Reports aggregate tok/s, time-to-first-token p50/p99
+    (submit -> first streamed token, the latency prefix reuse
+    actually buys), prefix hit rate, and ``recompiles_measured``
+    (prefill + decode executables after warmup — must be 0: one chunk
+    executable serves every prompt length)."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_SERVE_PREFIX_VOCAB",
+                                      32000)),
+        hidden_size=int(os.environ.get("BENCH_SERVE_PREFIX_HIDDEN",
+                                       2048)),
+        intermediate_size=int(os.environ.get("BENCH_SERVE_PREFIX_FFN",
+                                             5632)),
+        num_hidden_layers=int(os.environ.get(
+            "BENCH_SERVE_PREFIX_LAYERS", 8)),
+        num_attention_heads=16,
+        num_key_value_heads=8, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_SERVE_PREFIX_SLOTS", 8))
+    new = int(os.environ.get("BENCH_SERVE_PREFIX_NEW", 32))
+    n_req = int(os.environ.get("BENCH_SERVE_PREFIX_REQS", 16))
+    plen = int(os.environ.get("BENCH_SERVE_PREFIX_LEN", 256))
+    tail = int(os.environ.get("BENCH_SERVE_PREFIX_TAIL", 16))
+    chunk = int(os.environ.get("BENCH_SERVE_PREFIX_CHUNK", 128))
+    rng = np.random.RandomState(0)
+    sysp = rng.randint(1, cfg.vocab_size, (plen,))
+    prompts = [np.concatenate(
+        [sysp, rng.randint(1, cfg.vocab_size, (tail,))])
+        for _ in range(n_req)]
+
+    def run_engine(enable_cache):
+        first = {}
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=512,
+            max_new_tokens=new, prefill_chunk=chunk,
+            enable_prefix_cache=enable_cache),
+            stream_callback=lambda rid, tok:
+            first.setdefault(rid, time.perf_counter()))
+        # warmup: compile the chunk + decode executables; in cached
+        # mode this also seeds the shared prefix (retirement publishes
+        # its blocks), which is exactly the steady state measured
+        eng.serve([np.concatenate(
+            [sysp, rng.randint(1, cfg.vocab_size, (tail,))])],
+            max_new_tokens=4)
+        st0 = eng.stats()
+        compiles0 = st0["prefill_compiles"] + st0["decode_compiles"]
+        tokens0 = st0["tokens_total"]
+        first.clear()
+        submit_t = {}
+        for p in prompts:
+            rid = eng.submit(p, new)
+            submit_t[rid] = time.perf_counter()
+        t0 = time.perf_counter()
+        while eng.num_queued or eng.num_active:
+            eng.step()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        ttft = np.sort(np.asarray(
+            [1000.0 * (first[r] - submit_t[r]) for r in submit_t]))
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "ttft_p50_ms": round(float(ttft[len(ttft) // 2]), 2),
+            "ttft_p99_ms": round(float(
+                ttft[min(len(ttft) - 1, int(len(ttft) * 0.99))]), 2),
+            "prefix_hit_rate": round(st["prefix_hit_rate"], 4),
+            "prefix_tokens_reused": st["prefix_tokens_reused"],
+            "cow_copies": st["cow_copies"],
+            "cache_evictions": st["cache_evictions"],
+            "prefill_chunks": st["prefill_chunks"],
+            "recompiles_measured":
+                st["prefill_compiles"] + st["decode_compiles"]
+                - compiles0,
+        }
+
+    cold = run_engine(False)
+    warm = run_engine(True)
+    out = {
+        "cold_cache": cold,
+        "prefix_cached": warm,
+        "speedup_tokens_per_sec": round(
+            warm["aggregate_tokens_per_sec"]
+            / max(cold["aggregate_tokens_per_sec"], 1e-9), 3),
+        "ttft_p50_reduction": round(
+            cold["ttft_p50_ms"] / max(warm["ttft_p50_ms"], 1e-9), 3),
+        "num_slots": slots, "requests": n_req,
+        "shared_prefix_len": plen, "suffix_len": tail,
+        "max_new_tokens": new, "prefill_chunk": chunk,
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -776,6 +885,10 @@ def main():
     except Exception as exc:
         speculative = {"error": repr(exc)}
     try:
+        serving_prefix = _prefix_serving_bench()
+    except Exception as exc:
+        serving_prefix = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -787,6 +900,7 @@ def main():
               "moe_profile": moe_profile, "decode": decode,
               "serving": serving,
               "speculative": speculative,
+              "serving_prefix": serving_prefix,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -803,7 +917,7 @@ def main():
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
             if k not in ("decode", "serving", "speculative",
-                         "flashmask", "moe_profile")
+                         "serving_prefix", "flashmask", "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -820,6 +934,16 @@ def main():
              "spec_mean_accepted_len":
              speculative.get("ngram_g4", {}).get("mean_accepted_len")
              if isinstance(speculative, dict) else None,
+             "prefix_serving_speedup":
+             serving_prefix.get("speedup_tokens_per_sec")
+             if isinstance(serving_prefix, dict) else None,
+             "prefix_ttft_p50_reduction":
+             serving_prefix.get("ttft_p50_reduction")
+             if isinstance(serving_prefix, dict) else None,
+             "prefix_hit_rate":
+             serving_prefix.get("prefix_cached", {}).get(
+                 "prefix_hit_rate")
+             if isinstance(serving_prefix, dict) else None,
              "flashmask_16k_block_skip_speedup":
              flashmask.get("block_skip_speedup")
              if isinstance(flashmask, dict) else None},

@@ -17,11 +17,38 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   blocks to admitted requests and reclaims them at retirement, so HBM
   scales with live tokens, not ``slots x max_len``.
 - **Continuous batching.** ``step()`` admits queued requests into freed
-  slots (prefill compiled per power-of-two prompt bucket, K/V scattered
-  straight into the slot's blocks), decodes one token for every active
-  slot, streams tokens out, and retires slots on EOS/max-len — freed
-  blocks and slots are reused by the next admission without ever
-  draining the batch.
+  slots, decodes one token for every active slot, streams tokens out,
+  and retires slots on EOS/max-len — freed blocks and slots are reused
+  by the next admission without ever draining the batch.
+- **Chunked prefill — ONE executable.** Admission prefills the prompt
+  in fixed-size chunks (``ServingConfig.prefill_chunk``, default 128)
+  through the SAME multi-query paged path the speculative verify step
+  rides (``paged_verify_attention`` with ``T = chunk``): each chunk
+  writes its K/V into the slot's blocks and attends to every
+  previously cached block plus its own in-chunk causal prefix. The
+  chunk step is AOT-compiled ONCE per engine — ``ceil(n / C)`` chunk
+  calls replace the old per-power-of-two-bucket prefill zoo, so
+  ``serving_prefill_compiles`` collapses from O(#buckets) (x draft
+  copies) to O(1) and no prompt pays bucket padding. Optionally the
+  scheduler interleaves prefill chunks between decode steps
+  (``max_prefill_chunks_per_step > 0``) to bound head-of-line latency
+  for running requests. Kill switch ``PADDLE_TPU_CHUNKED_PREFILL=0``
+  restores the bucketed dense prefill.
+- **Prefix caching (content-addressed blocks).** The ``BlockAllocator``
+  keeps per-block refcounts and a content-hash index (rolling hash
+  chains over token ids, seeded by a model/config fingerprint —
+  ``ops/paged_cache.chain_hashes``). Retirement publishes the retired
+  sequence's FULL blocks into the index instead of dropping them; they
+  park in an LRU list until memory pressure evicts them. Admission
+  hashes the prompt's full blocks, maps the longest cached prefix
+  straight into the slot's block table (refcount++) and chunk-prefills
+  only the suffix — shared system prompts, few-shot headers and
+  multi-turn history prefill once per cache lifetime, not per request.
+  A shared block the suffix must write into (full-prompt hit) is
+  copy-on-write duplicated first (one device block copy). Greedy
+  outputs are token-exact vs the cold path. Kill switch:
+  ``PADDLE_TPU_PREFIX_CACHE=0``. See docs/OPS.md "Prefix caching &
+  chunked prefill".
 - **Ragged decode attention** reads the pool through the Pallas kernel
   on TPU (``ops/pallas/paged_attention.py``) and the gather fallback on
   CPU, behind the models' ordinary cached-attention path — the same
@@ -51,11 +78,16 @@ Telemetry (monitor registry, exported in the JSONL dump):
 ``serving_slot_occupancy`` gauge, ``serving_batch_utilization`` /
 ``serving_queue_wait_ms`` histograms, ``serving_tokens_total`` /
 ``serving_decode_steps`` / ``serving_decode_compiles`` /
-``serving_prefill_compiles`` / ``serving_requests_completed`` counters.
+``serving_prefill_compiles`` / ``serving_requests_completed`` /
+``serving_prefix_blocks_reused`` / ``serving_prefix_tokens_reused`` /
+``serving_cow_copies`` / ``serving_cache_evictions`` counters and the
+``serving_prefix_hit_rate`` gauge.
 """
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import os
 import time
 import warnings
 from collections import deque
@@ -101,12 +133,35 @@ class ServingConfig:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
-    min_prefill_bucket: int = 16        # smallest prompt bucket
+    min_prefill_bucket: int = 16        # smallest prompt bucket (legacy
+    #                                     bucketed prefill only)
     # speculative decoding: draft gamma tokens per slot per step and
     # verify them in one multi-token forward (0 = off)
     num_speculative_tokens: int = 0
     drafter: str = "ngram"              # ngram | model (pass draft_model)
     spec_ngram_max: int = 3             # longest prompt-lookup n-gram
+    # chunked prefill: ONE fixed-chunk AOT executable processes the
+    # prompt suffix in ceil(n / prefill_chunk) steps (multi-query paged
+    # attention, T = chunk). False (or PADDLE_TPU_CHUNKED_PREFILL=0)
+    # restores the per-bucket dense prefill.
+    chunked_prefill: bool = True
+    prefill_chunk: int = 128            # tokens per prefill chunk step
+    # content-addressed prefix reuse over the block pool (requires
+    # chunked prefill). False (or PADDLE_TPU_PREFIX_CACHE=0) disables
+    # hashing/publishing — blocks free eagerly as before.
+    enable_prefix_cache: bool = True
+    # > 0: admission leaves prefill pending and each engine tick
+    # advances at most this many chunk steps (across all pending slots)
+    # before decoding — bounds head-of-line latency for running
+    # requests at the cost of later first tokens. 0 = prefill whole
+    # prompts at admission.
+    max_prefill_chunks_per_step: int = 0
+    # False: retirement drops each request's token buffer instead of
+    # holding it for run() — REQUIRED for long-lived streaming
+    # deployments that consume tokens via stream_callback and drive
+    # step() themselves (otherwise finished results accumulate
+    # unboundedly; run() then returns {}).
+    retain_results: bool = True
 
 
 @dataclass
@@ -119,10 +174,11 @@ class ServingRequest:
 
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
-                 "last_token", "n_emitted", "max_new", "history")
+                 "last_token", "n_emitted", "max_new", "history",
+                 "prompt", "pend_pos", "pend_row")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
-                 max_new, history=None):
+                 max_new, history=None, prompt=None, pend_pos=None):
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -130,7 +186,13 @@ class _Slot:
         self.last_token = last_token
         self.n_emitted = 1              # prefill emitted the first token
         self.max_new = max_new
-        self.history = history          # prompt + emitted (spec drafter)
+        # prompt + emitted tokens: position p of the cache holds
+        # history[p] for p < cache_len — the n-gram drafter's lookup
+        # corpus AND the token stream retirement hashes full blocks of
+        self.history = history
+        self.prompt = prompt            # int32 prompt (pending chunks)
+        self.pend_pos = pend_pos        # next chunk start; None = done
+        self.pend_row = None            # device table row for chunks
 
 
 class ServingEngine:
@@ -226,6 +288,21 @@ class ServingEngine:
         # emitted token by up to gamma written-then-rolled-back slots
         self._gamma = gamma
         self._ngram_max = int(cfg.spec_ngram_max)
+        # chunked prefill + prefix caching switches: prefix reuse NEEDS
+        # the chunked path (the bucketed dense prefill recomputes and
+        # rewrites the whole prompt, so mapping cached blocks under it
+        # would save nothing and the scatter would clobber them)
+        self._chunked = bool(cfg.chunked_prefill) and \
+            os.environ.get("PADDLE_TPU_CHUNKED_PREFILL", "1") != "0"
+        self._prefix_on = self._chunked \
+            and bool(cfg.enable_prefix_cache) \
+            and os.environ.get("PADDLE_TPU_PREFIX_CACHE", "1") != "0"
+        self._chunk = max(1, min(int(cfg.prefill_chunk),
+                                 int(cfg.max_model_len)))
+        self._chunk_budget = int(cfg.max_prefill_chunks_per_step)
+        # content-hash chain seed: hashes are only comparable within
+        # one (model architecture, config, cache layout) world
+        self._fp = self._model_fingerprint(model)
         self._mb = _pc.blocks_for(cfg.max_model_len + gamma, self._bs)
         nb = (1 + cfg.num_slots * self._mb) if cfg.num_blocks is None \
             else int(cfg.num_blocks)
@@ -258,7 +335,11 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(int(cfg.seed))
         self._tables_dev = None         # device mirror of _tables
         self._decode_exec = None
-        self._prefill_execs = {}
+        self._prefill_execs = {}        # legacy bucketed prefill
+        self._chunk_exec = None         # the ONE chunked-prefill exec
+        self._draft_chunk_exec = None
+        self._cow_exec = None           # copy-on-write block duplicate
+        self._draft_cow_exec = None
         # per-engine counts (the monitor counters below are process-
         # global telemetry shared by every engine; stats() must report
         # THIS engine)
@@ -266,6 +347,13 @@ class ServingEngine:
         self._n_decode_steps = 0
         self._n_tokens = 0
         self._n_completed = 0
+        self._n_prefill_compiles = 0
+        self._n_prefill_chunks = 0
+        self._n_prefix_blocks = 0       # cached blocks mapped into slots
+        self._n_prefix_tokens = 0       # prompt tokens NOT re-prefilled
+        self._n_prompt_tokens = 0       # prompt tokens admitted
+        self._n_cow = 0
+        self._n_evictions_seen = 0
         self._n_spec_proposed = 0
         self._n_spec_accepted = 0
         self._n_spec_verifies = 0       # per-slot verify windows
@@ -293,6 +381,23 @@ class ServingEngine:
             labels=("bucket",))
         self._m_completed = monitor.counter(
             "serving_requests_completed", "requests fully served")
+        self._m_prefix_blocks = monitor.counter(
+            "serving_prefix_blocks_reused",
+            "cached KV blocks mapped into admitted slots")
+        self._m_prefix_tokens = monitor.counter(
+            "serving_prefix_tokens_reused",
+            "prompt tokens served from the prefix cache (not "
+            "re-prefilled)")
+        self._m_cow = monitor.counter(
+            "serving_cow_copies",
+            "copy-on-write block duplications (shared block appended "
+            "into)")
+        self._m_evict = monitor.counter(
+            "serving_cache_evictions",
+            "cached blocks evicted under memory pressure (LRU)")
+        self._m_hit_rate = monitor.gauge(
+            "serving_prefix_hit_rate",
+            "cumulative reused / admitted prompt tokens")
         if gamma:
             self._m_spec_len = monitor.histogram(
                 "serving_spec_accepted_len",
@@ -351,7 +456,9 @@ class ServingEngine:
         if self._gamma:
             return self._step_spec()
         emitted = self._admit()
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        self._advance_prefills(emitted)
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.pend_pos is None]
         if not active:
             return emitted
         self._ensure_blocks(active)
@@ -382,6 +489,7 @@ class ServingEngine:
             slot.cache_len += 1
             slot.last_token = tok
             slot.n_emitted += 1
+            slot.history.append(tok)
             self._emit(slot.rid, tok)
             emitted.append((slot.rid, tok))
             if tok == self._eos or slot.n_emitted >= slot.max_new:
@@ -399,7 +507,9 @@ class ServingEngine:
         plus ``_trim_blocks`` returning overhang blocks."""
         from ..generation import speculative as _spec
         emitted = self._admit()
-        active = [i for i, s in enumerate(self._slots) if s is not None]
+        self._advance_prefills(emitted)
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.pend_pos is None]
         if not active:
             return emitted
         g = self._gamma
@@ -505,6 +615,7 @@ class ServingEngine:
         """Scheduler/counter snapshot (tests + ops dashboards). In
         speculative mode ``decode_steps``/``decode_compiles`` count the
         verify executable (the spec-mode decode step)."""
+        self._sync_cache_metrics()
         out = {
             "active": self.num_active,
             "queued": self.num_queued,
@@ -514,6 +625,18 @@ class ServingEngine:
             "decode_compiles": self._n_decode_compiles,
             "tokens_total": self._n_tokens,
             "requests_completed": self._n_completed,
+            "prefill_compiles": self._n_prefill_compiles,
+            "prefill_chunks": self._n_prefill_chunks,
+            "chunked_prefill": self._chunked,
+            "prefix_cache_enabled": self._prefix_on,
+            "prefix_blocks_reused": self._n_prefix_blocks,
+            "prefix_tokens_reused": self._n_prefix_tokens,
+            "prefix_hit_rate":
+                self._n_prefix_tokens / self._n_prompt_tokens
+                if self._n_prompt_tokens else 0.0,
+            "cow_copies": self._n_cow,
+            "cache_evictions": self._alloc.evictions,
+            "cached_blocks": self._alloc.cached_blocks,
         }
         if self._gamma:
             out.update({
@@ -527,6 +650,39 @@ class ServingEngine:
                     if self._n_spec_verifies else 0.0,
             })
         return out
+
+    def shutdown(self, check_leaks: bool = True) -> bool:
+        """Engine teardown hook (tests / graceful ops restarts):
+        sweeps the allocator's invariants — every block must be exactly
+        one of free, LRU-cached, or owned by a live slot, with a
+        bijective hash index — raising RuntimeError on any leak or
+        double-accounting. Call after draining (or at any quiescent
+        point; live slots' blocks are passed as the expected live
+        set)."""
+        if check_leaks:
+            live = [b for s in self._slots if s is not None
+                    for b in s.blocks]
+            self._alloc.check_leaks(live)
+        return True
+
+    @staticmethod
+    def _model_fingerprint(model) -> bytes:
+        """Seed for the content-hash chains: two caches may share
+        blocks only when the model architecture + config (and thus the
+        K/V a token sequence produces) agree. Per-engine pools make
+        cross-model collisions impossible today; the fingerprint keeps
+        the hash space partitioned if the index is ever externalized."""
+        import dataclasses
+        desc = [type(model).__name__]
+        cfg = getattr(model, "config", None)
+        if cfg is not None:
+            try:
+                fields = dataclasses.asdict(cfg)
+            except TypeError:
+                fields = dict(vars(cfg))
+            desc.append(repr(sorted(fields.items())))
+        return hashlib.blake2b("\x1f".join(desc).encode(),
+                               digest_size=16).digest()
 
     # -- scheduler internals ------------------------------------------
 
@@ -558,42 +714,223 @@ class ServingEngine:
             n_real = int(req.prompt.size)
             worst = _pc.blocks_for(
                 n_real + req.max_new_tokens + self._gamma, self._bs)
-            init = _pc.blocks_for(n_real, self._bs)
             # worst-case reservation: admit only what can NEVER run the
             # pool dry mid-decode (FIFO — no head-of-line bypass, which
-            # keeps "every request completes exactly once" trivial)
+            # keeps "every request completes exactly once" trivial).
+            # free_blocks counts LRU-cached blocks (evictable on
+            # demand), so the prefix cache never blocks admission.
             if self._alloc.free_blocks - self._reserved < worst:
                 break
             self._queue.popleft()
             i = free[0]
-            blocks = self._alloc.alloc(init)
-            self._reserved += worst - init
+            blocks, cached = self._map_prefix(req.prompt, n_real)
+            self._reserved += worst - len(blocks)
             self._tables[i, :] = 0
-            self._tables[i, :init] = blocks
+            if not (self._chunked and self._chunk_budget > 0):
+                # interleaved prefill keeps the GLOBAL table row null
+                # until the prefill completes: the batched decode step
+                # masks pending slots by table (null-block writes/reads
+                # are harmless by construction, exactly like inactive
+                # slots); the chunk executable reads its row from
+                # ``slot.blocks`` directly
+                self._tables[i, :len(blocks)] = blocks
             self._tables_dev = None
             # observe BEFORE prefill so the histogram measures queue
             # wait, not prefill execution/compile time
             self._m_queue_wait.observe(
                 1000.0 * (time.monotonic() - req.submit_time))
             self._results[req.request_id] = []
-            tok = self._prefill(i, req, n_real)
-            history = list(map(int, req.prompt)) + [tok] \
-                if self._gamma else None
-            self._slots[i] = _Slot(req.request_id, blocks, worst,
-                                   n_real, tok, req.max_new_tokens,
-                                   history=history)
-            self._emit(req.request_id, tok)
-            emitted.append((req.request_id, tok))
+            self._slots[i] = _Slot(
+                req.request_id, blocks, worst, cached, None,
+                req.max_new_tokens,
+                history=list(map(int, req.prompt)),
+                prompt=np.asarray(req.prompt, np.int32),
+                pend_pos=cached)
             self._m_occupancy.set(self.num_active)
-            if tok == self._eos or req.max_new_tokens <= 1:
-                self._retire(i)
+            if not self._chunked:
+                tok = self._prefill_bucketed(i, req, n_real)
+                self._finish_prefill(i, tok, emitted)
+            else:
+                # a shared suffix-boundary block (full-prompt cache
+                # hit) must be copy-on-write duplicated before the
+                # recomputed last token's K/V lands in it
+                bidx = cached // self._bs
+                if self._alloc.is_shared(blocks[bidx]):
+                    self._cow(i, bidx)
+                if self._chunk_budget <= 0:
+                    tok = self._advance_prefill(i)
+                    self._finish_prefill(i, tok, emitted)
+                # else: prefill chunks advance inside step() ticks,
+                # interleaved with running slots' decode
+        self._sync_cache_metrics()
         return emitted
 
-    def _prefill(self, i, req, n_real) -> int:
-        """Run the bucketed prefill for slot ``i``: dense cached forward
-        over the right-padded prompt, K/V scattered into the slot's
-        blocks, first token selected at the prompt's true last
-        position."""
+    def _map_prefix(self, prompt, n_real):
+        """Map the longest cached prefix of ``prompt`` — leading FULL
+        blocks whose rolling content hashes hit the allocator's index
+        get refcount++'d straight into the slot's block list — then
+        allocate fresh blocks for the remainder. Returns ``(blocks,
+        cached_tokens)``. ``cached_tokens`` is block-aligned except on
+        a full-prompt hit, where the last prompt token is recomputed
+        anyway (admission must produce first-token logits) and its
+        shared block is COW-duplicated by the caller before the
+        write."""
+        init = _pc.blocks_for(n_real, self._bs)
+        matched = []
+        if self._prefix_on:
+            # lazy hashing: a cache-cold prompt stops at block 0
+            for h in _pc.iter_chain_hashes(self._fp, prompt, self._bs):
+                b = self._alloc.lookup(h)
+                if b is None:
+                    break
+                matched.append(self._alloc.ref(b))
+        cached = len(matched) * self._bs
+        if cached >= n_real:                     # full-prompt hit
+            cached = n_real - 1
+        if matched:
+            self._n_prefix_blocks += len(matched)
+            self._n_prefix_tokens += cached
+            self._m_prefix_blocks.inc(len(matched))
+            self._m_prefix_tokens.inc(cached)
+        self._n_prompt_tokens += n_real
+        if self._prefix_on:
+            self._m_hit_rate.set(
+                self._n_prefix_tokens / self._n_prompt_tokens)
+        fresh = self._alloc.alloc(init - len(matched)) \
+            if init > len(matched) else []
+        return matched + fresh, cached
+
+    def _cow(self, i, bidx):
+        """Copy-on-write: duplicate the shared block at table position
+        ``bidx`` of slot ``i`` into a fresh block (ONE device block
+        copy per pool — target and draft pools share block ids), swap
+        it into the table, and drop this slot's reference on the
+        original (which stays intact for the cache / its other
+        holders)."""
+        slot = self._slots[i]
+        old = slot.blocks[bidx]
+        (new,) = self._alloc.alloc(1)
+        if self._cow_exec is None:
+            self._cow_exec = self._compile_cow(self._pools)
+        with _quiet_donation():
+            self._pools = self._cow_exec(
+                self._pools, jnp.asarray(old, jnp.int32),
+                jnp.asarray(new, jnp.int32))
+        if self._draft_model is not None:
+            if self._draft_cow_exec is None:
+                self._draft_cow_exec = self._compile_cow(self._dpools)
+            with _quiet_donation():
+                self._dpools = self._draft_cow_exec(
+                    self._dpools, jnp.asarray(old, jnp.int32),
+                    jnp.asarray(new, jnp.int32))
+        self._alloc.free([old])
+        slot.blocks[bidx] = new
+        slot.pend_row = None                 # (always pre-chunk today)
+        if self._tables[i, bidx] == old:     # row may be null (pending)
+            self._tables[i, bidx] = new
+            self._tables_dev = None
+        self._n_cow += 1
+        self._m_cow.inc()
+
+    def _advance_prefill(self, i, budget=None):
+        """Run up to ``budget`` chunk steps (None = to completion) of
+        slot ``i``'s pending prompt suffix through the ONE compiled
+        chunk executable. Returns the sampled first token when the
+        prefill completes, else None."""
+        slot = self._slots[i]
+        if self._chunk_exec is None:
+            self._chunk_exec = self._compile_chunk(self._next_key())
+        if self._draft_model is not None \
+                and self._draft_chunk_exec is None:
+            self._draft_chunk_exec = self._compile_draft_chunk()
+        c = self._chunk
+        n_real = int(slot.prompt.size)
+        if slot.pend_row is None:
+            # the row is invariant for the prefill's lifetime (the one
+            # possible COW happens at admission, before any chunk) —
+            # upload it once, not per interleaved tick
+            row = np.zeros((self._mb,), np.int32)
+            row[:len(slot.blocks)] = slot.blocks
+            slot.pend_row = jnp.asarray(row)
+        table_dev = slot.pend_row
+        while budget is None or budget > 0:
+            part = slot.prompt[slot.pend_pos:slot.pend_pos + c]
+            ids = np.full((1, c), self._pad, np.int32)
+            ids[0, :part.size] = part
+            ids_dev = jnp.asarray(ids)
+            pos = jnp.asarray(slot.pend_pos, jnp.int32)
+            with _quiet_donation():
+                tok, self._pools = self._chunk_exec(
+                    self._params, ids_dev, self._pools, table_dev,
+                    pos, jnp.asarray(int(part.size) - 1, jnp.int32),
+                    self._next_key())
+            if self._draft_model is not None:
+                # prime the draft cache over the same positions (its
+                # pools ride the same block table)
+                with _quiet_donation():
+                    self._dpools = self._draft_chunk_exec(
+                        self._dparams, ids_dev, self._dpools,
+                        table_dev, pos)
+            self._n_prefill_chunks += 1
+            slot.pend_pos += int(part.size)
+            slot.cache_len = slot.pend_pos
+            if budget is not None:
+                budget -= 1
+            if slot.pend_pos >= n_real:
+                slot.pend_pos = None
+                slot.pend_row = None
+                return int(tok)
+        return None
+
+    def _advance_prefills(self, emitted):
+        """Interleaved-prefill tick: spend the per-step chunk budget
+        across pending slots (lowest slot index first), finishing
+        admissions whose last chunk lands."""
+        if self._chunk_budget <= 0:
+            return
+        budget = self._chunk_budget
+        for i, s in enumerate(self._slots):
+            if budget <= 0:
+                break
+            if s is None or s.pend_pos is None:
+                continue
+            n0 = self._n_prefill_chunks
+            tok = self._advance_prefill(i, budget)
+            budget -= self._n_prefill_chunks - n0
+            if tok is not None:
+                self._finish_prefill(i, tok, emitted)
+
+    def _finish_prefill(self, i, tok, emitted):
+        """Shared admission epilogue (synchronous and interleaved
+        prefill): record and emit the first token, retire immediately
+        on EOS / max_new_tokens == 1."""
+        slot = self._slots[i]
+        slot.cache_len = int(slot.prompt.size)
+        slot.pend_pos = None
+        if self._tables[i, 0] == 0:          # interleaved: publish the
+            self._tables[i, :len(slot.blocks)] = slot.blocks   # row now
+            self._tables_dev = None
+        slot.last_token = tok
+        slot.history.append(tok)
+        self._emit(slot.rid, tok)
+        emitted.append((slot.rid, tok))
+        if tok == self._eos or slot.max_new <= 1:
+            self._retire(i)
+
+    def _sync_cache_metrics(self):
+        """Mirror allocator-side eviction counts into the monitor
+        registry (the allocator stays monitor-free)."""
+        d = self._alloc.evictions - self._n_evictions_seen
+        if d:
+            self._m_evict.inc(d)
+            self._n_evictions_seen = self._alloc.evictions
+
+    def _prefill_bucketed(self, i, req, n_real) -> int:
+        """Legacy bucketed prefill (``PADDLE_TPU_CHUNKED_PREFILL=0`` /
+        ``chunked_prefill=False``): dense cached forward over the
+        right-padded prompt at a power-of-two bucket, K/V scattered
+        into the slot's blocks, first token selected at the prompt's
+        true last position. One compile per bucket."""
         bucket = self._bucket(n_real)
         ids = np.full((1, bucket), self._pad, np.int32)
         ids[0, :n_real] = req.prompt
@@ -659,13 +996,30 @@ class ServingEngine:
 
     def _retire(self, i):
         slot = self._slots[i]
+        if self._prefix_on and slot.cache_len >= self._bs:
+            # publish the retired sequence's FULL blocks into the
+            # content index instead of just dropping them: the hash
+            # chain runs over the tokens the cache actually holds
+            # (prompt + committed continuation — position p holds
+            # history[p] for p < cache_len), so a future prompt sharing
+            # the prefix maps these blocks instead of re-prefilling.
+            # Blocks go to the LRU cached list when their refcount hits
+            # 0 below and survive until memory pressure evicts them.
+            n_full = min(len(slot.blocks), slot.cache_len // self._bs)
+            for b, h in zip(slot.blocks[:n_full],
+                            _pc.chain_hashes(
+                                self._fp,
+                                slot.history[:n_full * self._bs],
+                                self._bs)):
+                self._alloc.publish(b, h)
         self._alloc.free(slot.blocks)
         self._reserved -= slot.worst_blocks - len(slot.blocks)
         self._tables[i, :] = 0
         self._tables_dev = None
         self._slots[i] = None
-        self._done[slot.rid] = np.asarray(self._results.pop(slot.rid),
-                                          np.int64)
+        toks = self._results.pop(slot.rid)
+        if self.config.retain_results:
+            self._done[slot.rid] = np.asarray(toks, np.int64)
         self._m_completed.inc()
         self._n_completed += 1
         self._m_occupancy.set(self.num_active)
@@ -697,6 +1051,75 @@ class ServingEngine:
         self._n_decode_compiles += 1
         return exec_
 
+    def _compile_chunk(self, key):
+        """AOT-compile THE fixed-chunk prefill step ONCE (the whole
+        prefill zoo, collapsed): ``[1, C]`` token ids run the same
+        multi-query paged machinery as the speculative verify window
+        (``paged_verify_attention`` with ``T = C`` query rows at
+        ``cache_len + t``) — each row attends to every previously
+        cached block plus its own in-chunk causal prefix, and K/V are
+        written into the slot's blocks as the chunk executes. The next
+        token is sampled at the chunk's last REAL row (non-final chunks
+        ignore it). Pad rows of a short final chunk write past the
+        table's reach (routed to the null block by ``write_tokens``)
+        and are never read, so ONE executable serves every prompt
+        length with zero padding-bucket waste."""
+        c = self._chunk
+
+        def chunk(params, ids, pools, table_row, pos, last, key):
+            lens = jnp.reshape(pos.astype(jnp.int32), (1,))
+            logits, pools = self._model_step(
+                params, ids, pools, None,
+                block_tables=table_row[None], cache_lens=lens)
+            row = jax.lax.dynamic_slice_in_dim(
+                logits, last, 1, axis=1)[:, 0, :]
+            _, sub = jax.random.split(key)
+            tok, _ = self._select(row, sub)
+            return tok[0], pools
+
+        jitted = jax.jit(chunk, donate_argnums=(2,))
+        with _quiet_donation():
+            exec_ = jitted.lower(
+                self._params, jnp.zeros((1, c), jnp.int32), self._pools,
+                jnp.zeros((self._mb,), jnp.int32),
+                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                key).compile()
+        self._m_prefill_compiles.labels(bucket=f"chunk{c}").inc()
+        self._n_prefill_compiles += 1
+        return exec_
+
+    def _compile_draft_chunk(self):
+        """Draft-cache twin of ``_compile_chunk``: write the draft
+        model's K/V for the same chunk positions through the SAME block
+        table row (no token is selected — the target picks the first
+        token). Also compiled exactly once."""
+        c = self._chunk
+
+        def dchunk(dparams, ids, dpools, table_row, pos):
+            lens = jnp.reshape(pos.astype(jnp.int32), (1,))
+            _, dpools = self._draft_step(
+                dparams, ids, dpools, None,
+                block_tables=table_row[None], cache_lens=lens)
+            return dpools
+
+        jitted = jax.jit(dchunk, donate_argnums=(2,))
+        with _quiet_donation():
+            exec_ = jitted.lower(
+                self._dparams, jnp.zeros((1, c), jnp.int32),
+                self._dpools, jnp.zeros((self._mb,), jnp.int32),
+                jnp.zeros((), jnp.int32)).compile()
+        self._m_prefill_compiles.labels(bucket=f"draft-chunk{c}").inc()
+        self._n_prefill_compiles += 1
+        return exec_
+
+    def _compile_cow(self, pools):
+        """AOT-compile the copy-on-write block duplicate (src/dst ride
+        as traced scalars — one executable serves every COW)."""
+        jitted = jax.jit(_pc.copy_blocks, donate_argnums=(0,))
+        with _quiet_donation():
+            return jitted.lower(pools, jnp.zeros((), jnp.int32),
+                                jnp.zeros((), jnp.int32)).compile()
+
     def _compile_prefill(self, bucket, key):
         def prefill(params, ids, n_real, pools, table_row, key):
             dense = self.model.init_caches(1, bucket)
@@ -719,6 +1142,7 @@ class ServingEngine:
                 jnp.zeros((), jnp.int32), self._pools,
                 jnp.zeros((self._mb,), jnp.int32), key).compile()
         self._m_prefill_compiles.labels(bucket=bucket).inc()
+        self._n_prefill_compiles += 1
         return exec_
 
     def _compile_verify(self, lens, toks, dq, key):
@@ -786,4 +1210,5 @@ class ServingEngine:
                 jnp.zeros((self._mb,), jnp.int32)).compile()
         self._m_prefill_compiles.labels(
             bucket=f"draft-{bucket}").inc()
+        self._n_prefill_compiles += 1
         return exec_

@@ -149,10 +149,15 @@ def paged_attention_decode(qh, kh, vh, k_pool, v_pool, block_tables,
     positions ``cache_lens[s] + t``, then attend q against each slot's
     length-bounded block list through the ragged paged kernel
     (``ops/pallas/paged_attention.py``; gather fallback off-TPU).
-    ``T = 1`` is the continuous-batching decode step; ``T > 1`` is the
-    speculative verify window (causal within the window — token ``t``
-    sees ``cache_lens[s] + t + 1`` positions). Prefill goes through
-    the dense cached path + ``ops.paged_cache.write_prefill``.
+    ``T = 1`` is the continuous-batching decode step; ``T > 1`` is
+    both the speculative verify window AND the serving engine's
+    chunked prefill (``T = prefill_chunk``) — causal within the
+    window: token ``t`` sees ``cache_lens[s] + t + 1`` positions,
+    which over a prompt chunk starting at ``cache_lens`` IS exact
+    causal prefill against the already-cached blocks (including
+    blocks mapped from the prefix cache). Only ``generate()``'s
+    one-program paged loop still prefills through the dense cached
+    path + ``ops.paged_cache.write_prefill``.
     Returns (out [S, T, H, D], new_k_pool, new_v_pool)."""
     from ..ops.paged_cache import write_decode, write_tokens
     from ..ops.pallas.paged_attention import (paged_decode_attention,

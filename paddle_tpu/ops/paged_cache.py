@@ -18,18 +18,33 @@ their (masked, discarded) reads and writes stay in-bounds without any
 dynamic shape or host-side branch.
 
 Device ops (pure jax, jit-safe) live here next to a host-side
-``BlockAllocator`` (plain free-list) that the serving scheduler uses to
-admit/retire slots. The ragged decode attention that READS this layout
-is ``ops/pallas/paged_attention.py``.
+``BlockAllocator`` that the serving scheduler uses to admit/retire
+slots. The allocator is **content-addressed** (vLLM-style automatic
+prefix caching on the block granularity): every block carries a
+refcount, a retired sequence's FULL blocks are published under a
+rolling content hash (``chain_hashes`` — a hash chain over token ids
+seeded by a model/config fingerprint, so block ``i``'s hash commits to
+the entire prefix through it), and freed-but-published blocks park in
+an LRU side-list where they stay reusable until memory pressure
+evicts them. A later request whose prompt prefix hashes to cached
+blocks maps them straight into its block table (refcount++) and only
+prefills the suffix; a shared block that must be appended into is
+copy-on-write duplicated (``copy_blocks`` — one device block copy).
+The ragged decode attention that READS this layout is
+``ops/pallas/paged_attention.py``.
 """
 from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
 
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "write_prefill", "write_decode", "write_tokens",
-           "gather_dense"]
+           "gather_dense", "chain_hashes", "iter_chain_hashes",
+           "copy_blocks"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -42,10 +57,22 @@ def blocks_for(n_tokens: int, block_size: int) -> int:
 
 
 class BlockAllocator:
-    """Host-side free-list over block ids ``1..num_blocks-1`` (block 0
-    is the reserved null block). The serving scheduler allocates at
-    admission/growth and frees at retirement; the device never sees
-    this object — only the int32 tables it fills in."""
+    """Host-side refcounted, content-addressed allocator over block ids
+    ``1..num_blocks-1`` (block 0 is the reserved null block). The
+    serving scheduler allocates at admission/growth and frees at
+    retirement; the device never sees this object — only the int32
+    tables it fills in.
+
+    Block lifecycle: ``alloc`` hands out blocks at refcount 1; ``free``
+    decrements, and a block hitting refcount 0 either returns to the
+    plain free-list (unpublished) or parks in the **LRU cache list**
+    (published via ``publish`` — it keeps its content hash and stays
+    discoverable through ``lookup`` until ``alloc`` evicts it under
+    memory pressure, oldest first). ``lookup`` + ``ref`` map a cached
+    or live block into another sequence's table (prefix reuse);
+    ``is_shared`` tells the caller a block must be copy-on-write
+    duplicated before any in-place append (refcount > 1, or published
+    — the cache itself holds an interest in published content)."""
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
@@ -54,31 +81,165 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # LIFO reuse keeps hot blocks hot in HBM-side caches
         self._free = list(range(self.num_blocks - 1, NULL_BLOCK, -1))
+        self._refs = [0] * self.num_blocks
+        self._hash_of = {}          # published block id -> content hash
+        self._by_hash = {}          # content hash -> block id (bijective)
+        self._lru = OrderedDict()   # refcount-0 published blocks, LRU->MRU
+        self.evictions = 0          # cached blocks reclaimed by alloc()
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + evictable cached (admission
+        reservations treat the LRU cache as free — eviction is
+        transparent)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Refcount-0 published blocks parked in the LRU list."""
+        return len(self._lru)
 
     def alloc(self, n: int = 1):
-        """Pop ``n`` block ids; raises when the pool is exhausted (the
-        scheduler's admission reservation should make this unreachable
-        in steady state)."""
-        if n > len(self._free):
+        """Pop ``n`` block ids, evicting LRU cached blocks when the
+        plain free-list runs short; raises when even the cache cannot
+        cover it (the scheduler's admission reservation should make
+        this unreachable in steady state)."""
+        if n > self.free_blocks:
             raise RuntimeError(
                 f"paged KV pool exhausted: want {n} blocks, "
-                f"{len(self._free)} free of {self.num_blocks - 1}")
+                f"{self.free_blocks} free of {self.num_blocks - 1}")
+        while len(self._free) < n:
+            b, _ = self._lru.popitem(last=False)     # oldest first
+            self._by_hash.pop(self._hash_of.pop(b), None)
+            self.evictions += 1
+            self._free.append(b)
         out = self._free[-n:][::-1]
         del self._free[-n:]
+        for b in out:
+            self._refs[b] = 1
         return out
 
     def free(self, block_ids):
+        """Drop one reference per block; refcount 0 parks published
+        blocks in the LRU cache and returns the rest to the free-list."""
         for b in block_ids:
             b = int(b)
             if not (NULL_BLOCK < b < self.num_blocks):
                 raise ValueError(f"freeing invalid block id {b}")
-            if b in self._free:
+            if self._refs[b] <= 0:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                if b in self._hash_of:
+                    self._lru[b] = None
+                    self._lru.move_to_end(b)         # MRU end
+                else:
+                    self._free.append(b)
+
+    def ref(self, block_id: int) -> int:
+        """Take one more reference on a live or cached block (prefix
+        reuse: map it into another slot's table). A cached block leaves
+        the LRU list — it is live again."""
+        b = int(block_id)
+        if not (NULL_BLOCK < b < self.num_blocks):
+            raise ValueError(f"ref of invalid block id {b}")
+        if self._refs[b] == 0:
+            if b not in self._lru:
+                raise ValueError(f"ref of free block {b}")
+            del self._lru[b]
+        self._refs[b] += 1
+        return b
+
+    def refcount(self, block_id: int) -> int:
+        return self._refs[int(block_id)]
+
+    def is_shared(self, block_id: int) -> bool:
+        """True when an in-place append into the block would be visible
+        beyond the caller: more than one reference, or published (the
+        hash index may hand it to a future request) — the caller must
+        copy-on-write first."""
+        b = int(block_id)
+        return self._refs[b] > 1 or b in self._hash_of
+
+    def lookup(self, content_hash):
+        """Block id published under ``content_hash``, or None. The
+        block may be cached (refcount 0) or live inside other slots;
+        either way ``ref`` it before mapping."""
+        return self._by_hash.get(content_hash)
+
+    def publish(self, block_id: int, content_hash) -> bool:
+        """Register a live block's content hash so future prompts can
+        reuse it (call before ``free`` at retirement). First writer
+        wins: when the hash already maps to another block (identical
+        concurrent sequences), or the block is already published, the
+        call is a no-op returning whether THIS block backs the hash."""
+        b = int(block_id)
+        if self._refs[b] <= 0:
+            raise ValueError(f"publishing dead block {b}")
+        if content_hash in self._by_hash:
+            return self._by_hash[content_hash] == b
+        if b in self._hash_of:
+            return False
+        self._by_hash[content_hash] = b
+        self._hash_of[b] = content_hash
+        return True
+
+    def check_leaks(self, live_blocks=()):
+        """Debug invariant sweep (engine shutdown in tests): every
+        block is exactly one of {free, LRU-cached, referenced}, the
+        referenced set equals ``live_blocks``, and the hash index is
+        bijective. Raises RuntimeError on any violation."""
+        live = {int(b) for b in live_blocks}
+        free = set(self._free)
+        cached = set(self._lru)
+        if free & cached:
+            raise RuntimeError(
+                f"blocks both free and cached: {sorted(free & cached)}")
+        refd = {b for b in range(1, self.num_blocks) if self._refs[b] > 0}
+        if refd & (free | cached):
+            raise RuntimeError(
+                "referenced blocks on a free/cache list: "
+                f"{sorted(refd & (free | cached))}")
+        lost = set(range(1, self.num_blocks)) - free - cached - refd
+        if lost:
+            raise RuntimeError(f"leaked blocks (unreachable): "
+                               f"{sorted(lost)}")
+        if refd != live:
+            raise RuntimeError(
+                f"live-block mismatch: allocator holds {sorted(refd)}, "
+                f"caller expects {sorted(live)}")
+        for b, h in self._hash_of.items():
+            if self._by_hash.get(h) != b:
+                raise RuntimeError(f"hash index not bijective at "
+                                   f"block {b}")
+        for b in cached:
+            if b not in self._hash_of:
+                raise RuntimeError(f"cached block {b} has no hash")
+        return True
+
+
+def iter_chain_hashes(seed: bytes, tokens, block_size: int):
+    """Lazy ``chain_hashes``: yields the per-full-block hashes one at a
+    time, so a consumer that stops at the first cache miss (the
+    admission prefix walk) never pays for hashing the whole prompt."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    bs = int(block_size)
+    h = bytes(seed)
+    for i in range(len(toks) // bs):
+        m = hashlib.blake2b(h, digest_size=16)
+        m.update(toks[i * bs:(i + 1) * bs].tobytes())
+        h = m.digest()
+        yield h
+
+
+def chain_hashes(seed: bytes, tokens, block_size: int):
+    """Rolling per-FULL-block content hashes: ``h_i = H(h_{i-1} ||
+    tokens[i*bs:(i+1)*bs])`` with ``h_{-1} = seed`` (the model/config
+    fingerprint). Because each hash chains over everything before it,
+    equal hashes mean equal *prefixes through that block* — the
+    soundness condition for block-granular prefix sharing. Partial
+    trailing blocks are never hashed (they are never shared)."""
+    return list(iter_chain_hashes(seed, tokens, block_size))
 
 
 def init_pool(num_blocks: int, block_size: int, num_kv_heads: int,
@@ -141,17 +302,37 @@ def write_tokens(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
     bookkeeping — positions at/after ``cache_lens`` are masked out of
     every attention read and are overwritten by the next append at the
     same positions. Inactive slots' tables hold the null block, so
-    their writes are harmless by construction."""
+    their writes are harmless by construction. Positions past the
+    table's reach (chunked prefill right-pads the final chunk, so its
+    pad tokens can overrun ``MB * block_size``) are routed to the null
+    block instead of letting the gather clamp silently target the
+    slot's LAST block."""
     t = k_new.shape[1]
     bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
     lens = cache_lens.astype(jnp.int32)
     pos = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    blk = pos // bs
     bi = jnp.take_along_axis(block_tables.astype(jnp.int32),
-                             pos // bs, axis=1)               # [S, T]
+                             jnp.minimum(blk, mb - 1), axis=1)  # [S, T]
+    bi = jnp.where(blk < mb, bi, NULL_BLOCK)
     off = pos % bs
     k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool
+
+
+def copy_blocks(pools, src, dst):
+    """Copy-on-write device op: duplicate block ``src`` into ``dst``
+    across every layer's (k_pool, v_pool) pair. ``src``/``dst`` are
+    traced int32 scalars, so ONE jitted executable (donate the pools)
+    serves every COW — the cost is a single block's K/V bytes per
+    layer, no host roundtrip. The caller then swaps ``dst`` into the
+    slot's block table and drops its reference on ``src``."""
+    out = []
+    for kp, vp in pools:
+        out.append((kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])))
+    return out
 
 
 def gather_dense(pool, block_tables):

@@ -31,6 +31,14 @@ at its own. Same grid, same scalar-prefetch block-table chasing; the
 only kernel delta is ``t_q * rep`` softmax rows with a per-row length
 bound instead of ``rep`` rows with one shared bound (the single-token
 decode kernel is the ``t_q = 1`` instantiation of the same body).
+
+CHUNKED PREFILL is the same multi-query variant at ``T = chunk``
+(serving's one fixed-chunk prefill executable,
+``inference/serving.py``): a chunk of the prompt enters as T query
+rows at ``cache_lens + t``, attending to every previously cached
+block (possibly mapped from the content-addressed prefix cache) plus
+its own in-chunk causal prefix — prefill, verify, and decode are one
+kernel body at three ``t_q`` widths.
 """
 from __future__ import annotations
 

@@ -46,6 +46,15 @@ def test_block_allocator_reuse_and_errors():
         a.free([got[0]])
     with pytest.raises(ValueError, match="invalid"):
         a.free([0])                    # the null block is never freed
+    # prefix-cache back-compat contract: a freed-but-PUBLISHED block
+    # parks in the LRU cache yet still counts as free (admission
+    # reservations see it; alloc reclaims it transparently)
+    a.publish(got[3], b"h3")
+    a.free([got[3]])
+    assert a.free_blocks == 4 and a.cached_blocks == 1
+    assert a.lookup(b"h3") == got[3]
+    a.alloc(4)                         # eviction makes it allocatable
+    assert a.lookup(b"h3") is None and a.evictions == 1
 
 
 def test_paged_write_gather_roundtrip():
@@ -272,6 +281,27 @@ def test_serving_gpt_family(llama_tiny):
     for p, got in zip(prompts, outs):
         ref = _dense_ref(m, p, 4)
         np.testing.assert_array_equal(got, ref[:len(got)])
+
+
+def test_serving_streaming_mode_drops_results(llama_tiny):
+    """``retain_results=False`` (long-lived streaming deployments):
+    tokens reach the callback but retirement drops the per-request
+    buffer — nothing accumulates, ``run()`` returns {}."""
+    rng = np.random.RandomState(13)
+    streamed = {}
+    eng = ServingEngine(
+        llama_tiny,
+        ServingConfig(num_slots=2, block_size=8, max_model_len=64,
+                      retain_results=False),
+        stream_callback=lambda rid, t: streamed.setdefault(rid, [])
+        .append(t))
+    rids = [eng.submit(rng.randint(1, 128, (n,)), 4) for n in (5, 9, 3)]
+    done = eng.run()
+    assert done == {}
+    assert eng._done == {} and eng._results == {}
+    for rid in rids:
+        assert 1 <= len(streamed[rid]) <= 4
+    assert eng.stats()["requests_completed"] == 3
 
 
 def test_serving_validates_requests(llama_tiny):
