@@ -783,6 +783,129 @@ def _prefix_serving_bench():
     return out
 
 
+def _tp_serving_bench_impl():
+    """Tensor-parallel serving scaling (the ISSUE-6 bar): the SAME
+    mixed-length workload through ``ServingEngine`` at tp in {1, 2, 4}
+    — every executable sharded over the ``mp`` mesh axis, KV pool split
+    on kv_heads, one explicit logits all_gather per step. Reports
+    aggregate tok/s, p50/p99 step latency, ``recompiles_measured``
+    (must stay 0 under TP), per-step collective payload bytes, and
+    scaling efficiency vs tp=1. On a CPU host-device mesh the absolute
+    ratios are a STRUCTURE proxy only (shared cores, software
+    collectives — flagged ``cpu_mesh_proxy``); the >= 1.6x tp=2 bar is
+    a real-multi-chip expectation, like the MULTICHIP axis table."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_TP_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_TP_HIDDEN", 1024)),
+        intermediate_size=int(os.environ.get("BENCH_TP_FFN", 2816)),
+        num_hidden_layers=int(os.environ.get("BENCH_TP_LAYERS", 4)),
+        num_attention_heads=16,
+        num_key_value_heads=8, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_TP_SLOTS", 8))
+    new = int(os.environ.get("BENCH_TP_NEW", 64))
+    n_req = int(os.environ.get("BENCH_TP_REQS", 16))
+    plens = [32, 64, 96, 48, 128, 24]
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (plens[i % len(plens)],))
+               for i in range(n_req)]
+    n_dev = len(jax.devices())
+    degrees = [t for t in (1, 2, 4)
+               if t <= n_dev and cfg.num_key_value_heads % t == 0]
+
+    def run_engine(tp):
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=512,
+            max_new_tokens=new, tp_degree=tp))
+        eng.serve([rng.randint(1, cfg.vocab_size, (p,))
+                   for p in plens[:2]], max_new_tokens=4)
+        compiles0 = eng.stats()["decode_compiles"]
+        tokens0 = eng.stats()["tokens_total"]
+        for p in prompts:
+            eng.submit(p, new)
+        step_ms = []
+        t0 = time.perf_counter()
+        while eng.num_queued or eng.num_active:
+            s0 = time.perf_counter()
+            eng.step()
+            step_ms.append(1000 * (time.perf_counter() - s0))
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        lat = np.sort(np.asarray(step_ms))
+        out = {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "p50_token_latency_ms": round(float(
+                lat[len(lat) // 2]), 2),
+            "p99_token_latency_ms": round(float(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 2),
+            "recompiles_measured":
+                st["decode_compiles"] - compiles0,
+            "tp_degree": st["tp_degree"],
+        }
+        if tp > 1:
+            out["collective_bytes_per_step"] = \
+                st["tp_collective_bytes_per_step"]
+            out["pool_bytes_per_shard"] = st["tp_pool_bytes_per_shard"]
+        eng.shutdown()
+        return out
+
+    out = {"devices": n_dev,
+           "cpu_mesh_proxy": jax.default_backend() == "cpu",
+           "requests": n_req, "num_slots": slots,
+           "max_new_tokens": new}
+    base = None
+    for tp in degrees:
+        r = run_engine(tp)
+        if tp == 1:
+            base = r["aggregate_tokens_per_sec"]
+        else:
+            r["speedup_vs_tp1"] = round(
+                r["aggregate_tokens_per_sec"] / max(base, 1e-9), 3)
+            r["scaling_efficiency"] = round(
+                r["speedup_vs_tp1"] / tp, 3)
+        out[f"tp{tp}"] = r
+    del model
+    gc.collect()
+    return out
+
+
+def _tp_serving_bench():
+    """Run the TP serving bench on >= 4 devices: in-process when this
+    process already sees a multi-device backend (a TPU slice), else in
+    a subprocess on a forced 8-host-device CPU mesh (the documented
+    CPU-mesh proxy — same trick as the MULTICHIP dryrun)."""
+    import jax
+    if len(jax.devices()) >= 4:
+        return _tp_serving_bench_impl()
+    import json as _json
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--tp-serving-sub"],
+        capture_output=True, text=True, env=env, timeout=3600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"tp serving subprocess failed: {proc.stderr[-2000:]}")
+    return _json.loads(proc.stdout.strip().splitlines()[-1])
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -889,6 +1012,10 @@ def main():
     except Exception as exc:
         serving_prefix = {"error": repr(exc)}
     try:
+        serving_tp = _tp_serving_bench()
+    except Exception as exc:
+        serving_tp = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -901,6 +1028,7 @@ def main():
               "serving": serving,
               "speculative": speculative,
               "serving_prefix": serving_prefix,
+              "serving_tp": serving_tp,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -917,7 +1045,8 @@ def main():
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
             if k not in ("decode", "serving", "speculative",
-                         "serving_prefix", "flashmask", "moe_profile")
+                         "serving_prefix", "serving_tp", "flashmask",
+                         "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -944,6 +1073,15 @@ def main():
              serving_prefix.get("prefix_cached", {}).get(
                  "prefix_hit_rate")
              if isinstance(serving_prefix, dict) else None,
+             "tp2_serving_tokens_per_sec":
+             serving_tp.get("tp2", {}).get("aggregate_tokens_per_sec")
+             if isinstance(serving_tp, dict) else None,
+             "tp2_serving_speedup":
+             serving_tp.get("tp2", {}).get("speedup_vs_tp1")
+             if isinstance(serving_tp, dict) else None,
+             "tp4_serving_speedup":
+             serving_tp.get("tp4", {}).get("speedup_vs_tp1")
+             if isinstance(serving_tp, dict) else None,
              "flashmask_16k_block_skip_speedup":
              flashmask.get("block_skip_speedup")
              if isinstance(flashmask, dict) else None},
@@ -958,4 +1096,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if "--tp-serving-sub" in _sys.argv:
+        # subprocess mode for _tp_serving_bench: the parent forced a
+        # multi-host-device CPU mesh via env before exec
+        print(json.dumps(_tp_serving_bench_impl()))
+    else:
+        main()

@@ -114,7 +114,7 @@ def ngram_propose(history, gamma: int, max_ngram: int = 3) -> List[int]:
 
 
 def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
-                     top_k, top_p, want_probs):
+                     top_k, top_p, want_probs, gather_logits=None):
     """Compiled draft proposal loop: ``gamma + 1`` single-token decode
     steps of the draft model inside one ``lax.scan`` (the extra step
     emits nothing — it writes the last draft token's K/V so a fully
@@ -126,7 +126,9 @@ def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
     ``q_probs`` are the draft distributions AFTER the shared
     temperature/top-k/top-p pipeline (``want_probs`` — sampling mode
     needs them for rejection sampling; greedy verifies by token id
-    only)."""
+    only). ``gather_logits`` (tensor-parallel serving): applied to the
+    per-step logits BEFORE filtering/sampling, so selection always
+    sees the full replicated vocab row."""
     from . import _filter_logits
 
     def loop(dparams, dpools, tables, lens, cur, key):
@@ -135,7 +137,10 @@ def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
             logits, pools = draft_step(dparams, tok[:, None], pools,
                                        None, block_tables=tables,
                                        cache_lens=l)
-            f = _filter_logits(logits[:, -1, :], do_sample=do_sample,
+            row = logits[:, -1, :]
+            if gather_logits is not None:
+                row = gather_logits(row)
+            f = _filter_logits(row, do_sample=do_sample,
                                temperature=temperature, top_k=top_k,
                                top_p=top_p)
             k, sub = jax.random.split(k)
@@ -163,7 +168,8 @@ def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
 # ---------------------------------------------------------------------------
 
 def build_verify_step(model_step, *, gamma, do_sample, temperature,
-                      top_k, top_p, onehot_draft=True):
+                      top_k, top_p, onehot_draft=True,
+                      gather_logits=None):
     """Build the fixed-gamma multi-token verify step.
 
     The returned function runs ONE target forward over the window
@@ -184,13 +190,18 @@ def build_verify_step(model_step, *, gamma, do_sample, temperature,
     randomness). Sampling: rejection sampling against the draft
     distribution — one-hot of ``toks[:, 1:]`` when ``onehot_draft``
     (n-gram drafter), else the explicit ``dq`` operand — signature
-    ``verify(params, pools, tables, lens, toks[, dq], key)``."""
+    ``verify(params, pools, tables, lens, toks[, dq], key)``.
+    ``gather_logits`` (tensor-parallel serving): applied to the window
+    logits before filtering, so acceptance/sampling always see the
+    full replicated vocab — the step's ONE cross-shard collective."""
     from . import _filter_logits
 
     def _target(params, pools, tables, lens, toks):
         logits, pools = model_step(params, toks, pools, None,
                                    block_tables=tables,
                                    cache_lens=lens)
+        if gather_logits is not None:
+            logits = gather_logits(logits)
         f = _filter_logits(logits, do_sample=do_sample,
                            temperature=temperature, top_k=top_k,
                            top_p=top_p)                 # [S, G+1, V]

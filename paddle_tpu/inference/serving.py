@@ -69,6 +69,29 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   window tokens would compete for expert capacity — same reasoning as
   prompt bucketing). See docs/OPS.md "Speculative decoding".
 
+- **Tensor-parallel serving** (``ServingConfig(tp_degree=N)``): every
+  serving executable — batched decode, fixed-gamma verify, fixed-chunk
+  prefill, the draft loop and the ``copy_blocks`` COW — is sharded
+  over a ``Mesh(devices[:N], ("mp",))`` axis (GSPMD, arxiv 2105.04663).
+  The KV block pool splits on its kv_heads dim (each shard owns a
+  contiguous kv_head slice of EVERY block, so the paged-attention
+  grid runs unmodified on its local slice inside ``shard_map`` —
+  ``ops/pallas/paged_attention.sharded_paged_attention_step``); model
+  params shard column/row-wise through the models' existing ``mp``
+  PartitionSpecs; block tables, ``cache_lens``, token ids and the
+  sampling PRNG key are replicated. The only EXPLICIT cross-shard
+  collective is one logits ``all_gather`` before sampling
+  (``_gather_logits`` — census-asserted; the per-layer reduces of the
+  row-parallel linears are GSPMD-inserted and proxied by the
+  ``sharding_constraint`` census row), so sampling consumes the same
+  replicated logits/key on every shard. Host state is untouched: ONE
+  ``BlockAllocator``, one scheduler, one prefix-cache index — block
+  ids are global and every shard's pool slice is indexed by the same
+  tables, so prefix caching, COW, speculative rollback and chunked
+  prefill all compose with TP for free. Kill switch
+  ``PADDLE_TPU_SERVE_TP=0`` restores the single-device path
+  bit-for-bit. See docs/OPS.md "Tensor-parallel serving".
+
 Admission is worst-case reserved: a request is admitted only when the
 pool can cover ``prompt + max_new`` blocks for it PLUS the outstanding
 reservations of every active slot, so mid-decode pool exhaustion is
@@ -97,6 +120,7 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import monitor
 from ..ops import paged_cache as _pc
@@ -162,6 +186,21 @@ class ServingConfig:
     # step() themselves (otherwise finished results accumulate
     # unboundedly; run() then returns {}).
     retain_results: bool = True
+    # tensor-parallel degree: shard every serving executable over a
+    # Mesh(devices[:tp_degree], ("mp",)) axis — the KV pool splits on
+    # kv_heads, params column/row-wise, tables/lengths/keys replicate,
+    # one explicit logits all_gather per step. Must divide the model's
+    # num_kv_heads / num_attention_heads / vocab_size (validated at
+    # engine construction). Kill switch: PADDLE_TPU_SERVE_TP=0.
+    tp_degree: int = 1
+
+    def __post_init__(self):
+        # reject broken degrees HERE, with a message, instead of as a
+        # shape crash deep inside shard_map tracing
+        tp = self.tp_degree
+        if not isinstance(tp, int) or isinstance(tp, bool) or tp < 1:
+            raise ValueError(
+                f"tp_degree must be a positive int, got {tp!r}")
 
 
 @dataclass
@@ -272,9 +311,23 @@ class ServingEngine:
         self._stream = stream_callback
         model.eval()
 
+        # -- tensor parallelism -----------------------------------------
+        tp = int(getattr(cfg, "tp_degree", 1) or 1)
+        if tp > 1 and os.environ.get("PADDLE_TPU_SERVE_TP", "1") == "0":
+            tp = 1          # kill switch: single-device path, bit-for-bit
+        self._tp = tp
+        self._mesh = self._build_tp_mesh(model, draft_model, tp) \
+            if tp > 1 else None
+        self._pool_sharding = _pc.pool_sharding(self._mesh) \
+            if self._mesh is not None else None
+        self._census = {}           # exec name -> jaxpr collective rows
+        self._tp_step_bytes = 0     # explicit mp payload of one decode
+        self._n_tp_bytes = 0
+
         from ..jit import _LayerBinder
         binder = _LayerBinder(model)
-        self._params = binder.param_arrays()
+        self._params = self._shard_params(binder) \
+            if self._mesh is not None else binder.param_arrays()
         self._model_step = model._build_model_step(
             binder, binder.buffer_arrays())
         do_sample = cfg.decode_strategy == "sampling"
@@ -307,18 +360,18 @@ class ServingEngine:
         nb = (1 + cfg.num_slots * self._mb) if cfg.num_blocks is None \
             else int(cfg.num_blocks)
         self._alloc = _pc.BlockAllocator(nb)
-        self._pools = model.init_paged_caches(nb, self._bs)
+        self._pools = self._init_caches(model, nb)
         self._draft_model = draft_model \
             if gamma and cfg.drafter == "model" else None
         if self._draft_model is not None:
             self._draft_model.eval()
             dbinder = _LayerBinder(self._draft_model)
             self._dbinder = dbinder
-            self._dparams = dbinder.param_arrays()
+            self._dparams = self._shard_params(dbinder) \
+                if self._mesh is not None else dbinder.param_arrays()
             self._draft_step = self._draft_model._build_model_step(
                 dbinder, dbinder.buffer_arrays())
-            self._dpools = self._draft_model.init_paged_caches(
-                nb, self._bs)
+            self._dpools = self._init_caches(self._draft_model, nb)
             self._draft_prefill_execs = {}
         self._verify_exec = None
         self._draft_exec = None
@@ -332,7 +385,12 @@ class ServingEngine:
         self._eos = -1 if cfg.eos_token_id is None \
             else int(cfg.eos_token_id)
         self._pad = int(cfg.pad_token_id)
-        self._key = jax.random.PRNGKey(int(cfg.seed))
+        # the sampling key is EXPLICITLY replicated across shards: every
+        # shard consumes the identical key against the identical
+        # gathered logits, so TP sampling is the same draw as
+        # single-device (never split per-shard — that would silently
+        # sample a different token on every shard)
+        self._key = self._dev(jax.random.PRNGKey(int(cfg.seed)))
         self._tables_dev = None         # device mirror of _tables
         self._decode_exec = None
         self._prefill_execs = {}        # legacy bucketed prefill
@@ -398,6 +456,26 @@ class ServingEngine:
         self._m_hit_rate = monitor.gauge(
             "serving_prefix_hit_rate",
             "cumulative reused / admitted prompt tokens")
+        monitor.info(
+            "serving_tp_degree",
+            "tensor-parallel degree of the most recent engine").set(
+            self._tp)
+        self._m_tp_bytes = monitor.counter(
+            "serving_tp_collective_bytes",
+            "explicit cross-shard collective payload executed per "
+            "engine step (per-shard bytes, jaxpr census: decode OR "
+            "draft-loop + verify; GSPMD-inserted collectives not "
+            "included)")
+        self._m_tp_pool = monitor.gauge(
+            "serving_tp_pool_bytes_per_shard",
+            "KV block-pool bytes each shard holds (kv_head slice)")
+        pool_bytes = sum(int(kp.nbytes) + int(vp.nbytes)
+                         for kp, vp in self._pools)
+        if self._draft_model is not None:
+            pool_bytes += sum(int(kp.nbytes) + int(vp.nbytes)
+                              for kp, vp in self._dpools)
+        self._pool_bytes_per_shard = pool_bytes // self._tp
+        self._m_tp_pool.set(self._pool_bytes_per_shard)
         if gamma:
             self._m_spec_len = monitor.histogram(
                 "serving_spec_accepted_len",
@@ -471,17 +549,20 @@ class ServingEngine:
             toks[i] = self._slots[i].last_token
         sub = self._next_key()
         if self._tables_dev is None:    # only re-upload after changes
-            self._tables_dev = jnp.asarray(self._tables)
+            self._tables_dev = self._dev(self._tables)
         if self._decode_exec is None:
             self._decode_exec = self._compile_decode(lens, toks, sub)
         with _quiet_donation():
             out, self._pools = self._decode_exec(
                 self._params, self._pools, self._tables_dev,
-                jnp.asarray(lens), jnp.asarray(toks), sub)
+                self._dev(lens), self._dev(toks), sub)
         out = np.asarray(out)
 
         self._m_steps.inc()
         self._n_decode_steps += 1
+        if self._mesh is not None:
+            self._m_tp_bytes.inc(self._tp_step_bytes)
+            self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
         for i in active:
             slot = self._slots[i]
@@ -523,8 +604,8 @@ class ServingEngine:
             lens[i] = self._slots[i].cache_len
             toks[i, 0] = self._slots[i].last_token
         if self._tables_dev is None:
-            self._tables_dev = jnp.asarray(self._tables)
-        lens_dev = jnp.asarray(lens)
+            self._tables_dev = self._dev(self._tables)
+        lens_dev = self._dev(lens)
 
         dq = None
         if self._draft_model is not None:
@@ -534,7 +615,7 @@ class ServingEngine:
             with _quiet_donation():
                 props, dq, self._dpools = self._draft_exec(
                     self._dparams, self._dpools, self._tables_dev,
-                    lens_dev, jnp.asarray(toks[:, 0]), sub)
+                    lens_dev, self._dev(toks[:, 0]), sub)
             toks[:, 1:] = np.asarray(props)
         else:
             for i in active:
@@ -546,7 +627,7 @@ class ServingEngine:
             self._verify_exec = self._compile_verify(lens, toks, dq,
                                                      sub)
         args = [self._params, self._pools, self._tables_dev, lens_dev,
-                jnp.asarray(toks)]
+                self._dev(toks)]
         if self._do_sample:
             if dq is not None:
                 args.append(dq)
@@ -558,6 +639,9 @@ class ServingEngine:
 
         self._m_steps.inc()
         self._n_decode_steps += 1
+        if self._mesh is not None:
+            self._m_tp_bytes.inc(self._tp_step_bytes)
+            self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
         for i in active:
             slot = self._slots[i]
@@ -637,6 +721,13 @@ class ServingEngine:
             "cow_copies": self._n_cow,
             "cache_evictions": self._alloc.evictions,
             "cached_blocks": self._alloc.cached_blocks,
+            "tp_degree": self._tp,
+            # always present (0 / full pool when single-device), so a
+            # tp_degree>1 request downgraded by the PADDLE_TPU_SERVE_TP=0
+            # kill switch never KeyErrors stats() consumers mid-rollback
+            "tp_collective_bytes_per_step": self._tp_step_bytes,
+            "tp_collective_bytes_total": self._n_tp_bytes,
+            "tp_pool_bytes_per_shard": self._pool_bytes_per_shard,
         }
         if self._gamma:
             out.update({
@@ -684,6 +775,194 @@ class ServingEngine:
         return hashlib.blake2b("\x1f".join(desc).encode(),
                                digest_size=16).digest()
 
+    # -- tensor parallelism -------------------------------------------
+
+    def _init_caches(self, mdl, nb):
+        """Per-layer paged pools. The ``sharding`` kwarg is passed only
+        under TP, so duck-typed models implementing the pre-TP
+        two-argument ``init_paged_caches(num_blocks, block_size)``
+        protocol keep working at tp_degree=1."""
+        if self._pool_sharding is not None:
+            return mdl.init_paged_caches(nb, self._bs,
+                                         sharding=self._pool_sharding)
+        return mdl.init_paged_caches(nb, self._bs)
+
+    @staticmethod
+    def _build_tp_mesh(model, draft_model, tp: int) -> Mesh:
+        """Validate ``tp_degree`` against the device count and BOTH
+        models' head/vocab divisibility — a clear error here instead of
+        a shape crash inside shard_map tracing — then build the serving
+        mesh: the first ``tp`` devices on one ``mp`` axis."""
+        devs = jax.devices()
+        if tp > len(devs):
+            raise ValueError(
+                f"tp_degree={tp} needs {tp} devices, but only "
+                f"{len(devs)} are visible")
+        for mdl, who in ((model, "model"), (draft_model, "draft model")):
+            if mdl is None:
+                continue
+            c = getattr(mdl, "config", None)
+            h = getattr(c, "num_attention_heads", None)
+            hkv = getattr(c, "num_key_value_heads", None) or h
+            v = getattr(c, "vocab_size", None)
+            if hkv is not None and hkv % tp:
+                ok = [d for d in range(1, hkv + 1) if hkv % d == 0]
+                raise ValueError(
+                    f"tp_degree={tp} does not divide the {who}'s "
+                    f"num_kv_heads={hkv}: the KV block pool is sharded "
+                    f"on the kv_heads dim, so tp_degree must divide it "
+                    f"(valid degrees for this model: {ok})")
+            if h is not None and h % tp:
+                raise ValueError(
+                    f"tp_degree={tp} does not divide the {who}'s "
+                    f"num_attention_heads={h}")
+            if v is not None and v % tp:
+                raise ValueError(
+                    f"tp_degree={tp} does not divide the {who}'s "
+                    f"vocab_size={v} (the logits all_gather needs an "
+                    f"even vocab split)")
+        return Mesh(np.array(devs[:tp]), ("mp",))
+
+    def _shard_params(self, binder):
+        """Place every parameter under the engine mesh: params carrying
+        an ``mp`` PartitionSpec (the models' Column/Row-parallel linears
+        and vocab-parallel embeddings annotate these at construction)
+        shard along it; everything else — norms, biases without specs,
+        int8 weights/scales from ``quantize_for_inference`` — is
+        replicated. The serving mesh has ONLY the ``mp`` axis, so spec
+        dims naming foreign fleet axes (``dp``/``sharding``/expert
+        axes, e.g. a model previously placed by stage-3 sharding)
+        replicate on that dim instead of crashing NamedSharding; a
+        ``mp`` dim that does not divide ``tp`` falls back to fully
+        replicated (correct, just not memory-split)."""
+        out = []
+        from ..framework.core import as_jax
+        for _, p in binder.param_items:
+            arr = as_jax(p)
+            spec = getattr(p, "dist_spec", None)
+            pspec = None
+            if spec is not None:
+                dims = []
+                for dim, names in enumerate(spec):
+                    axes = names if isinstance(names, tuple) \
+                        else (names,)
+                    if "mp" in axes:
+                        if arr.shape[dim] % self._tp:
+                            dims = None
+                            break
+                        dims.append("mp")
+                    else:
+                        dims.append(None)
+                if dims is not None:
+                    pspec = P(*dims)
+            if pspec is None:
+                pspec = P()
+            out.append(jax.device_put(
+                arr, NamedSharding(self._mesh, pspec)))
+        return out
+
+    def _dev(self, x):
+        """Committed device operand: under TP every scheduler-produced
+        array (tables, lengths, token ids, PRNG keys, COW indices) must
+        be explicitly replicated across the mesh — compiled executables
+        are strict about input shardings; single-device engines keep the
+        plain ``asarray``. ``device_put`` takes host arrays directly, so
+        the per-token hot path pays ONE transfer, not asarray + reshard."""
+        if self._mesh is None:
+            return jnp.asarray(x)
+        return jax.device_put(
+            x, NamedSharding(self._mesh, P(*([None] * np.ndim(x)))))
+
+    def _gather_logits(self, logits):
+        """THE step's explicit cross-shard collective: all_gather the
+        vocab-sharded logits over ``mp`` so sampling sees the full
+        replicated row on every shard (bitwise the same concatenation
+        of per-shard columns the single-device matmul produces).
+        Identity when TP is off — the single-device path traces
+        unchanged."""
+        if self._mesh is None:
+            return logits
+        from ..distributed.shard_utils import shard_map_compat
+        nd = logits.ndim
+        spec = P(*([None] * (nd - 1) + ["mp"]))
+        logits = jax.lax.with_sharding_constraint(
+            logits, NamedSharding(self._mesh, spec))
+        gather = shard_map_compat(
+            lambda x: jax.lax.all_gather(x, "mp", axis=nd - 1,
+                                         tiled=True),
+            self._mesh, in_specs=(spec,),
+            out_specs=P(*([None] * nd)))
+        return gather(logits)
+
+    @contextlib.contextmanager
+    def _trace_ctx(self):
+        """Tracing context for every ``_compile_*``: activate the
+        engine's mesh (the TP layers' sharding constraints and the
+        shard_map attention wrapper read the global mesh at trace time)
+        and un-gather the lm_head so logits leave the model
+        vocab-sharded — ``_gather_logits`` is then the step's ONE
+        explicit logits collective instead of a gather/re-shard pair.
+        Both are restored on exit, so nothing leaks into other code."""
+        if self._mesh is None:
+            yield
+            return
+        from ..distributed import env as _denv
+        prev = _denv.get_mesh()
+        heads = []
+        for mdl in (self.model, self._draft_model):
+            head = getattr(mdl, "lm_head", None) \
+                if mdl is not None else None
+            if head is not None and getattr(head, "gather_output",
+                                            False):
+                heads.append(head)
+                head.gather_output = False
+        from ..ops.pallas.paged_attention import serving_tp_scope
+        _denv.set_mesh(self._mesh)
+        try:
+            with serving_tp_scope():
+                yield
+        finally:
+            _denv.set_mesh(prev)
+            for head in heads:
+                head.gather_output = True
+
+    def _aot_compile(self, name, jitted, args):
+        """Lower + AOT-compile one serving executable. Under TP the
+        traced jaxpr is also walked for the collective census (PR 2's
+        ``monitor.collective_census``): explicit shard_map collectives
+        appear as op rows with per-shard payload bytes; GSPMD-inserted
+        ones only materialize post-partitioning and are proxied by the
+        ``sharding_constraint`` row. The decode/verify census feeds the
+        per-step collective-bytes counter."""
+        with self._trace_ctx(), _quiet_donation():
+            trace = getattr(jitted, "trace", None) \
+                if self._mesh is not None else None
+            if trace is not None:
+                traced = trace(*args)
+                exec_ = traced.lower().compile()
+                self._census[name] = monitor.collective_census(
+                    traced.jaxpr)
+                return exec_
+            # older jax: no jit().trace — the executable still compiles
+            # once, the census (and the byte counters it feeds) stays
+            # empty for this engine
+            return jitted.lower(*args).compile()
+
+    def collective_census(self) -> dict:
+        """Per-executable jaxpr collective census (TP engines only):
+        ``{exec_name: [{op, axis, count, bytes}, ...]}`` — the ops
+        dashboard / test hook behind the "exactly one logits gather
+        per step" assertion."""
+        return dict(self._census)
+
+    def _tp_census_bytes(self, name) -> int:
+        """Explicit per-shard ``mp`` collective payload of one
+        execution of ``name`` (the census-derived per-step cost)."""
+        return sum(
+            r["bytes"] for r in self._census.get(name, ())
+            if r["op"] != "sharding_constraint"
+            and "mp" in r["axis"].split(","))
+
     # -- scheduler internals ------------------------------------------
 
     def _emit(self, rid, tok):
@@ -698,10 +977,15 @@ class ServingEngine:
 
     def _next_key(self):
         """Greedy decode never consumes randomness — skip the per-step
-        split (one device dispatch per token saved)."""
+        split (one device dispatch per token saved). Under TP the key
+        (and every split of it) stays replicated across shards: all
+        shards draw the same sample from the same gathered logits."""
         if not self._do_sample:
             return self._key
         self._key, sub = jax.random.split(self._key)
+        if self._mesh is not None:
+            self._key = self._dev(self._key)
+            sub = self._dev(sub)
         return sub
 
     def _admit(self) -> List[tuple]:
@@ -814,15 +1098,15 @@ class ServingEngine:
             self._cow_exec = self._compile_cow(self._pools)
         with _quiet_donation():
             self._pools = self._cow_exec(
-                self._pools, jnp.asarray(old, jnp.int32),
-                jnp.asarray(new, jnp.int32))
+                self._pools, self._dev(np.int32(old)),
+                self._dev(np.int32(new)))
         if self._draft_model is not None:
             if self._draft_cow_exec is None:
                 self._draft_cow_exec = self._compile_cow(self._dpools)
             with _quiet_donation():
                 self._dpools = self._draft_cow_exec(
-                    self._dpools, jnp.asarray(old, jnp.int32),
-                    jnp.asarray(new, jnp.int32))
+                    self._dpools, self._dev(np.int32(old)),
+                    self._dev(np.int32(new)))
         self._alloc.free([old])
         slot.blocks[bidx] = new
         slot.pend_row = None                 # (always pre-chunk today)
@@ -851,18 +1135,18 @@ class ServingEngine:
             # upload it once, not per interleaved tick
             row = np.zeros((self._mb,), np.int32)
             row[:len(slot.blocks)] = slot.blocks
-            slot.pend_row = jnp.asarray(row)
+            slot.pend_row = self._dev(row)
         table_dev = slot.pend_row
         while budget is None or budget > 0:
             part = slot.prompt[slot.pend_pos:slot.pend_pos + c]
             ids = np.full((1, c), self._pad, np.int32)
             ids[0, :part.size] = part
-            ids_dev = jnp.asarray(ids)
-            pos = jnp.asarray(slot.pend_pos, jnp.int32)
+            ids_dev = self._dev(ids)
+            pos = self._dev(np.int32(slot.pend_pos))
             with _quiet_donation():
                 tok, self._pools = self._chunk_exec(
                     self._params, ids_dev, self._pools, table_dev,
-                    pos, jnp.asarray(int(part.size) - 1, jnp.int32),
+                    pos, self._dev(np.int32(int(part.size) - 1)),
                     self._next_key())
             if self._draft_model is not None:
                 # prime the draft cache over the same positions (its
@@ -941,9 +1225,9 @@ class ServingEngine:
             self._prefill_execs[bucket] = exec_
         with _quiet_donation():
             tok, self._pools = exec_(
-                self._params, jnp.asarray(ids),
-                jnp.asarray(n_real, jnp.int32), self._pools,
-                jnp.asarray(self._tables[i]), sub)
+                self._params, self._dev(ids),
+                self._dev(np.int32(n_real)), self._pools,
+                self._dev(self._tables[i]), sub)
         if self._draft_model is not None:
             # prime the draft model's cache with the same prompt K/V
             # (its pools share the slot's block table)
@@ -953,9 +1237,9 @@ class ServingEngine:
                 self._draft_prefill_execs[bucket] = dexec
             with _quiet_donation():
                 self._dpools = dexec(
-                    self._dparams, jnp.asarray(ids),
-                    jnp.asarray(n_real, jnp.int32), self._dpools,
-                    jnp.asarray(self._tables[i]))
+                    self._dparams, self._dev(ids),
+                    self._dev(np.int32(n_real)), self._dpools,
+                    self._dev(self._tables[i]))
         return int(tok)
 
     def _ensure_blocks(self, active, horizon=1):
@@ -1038,15 +1322,18 @@ class ServingEngine:
             logits, pools = self._model_step(
                 params, toks[:, None], pools, None,
                 block_tables=tables, cache_lens=lens)
+            row = self._gather_logits(logits[:, -1, :])
             _, sub = jax.random.split(key)
-            tok, _ = self._select(logits[:, -1, :], sub)
+            tok, _ = self._select(row, sub)
             return tok, pools
 
         jitted = jax.jit(decode, donate_argnums=(1,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._params, self._pools, jnp.asarray(self._tables),
-                jnp.asarray(lens), jnp.asarray(toks), key).compile()
+        exec_ = self._aot_compile(
+            "decode", jitted,
+            (self._params, self._pools, self._dev(self._tables),
+             self._dev(lens), self._dev(toks), key))
+        if self._mesh is not None:
+            self._tp_step_bytes = self._tp_census_bytes("decode")
         self._m_decode_compiles.inc()
         self._n_decode_compiles += 1
         return exec_
@@ -1073,17 +1360,17 @@ class ServingEngine:
                 block_tables=table_row[None], cache_lens=lens)
             row = jax.lax.dynamic_slice_in_dim(
                 logits, last, 1, axis=1)[:, 0, :]
+            row = self._gather_logits(row)
             _, sub = jax.random.split(key)
             tok, _ = self._select(row, sub)
             return tok[0], pools
 
         jitted = jax.jit(chunk, donate_argnums=(2,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._params, jnp.zeros((1, c), jnp.int32), self._pools,
-                jnp.zeros((self._mb,), jnp.int32),
-                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
-                key).compile()
+        exec_ = self._aot_compile(
+            "chunk", jitted,
+            (self._params, self._dev(np.zeros((1, c), np.int32)),
+             self._pools, self._dev(np.zeros((self._mb,), np.int32)),
+             self._dev(np.int32(0)), self._dev(np.int32(0)), key))
         self._m_prefill_compiles.labels(bucket=f"chunk{c}").inc()
         self._n_prefill_compiles += 1
         return exec_
@@ -1103,11 +1390,11 @@ class ServingEngine:
             return dpools
 
         jitted = jax.jit(dchunk, donate_argnums=(2,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._dparams, jnp.zeros((1, c), jnp.int32),
-                self._dpools, jnp.zeros((self._mb,), jnp.int32),
-                jnp.zeros((), jnp.int32)).compile()
+        exec_ = self._aot_compile(
+            "draft_chunk", jitted,
+            (self._dparams, self._dev(np.zeros((1, c), np.int32)),
+             self._dpools, self._dev(np.zeros((self._mb,), np.int32)),
+             self._dev(np.int32(0))))
         self._m_prefill_compiles.labels(bucket=f"draft-chunk{c}").inc()
         self._n_prefill_compiles += 1
         return exec_
@@ -1116,9 +1403,9 @@ class ServingEngine:
         """AOT-compile the copy-on-write block duplicate (src/dst ride
         as traced scalars — one executable serves every COW)."""
         jitted = jax.jit(_pc.copy_blocks, donate_argnums=(0,))
-        with _quiet_donation():
-            return jitted.lower(pools, jnp.zeros((), jnp.int32),
-                                jnp.zeros((), jnp.int32)).compile()
+        return self._aot_compile(
+            "cow", jitted, (pools, self._dev(np.int32(0)),
+                            self._dev(np.int32(0))))
 
     def _compile_prefill(self, bucket, key):
         def prefill(params, ids, n_real, pools, table_row, key):
@@ -1131,16 +1418,17 @@ class ServingEngine:
                 for (kp, vp), (dk, dv) in zip(pools, dense)]
             last = jax.lax.dynamic_slice_in_dim(
                 logits, n_real - 1, 1, axis=1)[:, 0, :]
+            last = self._gather_logits(last)
             _, sub = jax.random.split(key)
             tok, _ = self._select(last, sub)
             return tok[0], pools
 
         jitted = jax.jit(prefill, donate_argnums=(3,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._params, jnp.zeros((1, bucket), jnp.int32),
-                jnp.zeros((), jnp.int32), self._pools,
-                jnp.zeros((self._mb,), jnp.int32), key).compile()
+        exec_ = self._aot_compile(
+            f"prefill{bucket}", jitted,
+            (self._params, self._dev(np.zeros((1, bucket), np.int32)),
+             self._dev(np.int32(0)), self._pools,
+             self._dev(np.zeros((self._mb,), np.int32)), key))
         self._m_prefill_compiles.labels(bucket=bucket).inc()
         self._n_prefill_compiles += 1
         return exec_
@@ -1156,16 +1444,25 @@ class ServingEngine:
             self._model_step, gamma=self._gamma,
             do_sample=self._do_sample, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p,
-            onehot_draft=self._draft_model is None)
+            onehot_draft=self._draft_model is None,
+            gather_logits=self._gather_logits
+            if self._mesh is not None else None)
         jitted = jax.jit(verify, donate_argnums=(1,))
-        args = [self._params, self._pools, jnp.asarray(self._tables),
-                jnp.asarray(lens), jnp.asarray(toks)]
+        args = [self._params, self._pools, self._dev(self._tables),
+                self._dev(lens), self._dev(toks)]
         if self._do_sample:
             if dq is not None:
                 args.append(dq)
             args.append(key)
-        with _quiet_donation():
-            exec_ = jitted.lower(*args).compile()
+        exec_ = self._aot_compile("verify", jitted, tuple(args))
+        if self._mesh is not None:
+            # a spec step executes the draft loop AND the verify gather.
+            # The draft's gather sits inside a lax.scan body, which the
+            # census walks ONCE — the engine knows the trip count
+            # (gamma+1 iterations), so scale it to the bytes that
+            # actually move per step
+            self._tp_step_bytes = self._tp_census_bytes("verify") \
+                + (self._gamma + 1) * self._tp_census_bytes("draft")
         self._m_decode_compiles.inc()
         self._n_decode_compiles += 1
         return exec_
@@ -1179,14 +1476,14 @@ class ServingEngine:
             self._draft_step, gamma=self._gamma,
             do_sample=self._do_sample, temperature=cfg.temperature,
             top_k=cfg.top_k, top_p=cfg.top_p,
-            want_probs=self._do_sample)
+            want_probs=self._do_sample,
+            gather_logits=self._gather_logits
+            if self._mesh is not None else None)
         jitted = jax.jit(loop, donate_argnums=(1,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._dparams, self._dpools, jnp.asarray(self._tables),
-                jnp.asarray(lens), jnp.asarray(toks[:, 0]),
-                key).compile()
-        return exec_
+        return self._aot_compile(
+            "draft", jitted,
+            (self._dparams, self._dpools, self._dev(self._tables),
+             self._dev(lens), self._dev(toks[:, 0]), key))
 
     def _compile_draft_prefill(self, bucket):
         """Draft-cache twin of ``_compile_prefill``: scatter the draft
@@ -1203,11 +1500,11 @@ class ServingEngine:
                 for (kp, vp), (dk, dv) in zip(dpools, dense)]
 
         jitted = jax.jit(dprefill, donate_argnums=(3,))
-        with _quiet_donation():
-            exec_ = jitted.lower(
-                self._dparams, jnp.zeros((1, bucket), jnp.int32),
-                jnp.zeros((), jnp.int32), self._dpools,
-                jnp.zeros((self._mb,), jnp.int32)).compile()
+        exec_ = self._aot_compile(
+            f"draft_prefill{bucket}", jitted,
+            (self._dparams, self._dev(np.zeros((1, bucket), np.int32)),
+             self._dev(np.int32(0)), self._dpools,
+             self._dev(np.zeros((self._mb,), np.int32))))
         self._m_prefill_compiles.labels(
             bucket=f"draft-{bucket}").inc()
         self._n_prefill_compiles += 1

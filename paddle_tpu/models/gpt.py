@@ -226,15 +226,17 @@ class GPTForCausalLM(Layer, GenerationMixin):
             for _ in range(cfg.num_hidden_layers)
         ]
 
-    def init_paged_caches(self, num_blocks: int, block_size: int):
+    def init_paged_caches(self, num_blocks: int, block_size: int,
+                          sharding=None):
         """Per-layer paged (k_pool, v_pool) for serving (MHA: kv head
-        count equals the query head count)."""
+        count equals the query head count). ``sharding``: the
+        tensor-parallel kv_head split (``pool_sharding(mesh)``)."""
         from ..ops.paged_cache import init_pool
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         return [
             init_pool(num_blocks, block_size, cfg.num_attention_heads,
-                      head_dim, jnp.float32)
+                      head_dim, jnp.float32, sharding=sharding)
             for _ in range(cfg.num_hidden_layers)
         ]
 

@@ -158,22 +158,23 @@ def paged_attention_decode(qh, kh, vh, k_pool, v_pool, block_tables,
     blocks mapped from the prefix cache). Only ``generate()``'s
     one-program paged loop still prefills through the dense cached
     path + ``ops.paged_cache.write_prefill``.
+    Tensor-parallel serving: inside a TP engine's trace
+    (``serving_tp_scope``, a mesh with a live ``mp`` axis, divisible
+    head counts) the SAME body runs inside ``shard_map``
+    — each shard writes/attends its contiguous kv_head slice of the
+    pool, block tables and lengths replicated, no collective inside
+    (``ops/pallas/paged_attention.sharded_paged_attention_step``).
     Returns (out [S, T, H, D], new_k_pool, new_v_pool)."""
-    from ..ops.paged_cache import write_decode, write_tokens
-    from ..ops.pallas.paged_attention import (paged_decode_attention,
-                                              paged_verify_attention)
-    lens = cache_lens.astype(jnp.int32)
-    if qh.shape[1] == 1:
-        kp2, vp2 = write_decode(k_pool, v_pool, block_tables, lens,
-                                kh[:, 0], vh[:, 0])
-        out = paged_decode_attention(qh[:, 0], kp2, vp2, block_tables,
-                                     lens + 1,
-                                     sm_scale=1.0 / math.sqrt(head_dim))
-        return out[:, None], kp2, vp2
-    kp2, vp2 = write_tokens(k_pool, v_pool, block_tables, lens, kh, vh)
-    out = paged_verify_attention(qh, kp2, vp2, block_tables, lens + 1,
-                                 sm_scale=1.0 / math.sqrt(head_dim))
-    return out, kp2, vp2
+    from ..ops.pallas.paged_attention import (paged_attention_step,
+                                              sharded_paged_attention_step,
+                                              tp_shard_degree)
+    sm = 1.0 / math.sqrt(head_dim)
+    if tp_shard_degree(qh.shape[2], kh.shape[2]) > 1:
+        return sharded_paged_attention_step(qh, kh, vh, k_pool, v_pool,
+                                            block_tables, cache_lens,
+                                            sm_scale=sm)
+    return paged_attention_step(qh, kh, vh, k_pool, v_pool,
+                                block_tables, cache_lens, sm_scale=sm)
 
 
 def _rope_rotate(x, c, s):
@@ -543,16 +544,20 @@ class LlamaForCausalLM(Layer, GenerationMixin):
             for _ in range(cfg.num_hidden_layers)
         ]
 
-    def init_paged_caches(self, num_blocks: int, block_size: int):
+    def init_paged_caches(self, num_blocks: int, block_size: int,
+                          sharding=None):
         """Zeroed per-layer paged (k_pool, v_pool), each
         [num_blocks, block_size, H_kv, D] — the shared serving cache
-        (block 0 is the null block; see ``ops/paged_cache.py``)."""
+        (block 0 is the null block; see ``ops/paged_cache.py``).
+        ``sharding``: tensor-parallel pool placement (normally
+        ``ops.paged_cache.pool_sharding(mesh)`` — the kv_head split),
+        so each shard materializes only its slice."""
         from ..ops.paged_cache import init_pool
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
         return [
             init_pool(num_blocks, block_size, cfg.num_key_value_heads,
-                      head_dim, jnp.dtype(cfg.dtype))
+                      head_dim, jnp.dtype(cfg.dtype), sharding=sharding)
             for _ in range(cfg.num_hidden_layers)
         ]
 

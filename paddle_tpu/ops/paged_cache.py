@@ -35,6 +35,7 @@ The ragged decode attention that READS this layout is
 """
 from __future__ import annotations
 
+import functools
 import hashlib
 from collections import OrderedDict
 
@@ -44,7 +45,7 @@ import numpy as np
 __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "write_prefill", "write_decode", "write_tokens",
            "gather_dense", "chain_hashes", "iter_chain_hashes",
-           "copy_blocks"]
+           "copy_blocks", "pool_sharding", "pool_head_slice"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -243,10 +244,53 @@ def chain_hashes(seed: bytes, tokens, block_size: int):
 
 
 def init_pool(num_blocks: int, block_size: int, num_kv_heads: int,
-              head_dim: int, dtype) -> tuple:
-    """Zeroed (k_pool, v_pool), each [num_blocks, block_size, H_kv, D]."""
+              head_dim: int, dtype, sharding=None) -> tuple:
+    """Zeroed (k_pool, v_pool), each [num_blocks, block_size, H_kv, D].
+
+    ``sharding`` (tensor-parallel serving): a ``jax.sharding.Sharding``
+    — normally ``pool_sharding(mesh)``, the kv_heads split — the pool
+    is created under, so each shard materializes only its contiguous
+    kv_head slice and no resharding transfer ever happens."""
     shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    if sharding is not None:
+        # compile the zeros INTO the sharding: each device writes only
+        # its own slice, so a pool sized near per-chip HBM x tp never
+        # materializes unsharded on device 0 first
+        mk = _sharded_zeros(shape, jnp.dtype(dtype), sharding)
+        return mk(), mk()
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_zeros(shape, dtype, sharding):
+    """One compiled sharded-zeros program per (shape, dtype, sharding)
+    — every layer of a model (and its draft) reuses it instead of
+    paying a fresh XLA compile per ``init_pool`` call."""
+    import jax
+    return jax.jit(lambda: jnp.zeros(shape, dtype),
+                   out_shardings=sharding)
+
+
+def pool_sharding(mesh):
+    """The tensor-parallel pool placement: ``[NB, BS, H_kv, D]`` split
+    on the kv_heads dim over the mesh's ``mp`` axis. Every shard holds
+    ALL blocks (block ids stay global — one host allocator, one set of
+    block tables serves every shard) but only a contiguous kv_head
+    slice of each, which is exactly the slice the per-shard paged
+    attention grid iterates."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(None, None, "mp", None))
+
+
+def pool_head_slice(pool, shard: int, tp: int):
+    """The contiguous kv_head slice shard ``shard`` of ``tp`` owns —
+    the per-shard view the TP attention computes on (tests/debugging;
+    the device never materializes this outside its own shard)."""
+    hkv = pool.shape[2]
+    if hkv % tp:
+        raise ValueError(f"kv_heads={hkv} not divisible by tp={tp}")
+    per = hkv // tp
+    return pool[:, :, shard * per:(shard + 1) * per, :]
 
 
 def write_prefill(k_pool, v_pool, block_tables, k_new, v_new,

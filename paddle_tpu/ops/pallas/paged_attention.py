@@ -42,15 +42,19 @@ kernel body at three ``t_q`` widths.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["paged_decode_attention", "pallas_paged_attention",
-           "paged_verify_attention", "pallas_paged_verify_attention"]
+           "paged_verify_attention", "pallas_paged_verify_attention",
+           "paged_attention_step", "sharded_paged_attention_step",
+           "tp_shard_degree", "serving_tp_scope"]
 
 NEG_INF = np.float32(-1e30)
 
@@ -360,6 +364,112 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
                                       context_lens, sm_scale=sm_scale)
     return _xla_paged_attention(q, k_pool, v_pool, block_tables,
                                 context_lens, sm_scale=sm_scale)
+
+
+def paged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
+                         cache_lens, sm_scale=None):
+    """Write this step's K/V into the pool and attend — the shared
+    decode/verify/chunk body behind the models' paged forward:
+    ``T = 1`` (qh ``[S, 1, H, D]``) is the continuous-batching decode
+    step, ``T > 1`` the speculative verify window and the serving
+    engine's chunked prefill. Also the PER-SHARD body of the
+    tensor-parallel wrapper below — on a kv_head slice of the pool the
+    grid/fallback run completely unmodified, since nothing here ever
+    mixes kv heads. Returns ``(out [S, T, H, D], k_pool, v_pool)``."""
+    from ..paged_cache import write_decode, write_tokens
+    lens = cache_lens.astype(jnp.int32)
+    if qh.shape[1] == 1:
+        kp2, vp2 = write_decode(k_pool, v_pool, block_tables, lens,
+                                kh[:, 0], vh[:, 0])
+        out = paged_decode_attention(qh[:, 0], kp2, vp2, block_tables,
+                                     lens + 1, sm_scale=sm_scale)
+        return out[:, None], kp2, vp2
+    kp2, vp2 = write_tokens(k_pool, v_pool, block_tables, lens, kh, vh)
+    out = paged_verify_attention(qh, kp2, vp2, block_tables, lens + 1,
+                                 sm_scale=sm_scale)
+    return out, kp2, vp2
+
+
+_SERVING_TP = threading.local()   # thread-scoped like in_manual_region
+
+
+@contextlib.contextmanager
+def serving_tp_scope():
+    """Arm the TP routing gate below for the duration of one trace.
+    ``ServingEngine._trace_ctx`` enters this while tracing a
+    tensor-parallel executable; everywhere else ``tp_shard_degree``
+    reports 1, so an ambient training/fleet mesh with a live ``mp``
+    axis can never reroute a single-device engine (tp_degree=1, the
+    ``PADDLE_TPU_SERVE_TP=0`` kill switch) or ``generate``'s paged
+    loop through ``shard_map``. The flag is thread-local so a TP
+    compile on one thread never arms a concurrent trace on another."""
+    prev = getattr(_SERVING_TP, "on", False)
+    _SERVING_TP.on = True
+    try:
+        yield
+    finally:
+        _SERVING_TP.on = prev
+
+
+def tp_shard_degree(num_heads, num_kv_heads) -> int:
+    """``mp`` degree the TP paged-attention path can use right now:
+    > 1 only inside a ``serving_tp_scope`` (a TP engine's trace) whose
+    mesh has a live ``mp`` axis, when tracing is not already inside a
+    manual (shard_map) region, and BOTH head counts divide — otherwise
+    the caller must stay on the single-program path (GSPMD partitions
+    it if it can)."""
+    if not getattr(_SERVING_TP, "on", False):
+        return 1
+    try:
+        from ...distributed.shard_utils import (current_mesh,
+                                                in_manual_region)
+    except Exception:       # pragma: no cover - partial install
+        return 1
+    mesh = current_mesh()
+    if mesh is None or in_manual_region():
+        return 1
+    tp = int(mesh.shape.get("mp", 1))
+    if tp <= 1 or num_heads % tp or num_kv_heads % tp:
+        return 1
+    return tp
+
+
+def sharded_paged_attention_step(qh, kh, vh, k_pool, v_pool,
+                                 block_tables, cache_lens,
+                                 sm_scale=None):
+    """Tensor-parallel ``paged_attention_step``: the same write+attend
+    body inside ``shard_map`` over the current mesh's ``mp`` axis.
+
+    Per-shard layout (*GSPMD*-style sharding of the serving
+    executables, cut along kv_heads as in *Ragged Paged Attention*'s
+    per-head grid): q/k/v ``[S, T, H, D]`` and both pools
+    ``[NB, BS, H_kv, D]`` split on their head dim — each shard owns a
+    contiguous kv_head GROUP slice, so GQA routing, the Pallas grid
+    ``(slot, kv_head, block)`` and the XLA gather fallback all run
+    unmodified on local shapes (``rep = H/H_kv`` is shard-invariant).
+    Block tables and lengths are REPLICATED: block ids are global, one
+    host allocator serves every shard, and each shard's pool slice is
+    indexed by the same tables — which is why prefix caching, COW,
+    speculative rollback and chunked prefill compose with TP for free.
+    No collective runs in here at all; the step's only cross-shard
+    traffic is the logits gather the serving engine adds before
+    sampling."""
+    import jax.sharding as _js
+    from ...distributed.shard_utils import current_mesh, shard_map_compat
+    P = _js.PartitionSpec
+    mesh = current_mesh()
+    heads = P(None, None, "mp", None)     # q/k/v head dim AND pool kv dim
+
+    def local(q, k, v, kp, vp, tables, lens):
+        return paged_attention_step(q, k, v, kp, vp, tables, lens,
+                                    sm_scale=sm_scale)
+
+    f = shard_map_compat(
+        local, mesh,
+        in_specs=(heads, heads, heads, heads, heads,
+                  P(None, None), P(None)),
+        out_specs=(heads, heads, heads))
+    return f(qh, kh, vh, k_pool, v_pool, block_tables, cache_lens)
 
 
 def paged_verify_attention(q, k_pool, v_pool, block_tables,

@@ -27,7 +27,18 @@ import pytest
 # Suite tiering: tests measured >=~9s on the 8-device CPU mesh (r4
 # --durations sweep) carry the ``slow`` marker. The FULL suite is the
 # default; ``pytest -m "not slow"`` is the <8-min iteration tier.
+# r6 re-sweep: rounds 4-6 added serving/spec/MoE tests without
+# re-measuring — the >=~15s outliers from the r6 --durations run moved
+# here so the tier keeps fitting its budget. (test_speculative.py's
+# 61s rollback property stays tier-1: that file's own
+# test_tier1_no_slow_marker guard pins every spec test to the tier.)
 _SLOW_TESTS = {
+    "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
+    "test_ep_dropless_vs_capacity_loss_parity",                 # 35s
+    "test_ep_dropless_output_matches_single_device",            # 35s
+    "test_dropless_trains_and_reports_zero_drop",               # 24s
+    "test_dropless_matches_padded_when_nothing_drops",          # 23s
+    "test_trace_summary_has_op_table",                          # 15s
     "test_pipeline_parallel_train_batch_engine",
     "test_llama_pipe_grads_match_nonpipe",
     "test_moe_generate_smoke",
