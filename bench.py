@@ -906,6 +906,132 @@ def _tp_serving_bench():
     return _json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _ragged_serving_bench():
+    """Ragged mixed-batch serving (the ISSUE-7 bar): a mixed-length
+    workload with CONCURRENT admissions — requests keep arriving while
+    earlier ones decode, the regime where the legacy path interleaves
+    chunk executables between decode launches — through the ONE ragged
+    executable vs the per-width zoo (``PADDLE_TPU_RAGGED_BATCH=0``,
+    interleaved prefill). Reports aggregate tok/s, per-step host
+    launch ms (p50/p99 of ``eng.step()`` wall time — every launch +
+    dispatch round-trip of a tick), ``executables_compiled`` and
+    ``recompiles_measured`` (must be 0 after warmup on BOTH paths),
+    plus a speculative (gamma=2 n-gram) pairing on repetitive text."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_RAGGED_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_RAGGED_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_RAGGED_FFN", 5632)),
+        num_hidden_layers=int(os.environ.get("BENCH_RAGGED_LAYERS", 8)),
+        num_attention_heads=16,
+        num_key_value_heads=8, max_position_embeddings=1024,
+        dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_RAGGED_SLOTS", 8))
+    new = int(os.environ.get("BENCH_RAGGED_NEW", 48))
+    n_req = int(os.environ.get("BENCH_RAGGED_REQS", 24))
+    chunk = int(os.environ.get("BENCH_RAGGED_CHUNK", 64))
+    plens = [32, 64, 96, 160, 224, 128, 48, 192]
+    rng = np.random.RandomState(0)
+
+    def rep_prompt(n):
+        phrase = rng.randint(1, cfg.vocab_size, (8,))
+        return np.tile(phrase, n // 8)
+
+    # prompts built ONCE per workload so ragged and legacy (and the
+    # spec pairing) are measured on IDENTICAL requests — n-gram
+    # acceptance depends on prompt content, so a fresh draw per engine
+    # would conflate path difference with workload difference
+    workloads = {}
+    for rep in (False, True):
+        mk = rep_prompt if rep else \
+            (lambda n: rng.randint(1, cfg.vocab_size, (n,)))
+        workloads[rep] = ([mk(plens[i % len(plens)])
+                           for i in range(n_req)],
+                          [mk(p) for p in plens])       # + warmup set
+
+    def run_engine(ragged, gamma=0, repetitive=False):
+        os.environ["PADDLE_TPU_RAGGED_BATCH"] = "1" if ragged else "0"
+        try:
+            prompts, warm = workloads[repetitive]
+            eng = ServingEngine(model, ServingConfig(
+                num_slots=slots, block_size=32, max_model_len=512,
+                max_new_tokens=new, min_prefill_bucket=32,
+                prefill_chunk=chunk, num_speculative_tokens=gamma,
+                # legacy comparison point: the interleaved scheduler
+                # (chunk execs between decode steps); ragged ignores it
+                max_prefill_chunks_per_step=0 if ragged else 1))
+            eng.serve([p.copy() for p in warm],
+                      max_new_tokens=4)                      # warmup
+            st0 = eng.stats()
+            comp0 = st0["executables_compiled"]
+            tokens0 = st0["tokens_total"]
+            queue = [p.copy() for p in prompts]
+            step_ms = []
+            t0 = time.perf_counter()
+            while queue or eng.num_queued or eng.num_active:
+                # concurrent admissions: keep the queue primed so
+                # prefill work is ALWAYS pending alongside decode
+                while queue and eng.num_queued < 2:
+                    eng.submit(queue.pop(0), new)
+                s0 = time.perf_counter()
+                eng.step()
+                step_ms.append(1000 * (time.perf_counter() - s0))
+            wall = time.perf_counter() - t0
+            st = eng.stats()
+            eng.shutdown()
+            lat = np.sort(np.asarray(step_ms))
+            return {
+                "aggregate_tokens_per_sec":
+                    round((st["tokens_total"] - tokens0) / wall, 1),
+                "step_launch_ms_p50": round(float(
+                    lat[len(lat) // 2]), 2),
+                "step_launch_ms_p99": round(float(
+                    lat[min(len(lat) - 1, int(len(lat) * 0.99))]), 2),
+                "steps": len(step_ms),
+                "executables_compiled": st["executables_compiled"],
+                "recompiles_measured":
+                    st["executables_compiled"] - comp0,
+                "ragged_batch": st["ragged_batch"],
+            }
+        finally:
+            os.environ.pop("PADDLE_TPU_RAGGED_BATCH", None)
+
+    ragged = run_engine(True)
+    legacy = run_engine(False)
+    spec_ragged = run_engine(True, gamma=2, repetitive=True)
+    spec_legacy = run_engine(False, gamma=2, repetitive=True)
+    out = {
+        "ragged": ragged,
+        "legacy_interleaved": legacy,
+        "spec_ragged": spec_ragged,
+        "spec_legacy_interleaved": spec_legacy,
+        "speedup_tokens_per_sec": round(
+            ragged["aggregate_tokens_per_sec"]
+            / max(legacy["aggregate_tokens_per_sec"], 1e-9), 3),
+        "spec_speedup_tokens_per_sec": round(
+            spec_ragged["aggregate_tokens_per_sec"]
+            / max(spec_legacy["aggregate_tokens_per_sec"], 1e-9), 3),
+        "executables_collapsed": (
+            f"{legacy['executables_compiled']} -> "
+            f"{ragged['executables_compiled']}"),
+        "num_slots": slots, "max_new_tokens": new,
+        "requests": n_req, "prefill_chunk": chunk,
+        "workload_prompt_lens": plens,
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -1016,6 +1142,10 @@ def main():
     except Exception as exc:
         serving_tp = {"error": repr(exc)}
     try:
+        serving_ragged = _ragged_serving_bench()
+    except Exception as exc:
+        serving_ragged = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -1029,6 +1159,7 @@ def main():
               "speculative": speculative,
               "serving_prefix": serving_prefix,
               "serving_tp": serving_tp,
+              "serving_ragged": serving_ragged,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -1045,8 +1176,8 @@ def main():
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
             if k not in ("decode", "serving", "speculative",
-                         "serving_prefix", "serving_tp", "flashmask",
-                         "moe_profile")
+                         "serving_prefix", "serving_tp",
+                         "serving_ragged", "flashmask", "moe_profile")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -1082,6 +1213,17 @@ def main():
              "tp4_serving_speedup":
              serving_tp.get("tp4", {}).get("speedup_vs_tp1")
              if isinstance(serving_tp, dict) else None,
+             "ragged_serving_tokens_per_sec":
+             serving_ragged.get("ragged", {}).get(
+                 "aggregate_tokens_per_sec")
+             if isinstance(serving_ragged, dict) else None,
+             "ragged_serving_speedup":
+             serving_ragged.get("speedup_tokens_per_sec")
+             if isinstance(serving_ragged, dict) else None,
+             "ragged_executables_compiled":
+             serving_ragged.get("ragged", {}).get(
+                 "executables_compiled")
+             if isinstance(serving_ragged, dict) else None,
              "flashmask_16k_block_skip_speedup":
              flashmask.get("block_skip_speedup")
              if isinstance(flashmask, dict) else None},

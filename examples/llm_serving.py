@@ -163,6 +163,36 @@ def main(argv=None):
     else:
         print("tensor-parallel engine: skipped (1 device visible; "
               "run under a multi-chip/8-CPU-device mesh)")
+
+    # ---- 6. ragged mixed-batch serving: ONE executable per engine
+    # The engines above already ran the ragged step (the default):
+    # decode rows, verify windows and prefill chunks ride ONE compiled
+    # launch per tick. Pin the collapse and assert the kill-switch
+    # (per-width zoo) produces identical greedy tokens.
+    eng = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96, prefill_chunk=16))
+    ragged_outs = eng.serve(list(prompts), max_new_tokens=6)
+    st_ragged = eng.stats()
+    eng.shutdown()
+    assert st_ragged["ragged_batch"] and \
+        st_ragged["executables_compiled"] == 1, st_ragged
+    os.environ["PADDLE_TPU_RAGGED_BATCH"] = "0"
+    try:
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=2, block_size=8, max_model_len=96,
+            prefill_chunk=16))
+        legacy_outs = eng.serve(list(prompts), max_new_tokens=6)
+        st_legacy = eng.stats()
+        eng.shutdown()
+    finally:
+        del os.environ["PADDLE_TPU_RAGGED_BATCH"]
+    for a, b in zip(ragged_outs, legacy_outs):
+        assert a.tolist() == b.tolist(), \
+            "ragged mixed batch changed the served tokens"
+    print(f"ragged mixed-batch engine: "
+          f"{st_ragged['executables_compiled']} executable vs "
+          f"{st_legacy['executables_compiled']} in the per-width zoo; "
+          f"tokens exact vs PADDLE_TPU_RAGGED_BATCH=0")
     return n_ok / 12.0, losses
 
 

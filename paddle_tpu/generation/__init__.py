@@ -157,7 +157,8 @@ class GenerationMixin:
 
     def _build_model_step(self, binder, buffers):
         def model_step(params_a, tok_ids, caches, off, mask=None,
-                      pos=None, block_tables=None, cache_lens=None):
+                      pos=None, block_tables=None, cache_lens=None,
+                      ragged_meta=None):
             t_caches = [(_wrap_out(k), _wrap_out(v)) for k, v in caches]
             kwargs = {"caches": t_caches}
             if off is not None:
@@ -170,6 +171,12 @@ class GenerationMixin:
                 # paged decode: caches are the shared (k_pool, v_pool)
                 kwargs["block_tables"] = _wrap_out(block_tables)
                 kwargs["cache_lens"] = _wrap_out(cache_lens)
+            if ragged_meta is not None:
+                # ragged mixed batch: (q_lens, row_starts, row_slot,
+                # row_pos, narrow_iota, win_iota) describing the
+                # packed row buffer
+                kwargs["ragged_meta"] = tuple(
+                    _wrap_out(x) for x in ragged_meta)
             out, _ = binder.call(
                 params_a, buffers, (_wrap_out(tok_ids),), kwargs)
             logits, new_caches = out

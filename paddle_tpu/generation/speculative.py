@@ -47,7 +47,7 @@ import numpy as np
 
 __all__ = ["speculative_enabled", "ngram_propose", "spec_exclusion_reason",
            "draft_exclusion_reason", "build_verify_step",
-           "build_draft_loop", "SpecGenerator"]
+           "accept_from_filtered", "build_draft_loop", "SpecGenerator"]
 
 
 def speculative_enabled() -> bool:
@@ -167,6 +167,60 @@ def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
 # verify step
 # ---------------------------------------------------------------------------
 
+def accept_from_filtered(f, toks, dq, key, *, gamma, do_sample):
+    """Window acceptance on ALREADY-FILTERED target logits — the
+    shared core of ``build_verify_step`` (per-width verify executable)
+    and the serving engine's ragged mixed-batch step (which gathers
+    its window logits out of one packed row buffer before calling
+    this): given ``f`` [S, gamma+1, V] (the target's window logits
+    after the temperature/top-k/top-p pipeline) and the window tokens
+    ``toks`` [S, gamma+1] = ``[cur, d_1..d_gamma]``, returns
+    ``(out [S, gamma+1], accept [S, gamma], picked_logp [S, gamma+1])``
+    with exactly the semantics documented on ``build_verify_step``.
+    ``dq`` is the draft's filtered distribution (None = one-hot
+    drafter); ``key`` is consumed only when ``do_sample``."""
+    if not do_sample:
+        logp = jax.nn.log_softmax(f, axis=-1)
+        out = jnp.argmax(f, axis=-1).astype(jnp.int32)
+        accept = out[:, :-1] == toks[:, 1:]
+        picked = jnp.take_along_axis(
+            logp, out[..., None], axis=-1)[..., 0]
+        return out, accept, picked
+
+    p = jax.nn.softmax(f, axis=-1)                  # [S, G+1, V]
+    s, _, v = p.shape
+    d = toks[:, 1:].astype(jnp.int32)               # [S, G]
+    pd = jnp.take_along_axis(
+        p[:, :gamma], d[..., None], axis=-1)[..., 0]
+    if dq is None:
+        # one-hot draft: q(d_i) = 1, residual = p with d_i removed
+        qd = jnp.ones_like(pd)
+        hit = jax.lax.broadcasted_iota(
+            jnp.int32, (s, gamma, v), 2) == d[..., None]
+        res = jnp.where(hit, 0.0, p[:, :gamma])
+    else:
+        qd = jnp.take_along_axis(dq, d[..., None], axis=-1)[..., 0]
+        res = jnp.maximum(p[:, :gamma] - dq, 0.0)
+    ku, kr, kb = jax.random.split(key, 3)
+    u = jax.random.uniform(ku, (s, gamma))
+    accept = u * qd < pd            # u < p/q without dividing by 0
+    rs = jnp.sum(res, axis=-1, keepdims=True)
+    # degenerate residual (q == p exactly): resample from p
+    res = jnp.where(rs > 0.0, res / jnp.maximum(rs, 1e-37),
+                    p[:, :gamma])
+    rtok = jax.random.categorical(
+        kr, jnp.log(jnp.maximum(res, 1e-37))
+        + jnp.where(res > 0.0, 0.0, -jnp.inf)).astype(jnp.int32)
+    bonus = jax.random.categorical(kb, f[:, gamma]) \
+        .astype(jnp.int32)
+    out = jnp.concatenate(
+        [jnp.where(accept, d, rtok), bonus[:, None]], axis=1)
+    logp = jax.nn.log_softmax(f, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, out[..., None], axis=-1)[..., 0]
+    return out, accept, picked
+
+
 def build_verify_step(model_step, *, gamma, do_sample, temperature,
                       top_k, top_p, onehot_draft=True,
                       gather_logits=None):
@@ -210,11 +264,8 @@ def build_verify_step(model_step, *, gamma, do_sample, temperature,
     if not do_sample:
         def verify(params, pools, tables, lens, toks):
             f, pools = _target(params, pools, tables, lens, toks)
-            logp = jax.nn.log_softmax(f, axis=-1)
-            out = jnp.argmax(f, axis=-1).astype(jnp.int32)
-            accept = out[:, :-1] == toks[:, 1:]
-            picked = jnp.take_along_axis(
-                logp, out[..., None], axis=-1)[..., 0]
+            out, accept, picked = accept_from_filtered(
+                f, toks, None, None, gamma=gamma, do_sample=False)
             return out, accept, picked, pools
         return verify
 
@@ -229,37 +280,8 @@ def build_verify_step(model_step, *, gamma, do_sample, temperature,
 
     def _sample_accept(params, pools, tables, lens, toks, dq, key):
         f, pools = _target(params, pools, tables, lens, toks)
-        p = jax.nn.softmax(f, axis=-1)                  # [S, G+1, V]
-        s, _, v = p.shape
-        d = toks[:, 1:].astype(jnp.int32)               # [S, G]
-        pd = jnp.take_along_axis(
-            p[:, :gamma], d[..., None], axis=-1)[..., 0]
-        if dq is None:
-            # one-hot draft: q(d_i) = 1, residual = p with d_i removed
-            qd = jnp.ones_like(pd)
-            hit = jax.lax.broadcasted_iota(
-                jnp.int32, (s, gamma, v), 2) == d[..., None]
-            res = jnp.where(hit, 0.0, p[:, :gamma])
-        else:
-            qd = jnp.take_along_axis(dq, d[..., None], axis=-1)[..., 0]
-            res = jnp.maximum(p[:, :gamma] - dq, 0.0)
-        ku, kr, kb = jax.random.split(key, 3)
-        u = jax.random.uniform(ku, (s, gamma))
-        accept = u * qd < pd            # u < p/q without dividing by 0
-        rs = jnp.sum(res, axis=-1, keepdims=True)
-        # degenerate residual (q == p exactly): resample from p
-        res = jnp.where(rs > 0.0, res / jnp.maximum(rs, 1e-37),
-                        p[:, :gamma])
-        rtok = jax.random.categorical(
-            kr, jnp.log(jnp.maximum(res, 1e-37))
-            + jnp.where(res > 0.0, 0.0, -jnp.inf)).astype(jnp.int32)
-        bonus = jax.random.categorical(kb, f[:, gamma]) \
-            .astype(jnp.int32)
-        out = jnp.concatenate(
-            [jnp.where(accept, d, rtok), bonus[:, None]], axis=1)
-        logp = jax.nn.log_softmax(f, axis=-1)
-        picked = jnp.take_along_axis(
-            logp, out[..., None], axis=-1)[..., 0]
+        out, accept, picked = accept_from_filtered(
+            f, toks, dq, key, gamma=gamma, do_sample=True)
         return out, accept, picked, pools
 
     return verify

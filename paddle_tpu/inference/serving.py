@@ -69,6 +69,28 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   window tokens would compete for expert capacity — same reasoning as
   prompt bucketing). See docs/OPS.md "Speculative decoding".
 
+- **Ragged mixed-batch serving — ONE executable per engine.** By
+  default every engine tick runs ONE AOT-compiled ragged step
+  (``_compile_ragged_step``) that consumes ALL active work as a single
+  packed row buffer: decoding slots contribute 1 query row, speculative
+  verify windows ``gamma + 1`` rows, and pending prefill chunks up to
+  ``prefill_chunk`` rows — partitioned by per-slot ``q_lens`` and
+  cumulative ``row_starts`` (*Ragged Paged Attention*, with the
+  surrounding write/sample fused into the same launch per the MPK
+  mega-kernelization direction). The per-width decode/verify/chunk
+  executables (and the interleave scheduler that juggled them)
+  collapse: steady-state executables per engine is 1 (2 with a draft
+  model — its proposal scan + prefill priming fuse into one draft
+  ragged step), every tick is one dispatch round-trip, and admission
+  prefill overlaps running decodes for free (prefill rows ride the
+  same launch — no head-of-line interleave budget needed, no NULL-row
+  table dance: a pending slot simply contributes 0 decode rows).
+  Greedy outputs are token-exact vs the per-width zoo (the ragged XLA
+  fallback is bitwise the per-width fallback per row). Kill switch
+  ``PADDLE_TPU_RAGGED_BATCH=0`` (or ``ServingConfig(
+  ragged_batch=False)``) restores the per-width executables
+  bit-for-bit. See docs/OPS.md "Ragged mixed-batch serving".
+
 - **Tensor-parallel serving** (``ServingConfig(tp_degree=N)``): every
   serving executable — batched decode, fixed-gamma verify, fixed-chunk
   prefill, the draft loop and the ``copy_blocks`` COW — is sharded
@@ -124,6 +146,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import monitor
 from ..ops import paged_cache as _pc
+from ..ops.pallas import paged_attention as _pa
 
 __all__ = ["ServingConfig", "ServingRequest", "ServingEngine"]
 
@@ -186,6 +209,17 @@ class ServingConfig:
     # step() themselves (otherwise finished results accumulate
     # unboundedly; run() then returns {}).
     retain_results: bool = True
+    # ragged mixed-batch serving: ONE executable per engine consumes
+    # decode rows + verify windows + prefill chunk rows as a single
+    # packed ragged batch each tick. False (or
+    # PADDLE_TPU_RAGGED_BATCH=0) restores the per-width
+    # decode/verify/chunk executable zoo bit-for-bit.
+    ragged_batch: bool = True
+    # per-tick prefill row budget of the ragged step (the executable's
+    # packed width is num_slots * (gamma+1) + this). None = one
+    # prefill_chunk's worth; shrink to trade time-to-first-token for
+    # smaller per-tick padding when slots mostly decode.
+    ragged_prefill_rows: Optional[int] = None
     # tensor-parallel degree: shard every serving executable over a
     # Mesh(devices[:tp_degree], ("mp",)) axis — the KV pool splits on
     # kv_heads, params column/row-wise, tables/lengths/keys replicate,
@@ -360,6 +394,28 @@ class ServingEngine:
         nb = (1 + cfg.num_slots * self._mb) if cfg.num_blocks is None \
             else int(cfg.num_blocks)
         self._alloc = _pc.BlockAllocator(nb)
+        # -- ragged mixed-batch layout --------------------------------
+        self._ragged = bool(getattr(cfg, "ragged_batch", True)) and \
+            os.environ.get("PADDLE_TPU_RAGGED_BATCH", "1") != "0"
+        if self._chunked:
+            want = cfg.ragged_prefill_rows
+            self._prefill_rows = max(1, min(
+                int(self._chunk if want is None else want),
+                int(cfg.max_model_len)))
+        else:
+            self._prefill_rows = 0      # bucketed prefill at admission
+        # static packed width: every active slot's decode/verify rows
+        # plus one tick's prefill row budget always fit
+        self._rows = cfg.num_slots * (gamma + 1) + self._prefill_rows
+        # static per-slot row ceiling (the ragged grid's window dim)
+        self._wmax = max(gamma + 1,
+                         min(self._chunk, self._prefill_rows)
+                         if self._chunked else 1)
+        # pad rows park at a position past every table's reach — the
+        # write null-routes and the rope/position gathers clamp
+        self._overflow = self._mb * self._bs
+        self._ragged_exec = None
+        self._ragged_draft_exec = None
         self._pools = self._init_caches(model, nb)
         self._draft_model = draft_model \
             if gamma and cfg.drafter == "model" else None
@@ -402,6 +458,13 @@ class ServingEngine:
         # global telemetry shared by every engine; stats() must report
         # THIS engine)
         self._n_decode_compiles = 0
+        self._n_exec_compiled = 0       # EVERY executable this engine
+        #                                 built (decode+verify+chunk+
+        #                                 prefill+cow, target AND draft)
+        # snapshot of the op-layer's process-wide fallback counter:
+        # stats() reports the DELTA, i.e. fallback events observed
+        # since this engine was created, not another engine's history
+        self._fallbacks0 = sum(_pa.kernel_fallback_counts().values())
         self._n_decode_steps = 0
         self._n_tokens = 0
         self._n_completed = 0
@@ -530,7 +593,11 @@ class ServingEngine:
         """One engine tick: admit what fits, decode one token (or
         verify a speculative window) for every active slot, retire
         finished sequences. Returns this tick's
-        ``[(request_id, token), ...]`` (admission prefills included)."""
+        ``[(request_id, token), ...]`` (admission prefills included).
+        On the default ragged path one tick is ONE executable launch
+        covering decode + verify + prefill rows together."""
+        if self._ragged:
+            return self._step_ragged()
         if self._gamma:
             return self._step_spec()
         emitted = self._admit()
@@ -644,38 +711,241 @@ class ServingEngine:
             self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
         for i in active:
-            slot = self._slots[i]
-            # EOS inside the window and max_new room both truncate
-            kept, n_acc = _spec.commit_window(
-                out[i], accept[i], slot.max_new - slot.n_emitted,
-                self._eos)
-            slot.n_emitted += len(kept)
-            slot.history.extend(kept)
-            for tok in kept:
-                self._emit(slot.rid, tok)
-                emitted.append((slot.rid, tok))
-            # accepted drafts that were actually USED: EOS-inside-window
-            # or max_new room can truncate the emission below n_acc+1,
-            # and the metrics must agree with what clients received
-            n_used = min(n_acc, len(kept))
-            self._n_spec_proposed += g
-            self._n_spec_accepted += n_used
-            self._n_spec_verifies += 1
-            self._n_spec_emitted += len(kept)
-            self._m_spec_len.observe(len(kept))
-            self._m_spec_proposed.inc(g)
-            self._m_spec_accepted.inc(n_used)
-            if kept[-1] == self._eos or slot.n_emitted >= slot.max_new:
-                self._retire(i)
-            else:
-                # commit the window prefix [cur, accepted drafts]; the
-                # rejected tail rolls back by NOT advancing over it
-                slot.cache_len += n_acc + 1
-                slot.last_token = kept[-1]
-                self._trim_blocks(i)
+            self._commit_verify_window(i, out[i], accept[i], emitted)
         if self._n_spec_proposed:
             self._m_spec_rate.set(
                 self._n_spec_accepted / self._n_spec_proposed)
+        return emitted
+
+    def _commit_verify_window(self, i, out_row, accept_row, emitted):
+        """Commit one slot's verified speculative window — the SHARED
+        host-side half of acceptance (legacy ``_step_spec`` and the
+        ragged tick both call it, so emission/rollback/metric
+        semantics cannot drift between the paths): emit the kept
+        prefix, account acceptance, retire on EOS/max_new, else
+        advance ``cache_len`` over the accepted prefix (rollback of
+        the rejected tail = NOT advancing over it) and trim overhang
+        blocks."""
+        from ..generation import speculative as _spec
+        g = self._gamma
+        slot = self._slots[i]
+        # EOS inside the window and max_new room both truncate
+        kept, n_acc = _spec.commit_window(
+            out_row, accept_row, slot.max_new - slot.n_emitted,
+            self._eos)
+        slot.n_emitted += len(kept)
+        slot.history.extend(kept)
+        for tok in kept:
+            self._emit(slot.rid, tok)
+            emitted.append((slot.rid, tok))
+        # accepted drafts that were actually USED: EOS-inside-window
+        # or max_new room can truncate the emission below n_acc+1,
+        # and the metrics must agree with what clients received
+        n_used = min(n_acc, len(kept))
+        self._n_spec_proposed += g
+        self._n_spec_accepted += n_used
+        self._n_spec_verifies += 1
+        self._n_spec_emitted += len(kept)
+        self._m_spec_len.observe(len(kept))
+        self._m_spec_proposed.inc(g)
+        self._m_spec_accepted.inc(n_used)
+        if kept[-1] == self._eos or slot.n_emitted >= slot.max_new:
+            self._retire(i)
+        else:
+            # commit the window prefix [cur, accepted drafts]; the
+            # rejected tail rolls back by NOT advancing over it
+            slot.cache_len += n_acc + 1
+            slot.last_token = kept[-1]
+            self._trim_blocks(i)
+
+    def _step_ragged(self) -> List[tuple]:
+        """Ragged mixed-batch tick (the default path): pack every live
+        query row — 1 per decoding slot, ``gamma + 1`` per verifying
+        slot, up to the prefill row budget for pending prompts — into
+        ONE launch of the engine's single compiled executable, then
+        commit tokens, prefill progress, speculative accept/reject and
+        retirements host-side. The packed width is static
+        (``num_slots * (gamma+1) + prefill_rows``); slots with no work
+        contribute zero rows, so raggedness lives entirely in the
+        ``q_lens``/``row_starts`` VALUES and steady state runs zero
+        recompiles exactly like the per-width path it replaces."""
+        from ..generation import speculative as _spec
+        emitted = self._admit()
+        cfg = self.config
+        g = self._gamma
+        n_slots = cfg.num_slots
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and s.pend_pos is None]
+        pending = [i for i, s in enumerate(self._slots)
+                   if s is not None and s.pend_pos is not None]
+        if not active and not pending:
+            return emitted
+        if active:
+            # room for this tick's write positions (the verify window
+            # overhangs by up to gamma speculated slots)
+            self._ensure_blocks(active, horizon=g + 1)
+
+        # -- pack the tick's work into per-slot row counts -------------
+        q_lens = np.zeros(n_slots, np.int64)
+        base = np.zeros(n_slots, np.int64)
+        given = {}              # slot -> prefill rows granted this tick
+        cap = min(self._chunk, self._prefill_rows)
+        budget = self._prefill_rows
+        for i in active:
+            q_lens[i] = g + 1
+            base[i] = self._slots[i].cache_len
+        for i in pending:
+            if budget <= 0:
+                break
+            slot = self._slots[i]
+            # ONE wide (chunk-width) slot per tick — the fallback's
+            # two-lane contract; later pending slots still trickle at
+            # the narrow (gamma+1) width, so nothing starves
+            cap_i = cap if not given else (g + 1)
+            k = min(int(slot.prompt.size) - slot.pend_pos, cap_i,
+                    budget)
+            if k <= 0:
+                continue
+            q_lens[i] = k
+            base[i] = slot.pend_pos
+            given[i] = k
+            budget -= k
+        if not int(q_lens.sum()):
+            return emitted      # budget exhausted by earlier slots
+        row_slot, row_pos, row_starts, last_rows = _pc.ragged_row_meta(
+            q_lens, base, self._rows, self._overflow)
+        if self._tables_dev is None:
+            self._tables_dev = self._dev(self._tables)
+
+        # -- draft proposals (speculative mode) ------------------------
+        toks = None
+        dq = None
+        if g:
+            toks = np.full((n_slots, g + 1), self._pad, np.int32)
+            for i in active:
+                toks[i, 0] = self._slots[i].last_token
+        if g and self._draft_model is not None:
+            # ONE fused draft executable: prime its cache over this
+            # tick's prefill rows, then run the gamma+1 proposal scan.
+            # Verify rows are parked at the overflow position for the
+            # prime (their K/V comes from the scan itself), and
+            # non-verifying slots' scan writes null-route past the
+            # table's reach — a pending slot's real blocks are never
+            # touched by the draft.
+            prime_ids = np.full(self._rows, self._pad, np.int32)
+            prime_pos = row_pos.copy()
+            prime_q = q_lens.copy()
+            for i in active:
+                s0, n = int(row_starts[i]), int(q_lens[i])
+                prime_pos[s0:s0 + n] = self._overflow
+                prime_q[i] = 0
+            for i, k in given.items():
+                s0 = int(row_starts[i])
+                slot = self._slots[i]
+                prime_ids[s0:s0 + k] = \
+                    slot.prompt[slot.pend_pos:slot.pend_pos + k]
+            scan_lens = np.full(n_slots, self._overflow, np.int64)
+            for i in active:
+                scan_lens[i] = self._slots[i].cache_len
+            sub = self._next_key()
+            # TWO packed uploads carry the whole tick's draft metadata
+            drows = np.stack([prime_ids, row_slot, prime_pos]) \
+                .astype(np.int32)
+            dslots = np.stack([base, prime_q, row_starts, scan_lens,
+                               toks[:, 0]]).astype(np.int32)
+            dargs = (self._dparams, self._dpools, self._tables_dev,
+                     self._dev(drows), self._dev(dslots), sub)
+            if self._ragged_draft_exec is None:
+                self._ragged_draft_exec = self._compile_ragged_draft(
+                    dargs)
+            with _quiet_donation():
+                outs = self._ragged_draft_exec(*dargs)
+            if self._do_sample:
+                props, dq, self._dpools = outs
+            else:
+                props, self._dpools = outs
+            toks[:, 1:] = np.asarray(props)
+        elif g:
+            for i in active:
+                toks[i, 1:] = _spec.ngram_propose(
+                    self._slots[i].history, g, self._ngram_max)
+
+        # -- the ONE mixed-batch launch --------------------------------
+        ids = np.full(self._rows, self._pad, np.int32)
+        for i in active:
+            s0 = int(row_starts[i])
+            if g:
+                ids[s0:s0 + g + 1] = toks[i]
+            else:
+                ids[s0] = self._slots[i].last_token
+        for i, k in given.items():
+            s0 = int(row_starts[i])
+            slot = self._slots[i]
+            ids[s0:s0 + k] = \
+                slot.prompt[slot.pend_pos:slot.pend_pos + k]
+        sub = self._next_key()
+        # TWO packed uploads carry the whole tick's row layout: the
+        # per-row triple (ids, slot, position) and the per-slot quad
+        # (base length, q_lens, row_starts, last_rows)
+        rows_pack = np.stack([ids, row_slot, row_pos]).astype(np.int32)
+        slots_pack = np.stack([base, q_lens, row_starts,
+                               last_rows]).astype(np.int32)
+        args = [self._params, self._pools, self._tables_dev,
+                self._dev(rows_pack), self._dev(slots_pack)]
+        if g:
+            args.append(self._dev(toks))
+            if self._do_sample and dq is not None:
+                args.append(dq)
+        args.append(sub)
+        if self._ragged_exec is None:
+            self._ragged_exec = self._compile_ragged_step(tuple(args))
+        with _quiet_donation():
+            outs = self._ragged_exec(*args)
+
+        self._m_steps.inc()
+        self._n_decode_steps += 1
+        if self._mesh is not None:
+            self._m_tp_bytes.inc(self._tp_step_bytes)
+            self._n_tp_bytes += self._tp_step_bytes
+        self._m_util.observe(len(active) / n_slots)
+
+        # -- commit decode / verify rows -------------------------------
+        if not g:
+            tok_arr = np.asarray(outs[0])
+            self._pools = outs[1]
+            for i in active:
+                slot = self._slots[i]
+                tok = int(tok_arr[i])
+                slot.cache_len += 1
+                slot.last_token = tok
+                slot.n_emitted += 1
+                slot.history.append(tok)
+                self._emit(slot.rid, tok)
+                emitted.append((slot.rid, tok))
+                if tok == self._eos or slot.n_emitted >= slot.max_new:
+                    self._retire(i)
+        else:
+            tok_arr = np.asarray(outs[0])       # prefill first tokens
+            out = np.asarray(outs[1])
+            accept = np.asarray(outs[2])
+            self._pools = outs[3]
+            for i in active:
+                self._commit_verify_window(i, out[i], accept[i],
+                                           emitted)
+            if self._n_spec_proposed:
+                self._m_spec_rate.set(
+                    self._n_spec_accepted / self._n_spec_proposed)
+
+        # -- commit prefill progress -----------------------------------
+        for i, k in given.items():
+            slot = self._slots[i]
+            slot.pend_pos += k
+            slot.cache_len = slot.pend_pos
+            self._n_prefill_chunks += 1
+            if slot.pend_pos >= int(slot.prompt.size):
+                # the chunk's last row IS the final prompt row: its
+                # sampled logits are the request's first token
+                self._finish_prefill(i, int(tok_arr[i]), emitted)
         return emitted
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -711,6 +981,22 @@ class ServingEngine:
             "requests_completed": self._n_completed,
             "prefill_compiles": self._n_prefill_compiles,
             "prefill_chunks": self._n_prefill_chunks,
+            # EVERY executable this engine built (decode + verify +
+            # chunk + prefill buckets + cow, target AND draft) — the
+            # ragged collapse is assertable from telemetry: 1 in
+            # steady state (2 with a draft model). Present on the
+            # legacy path too, where it counts the whole zoo.
+            "executables_compiled": self._n_exec_compiled,
+            "ragged_batch": self._ragged,
+            # paged-attention entry points that lost the Pallas kernel
+            # on a TPU backend since THIS engine was created (0 on
+            # CPU; the op-layer counter is process-wide, so the
+            # engine-lifetime delta is what "my engine silently fell
+            # off the kernel" means — a concurrent engine's events
+            # still land in the window, but never another's history)
+            "kernel_fallbacks": sum(
+                _pa.kernel_fallback_counts().values())
+            - self._fallbacks0,
             "chunked_prefill": self._chunked,
             "prefix_cache_enabled": self._prefix_on,
             "prefix_blocks_reused": self._n_prefix_blocks,
@@ -933,7 +1219,10 @@ class ServingEngine:
         appear as op rows with per-shard payload bytes; GSPMD-inserted
         ones only materialize post-partitioning and are proxied by the
         ``sharding_constraint`` row. The decode/verify census feeds the
-        per-step collective-bytes counter."""
+        per-step collective-bytes counter. Every executable the engine
+        ever builds flows through here, so ``executables_compiled`` in
+        ``stats()`` is exact on the ragged AND legacy paths."""
+        self._n_exec_compiled += 1
         with self._trace_ctx(), _quiet_donation():
             trace = getattr(jitted, "trace", None) \
                 if self._mesh is not None else None
@@ -1010,13 +1299,17 @@ class ServingEngine:
             blocks, cached = self._map_prefix(req.prompt, n_real)
             self._reserved += worst - len(blocks)
             self._tables[i, :] = 0
-            if not (self._chunked and self._chunk_budget > 0):
-                # interleaved prefill keeps the GLOBAL table row null
-                # until the prefill completes: the batched decode step
-                # masks pending slots by table (null-block writes/reads
-                # are harmless by construction, exactly like inactive
-                # slots); the chunk executable reads its row from
-                # ``slot.blocks`` directly
+            if self._ragged or not (self._chunked
+                                    and self._chunk_budget > 0):
+                # the ragged step needs the row live at once (a pending
+                # slot contributes ZERO query rows, so nothing can
+                # touch its blocks early — no NULL-row dance needed);
+                # legacy interleaved prefill instead keeps the GLOBAL
+                # table row null until the prefill completes: the
+                # batched decode step masks pending slots by table
+                # (null-block writes/reads are harmless by
+                # construction, exactly like inactive slots) and the
+                # chunk executable reads its row from ``slot.blocks``
                 self._tables[i, :len(blocks)] = blocks
             self._tables_dev = None
             # observe BEFORE prefill so the histogram measures queue
@@ -1041,11 +1334,12 @@ class ServingEngine:
                 bidx = cached // self._bs
                 if self._alloc.is_shared(blocks[bidx]):
                     self._cow(i, bidx)
-                if self._chunk_budget <= 0:
+                if not self._ragged and self._chunk_budget <= 0:
                     tok = self._advance_prefill(i)
                     self._finish_prefill(i, tok, emitted)
-                # else: prefill chunks advance inside step() ticks,
-                # interleaved with running slots' decode
+                # else: prefill rows ride the ragged step (or, on the
+                # legacy interleaved path, chunks advance inside
+                # step() ticks between running slots' decodes)
         self._sync_cache_metrics()
         return emitted
 
@@ -1466,6 +1760,134 @@ class ServingEngine:
         self._m_decode_compiles.inc()
         self._n_decode_compiles += 1
         return exec_
+
+    def _compile_ragged_step(self, args):
+        """AOT-compile THE ragged mixed-batch executable ONCE — the
+        whole per-width zoo (decode + verify + chunk prefill),
+        collapsed: a packed ``[R]`` token buffer runs the model over
+        every live row (``ragged_meta`` partitions it by slot), K/V
+        scatter per row, and the sampling head takes each slot's
+        continuation row from ``last_rows`` — decode rows sample their
+        only row, completing prefills their final prompt row, verify
+        windows run the shared acceptance core on their gamma+1 rows.
+        ONE logits gather serves all of it (under TP: still exactly
+        one explicit all_gather per step). Census name stays
+        ``decode``/``verify`` so telemetry keeps the per-step
+        collective contract of the per-width path."""
+        from ..generation import _filter_logits
+        from ..generation import speculative as _spec
+        cfg = self.config
+        g = self._gamma
+        r = self._rows
+        do_sample = self._do_sample
+
+        def ragged(params, pools, tables, rows_pack, slots_pack, *rest):
+            ids, row_slot, row_pos = (rows_pack[0], rows_pack[1],
+                                      rows_pack[2])
+            base, q_lens, row_starts, last_rows = (
+                slots_pack[0], slots_pack[1], slots_pack[2],
+                slots_pack[3])
+            nwin = jnp.arange(g + 1, dtype=jnp.int32)
+            win = jnp.arange(self._wmax, dtype=jnp.int32)
+            meta = (q_lens, row_starts, row_slot, row_pos, nwin, win)
+            logits, pools = self._model_step(
+                params, ids[None, :], pools, None, block_tables=tables,
+                cache_lens=base, ragged_meta=meta)
+            lg = logits[0]                          # [R, V(/tp)]
+            if not g:
+                (key,) = rest
+                rows = jnp.take(lg, last_rows.astype(jnp.int32),
+                                axis=0)
+                rows = self._gather_logits(rows)    # the ONE collective
+                _, sel = jax.random.split(key)
+                tok, _ = self._select(rows, sel)
+                return tok, pools
+            toks = rest[0]
+            dq = rest[1] if len(rest) == 3 else None
+            key = rest[-1]
+            # one take + ONE gather covers the per-slot continuation
+            # rows AND the verify windows
+            idx = row_starts.astype(jnp.int32)[:, None] \
+                + jnp.arange(g + 1, dtype=jnp.int32)[None, :]
+            take = jnp.concatenate(
+                [last_rows.astype(jnp.int32)[:, None], idx], axis=1)
+            rows = jnp.take(lg, jnp.clip(take, 0, r - 1).reshape(-1),
+                            axis=0)
+            rows = self._gather_logits(rows)
+            rows = rows.reshape(toks.shape[0], g + 2, -1)
+            sel_key, acc_key = jax.random.split(key)
+            first_tok, _ = self._select(rows[:, 0, :], sel_key)
+            f = _filter_logits(rows[:, 1:, :], do_sample=do_sample,
+                               temperature=cfg.temperature,
+                               top_k=cfg.top_k, top_p=cfg.top_p)
+            out, accept, _logp = _spec.accept_from_filtered(
+                f, toks, dq, acc_key, gamma=g, do_sample=do_sample)
+            return first_tok, out, accept, pools
+
+        jitted = jax.jit(ragged, donate_argnums=(1,))
+        name = "verify" if g else "decode"
+        exec_ = self._aot_compile(name, jitted, args)
+        if self._mesh is not None:
+            self._tp_step_bytes = self._tp_census_bytes(name)
+            if g and self._draft_model is not None:
+                # the fused draft step's gather sits inside its scan
+                # body (census walks it once; gamma+1 iterations move
+                # bytes per step)
+                self._tp_step_bytes += \
+                    (g + 1) * self._tp_census_bytes("draft")
+        self._m_decode_compiles.inc()
+        self._n_decode_compiles += 1
+        return exec_
+
+    def _compile_ragged_draft(self, args):
+        """AOT-compile the draft model's HALF of a ragged spec tick
+        ONCE — one fused executable: (1) prime the draft cache over
+        this tick's prefill rows (the ragged write, logits discarded —
+        the legacy per-chunk draft prefill twin, folded in), then
+        (2) run the gamma+1-step proposal scan. With a draft model the
+        engine's steady state is therefore exactly TWO executables."""
+        from ..generation import speculative as _spec
+        cfg = self.config
+        g = self._gamma
+        prime = self._chunked and self._prefill_rows > 0
+        loop = _spec.build_draft_loop(
+            self._draft_step, gamma=g, do_sample=self._do_sample,
+            temperature=cfg.temperature, top_k=cfg.top_k,
+            top_p=cfg.top_p, want_probs=self._do_sample,
+            gather_logits=self._gather_logits
+            if self._mesh is not None else None)
+
+        def dstep(dparams, dpools, tables, drows, dslots, key):
+            ids, row_slot, prime_pos = drows[0], drows[1], drows[2]
+            base, prime_q, row_starts, scan_lens, cur = (
+                dslots[0], dslots[1], dslots[2], dslots[3], dslots[4])
+            if prime:
+                nwin = jnp.arange(g + 1, dtype=jnp.int32)
+                win = jnp.arange(self._wmax, dtype=jnp.int32)
+                meta = (prime_q, row_starts, row_slot, prime_pos,
+                        nwin, win)
+
+                def _prime(dp):
+                    _, dp = self._draft_step(
+                        dparams, ids[None, :], dp, None,
+                        block_tables=tables, cache_lens=base,
+                        ragged_meta=meta)
+                    return dp
+
+                # no pending prefill rows this tick -> the prime
+                # forward would only null-route pad writes; skip the
+                # whole pass at runtime (same executable, zero
+                # steady-state recompiles)
+                dpools = jax.lax.cond(jnp.max(prime_q) > 0, _prime,
+                                      lambda dp: dp, dpools)
+            props, qp, dpools = loop(dparams, dpools, tables,
+                                     scan_lens, cur, key)
+            if qp is None:
+                return props, dpools
+            return props, qp, dpools
+
+        jitted = jax.jit(dstep, donate_argnums=(1,))
+        return self._aot_compile("draft", jitted, args)
 
     def _compile_draft(self, lens, toks, key):
         """AOT-compile the draft model's gamma+1-step proposal scan

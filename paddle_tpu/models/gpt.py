@@ -58,9 +58,36 @@ class GPTAttention(Layer):
         self.dropout_p = config.attention_probs_dropout_prob
 
     def forward(self, x, kv_cache=None, offset=None, block_tables=None,
-                cache_lens=None):
+                cache_lens=None, ragged_meta=None):
         b, l, d = x.shape
         qkv = self.qkv_proj(x)
+
+        if kv_cache is not None and block_tables is not None \
+                and ragged_meta is not None:
+            # ragged mixed batch: [1, R] packed rows over the pool
+            (q_lens, row_starts, row_slot, row_pos, narrow_iota,
+             win_iota) = ragged_meta
+
+            def attn_r(a, kp, vp, tables, lens, ql, rs, sl, pos_r,
+                       nwin, win):
+                from .llama import ragged_paged_attention_decode
+                q, k, v = jnp.split(a, 3, axis=-1)
+                r = b * l                        # packed rows (b == 1)
+                qh = q.reshape(r, self.num_heads, self.head_dim)
+                kh = k.reshape(r, self.num_heads, self.head_dim)
+                vh = v.reshape(r, self.num_heads, self.head_dim)
+                out, kp2, vp2 = ragged_paged_attention_decode(
+                    qh, kh, vh, kp, vp, tables, lens, ql, rs, sl,
+                    pos_r, nwin, win, self.head_dim)
+                return out.reshape(b, l, d), kp2, vp2
+
+            ctx, kp2, vp2 = apply_jax(
+                "gpt_attention_ragged", attn_r, qkv, kv_cache[0],
+                kv_cache[1], block_tables, cache_lens, q_lens,
+                row_starts, row_slot, row_pos, narrow_iota, win_iota,
+                n_outputs=3)
+            ctx = constraint(ctx, None, None, "mp")
+            return self.out_proj(ctx), (kp2, vp2)
 
         if kv_cache is not None and block_tables is not None:
             # paged decode: kv_cache is the shared (k_pool, v_pool)
@@ -128,12 +155,13 @@ class GPTDecoderLayer(Layer):
         self.dropout = Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, kv_cache=None, offset=None, block_tables=None,
-                cache_lens=None):
+                cache_lens=None, ragged_meta=None):
         new_cache = None
         if kv_cache is not None:
             a, new_cache = self.attn(self.ln_1(x), kv_cache, offset,
                                      block_tables=block_tables,
-                                     cache_lens=cache_lens)
+                                     cache_lens=cache_lens,
+                                     ragged_meta=ragged_meta)
         else:
             a = self.attn(self.ln_1(x))
         x = x + self.dropout(a)
@@ -160,11 +188,22 @@ class GPTModel(Layer):
                               config.layer_norm_epsilon)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                offset=None, block_tables=None, cache_lens=None):
+                offset=None, block_tables=None, cache_lens=None,
+                ragged_meta=None):
         input_ids = batch_shard(input_ids)
         l = input_ids.shape[1]
         if position_ids is None:
-            if cache_lens is not None:
+            if ragged_meta is not None:
+                # ragged mixed batch: each packed row carries its own
+                # absolute position (pad rows clamp to the last learned
+                # position — their output is discarded and their write
+                # null-routed)
+                from ..framework.core import _wrap_out as _w
+                from ..framework.core import as_jax as _aj
+                position_ids = _w(jnp.clip(
+                    _aj(ragged_meta[3]).astype(jnp.int32), 0,
+                    self.config.max_position_embeddings - 1)[None, :])
+            elif cache_lens is not None:
                 # paged decode: each slot sits at its own position
                 # (window token t of a speculative verify chunk at
                 # cache_lens + t)
@@ -186,7 +225,8 @@ class GPTModel(Layer):
             for layer, kv in zip(self.h, caches):
                 h, kv2 = layer(h, kv_cache=kv, offset=offset,
                                block_tables=block_tables,
-                               cache_lens=cache_lens)
+                               cache_lens=cache_lens,
+                               ragged_meta=ragged_meta)
                 new_caches.append(kv2)
             return self.ln_f(h), new_caches
         for layer in self.h:
@@ -241,13 +281,14 @@ class GPTForCausalLM(Layer, GenerationMixin):
         ]
 
     def forward(self, input_ids, labels=None, caches=None, offset=None,
-                block_tables=None, cache_lens=None):
+                block_tables=None, cache_lens=None, ragged_meta=None):
         from ..ops.linalg import matmul
         if caches is not None:
             h, new_caches = self.gpt(input_ids, caches=caches,
                                      offset=offset,
                                      block_tables=block_tables,
-                                     cache_lens=cache_lens)
+                                     cache_lens=cache_lens,
+                                     ragged_meta=ragged_meta)
             logits = matmul(h, self.gpt.embeddings.weight,
                             transpose_y=True)
             return logits, new_caches

@@ -177,6 +177,36 @@ def paged_attention_decode(qh, kh, vh, k_pool, v_pool, block_tables,
                                 block_tables, cache_lens, sm_scale=sm)
 
 
+def ragged_paged_attention_decode(qh, kh, vh, k_pool, v_pool,
+                                  block_tables, cache_lens, q_lens,
+                                  row_starts, row_slot, row_pos,
+                                  narrow_iota, win_iota, head_dim):
+    """Shared RAGGED mixed-batch step (Llama/GPT families): one packed
+    row buffer ``[R, H, D]`` carries every live query row of a serving
+    tick — decoding slots (1 row), speculative verify windows
+    (gamma+1 rows) and prefill chunks — partitioned by per-slot
+    ``q_lens``/``row_starts``; row ``r`` writes and attends at cache
+    position ``row_pos[r]`` of slot ``row_slot[r]``. The per-width
+    ``paged_attention_decode`` above is the uniform-width special case
+    of this step; the serving engine's ONE ragged executable is its
+    only caller. Tensor-parallel serving routes the same body through
+    ``shard_map`` exactly like the per-width wrapper. Returns
+    ``(out [R, H, D], new_k_pool, new_v_pool)``."""
+    from ..ops.pallas.paged_attention import (
+        ragged_attention_step, sharded_ragged_attention_step,
+        tp_shard_degree)
+    sm = 1.0 / math.sqrt(head_dim)
+    if tp_shard_degree(qh.shape[1], kh.shape[1]) > 1:
+        return sharded_ragged_attention_step(
+            qh, kh, vh, k_pool, v_pool, block_tables, cache_lens,
+            q_lens, row_starts, row_slot, row_pos, narrow_iota,
+            win_iota, sm_scale=sm)
+    return ragged_attention_step(
+        qh, kh, vh, k_pool, v_pool, block_tables, cache_lens, q_lens,
+        row_starts, row_slot, row_pos, narrow_iota, win_iota,
+        sm_scale=sm)
+
+
 def _rope_rotate(x, c, s):
     """Shared neox-halves rotation; c/s arrive pre-broadcast against
     [B, L, H, D/2]. Tables stay fp32 for precision; output is cast back
@@ -232,12 +262,19 @@ class LlamaAttention(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None, block_tables=None, cache_lens=None):
+                position_ids=None, block_tables=None, cache_lens=None,
+                ragged_meta=None):
         b, l, _ = hidden_states.shape
         q = self.q_proj(hidden_states)
         k = self.k_proj(hidden_states)
         v = self.v_proj(hidden_states)
 
+        if kv_cache is not None and block_tables is not None \
+                and ragged_meta is not None:
+            # ragged mixed batch: [1, R] packed rows over the pool
+            return self._forward_ragged(q, k, v, rope_cos, rope_sin,
+                                        kv_cache, block_tables,
+                                        cache_lens, ragged_meta, b, l)
         if kv_cache is not None and block_tables is not None:
             # paged decode: kv_cache is the shared (k_pool, v_pool)
             return self._forward_paged(q, k, v, rope_cos, rope_sin,
@@ -307,6 +344,44 @@ class LlamaAttention(Layer):
             "llama_attention_paged", attn_p, q, k, v, rope_cos, rope_sin,
             kv_cache[0], kv_cache[1], block_tables, cache_lens,
             n_outputs=3)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx), (kp2, vp2)
+
+    def _forward_ragged(self, q, k, v, rope_cos, rope_sin, kv_cache,
+                        block_tables, cache_lens, ragged_meta, b, l):
+        """Ragged mixed-batch attention: the hidden states arrive as
+        ONE packed row buffer ``[1, R, hidden]`` (decode rows, verify
+        windows and prefill chunks of every slot, concatenated); rope
+        positions come per ROW (``row_pos`` — pad rows carry an
+        overflow position whose clamped rope garbage never survives
+        the null-routed write), and the write+attend runs through
+        ``ragged_paged_attention_decode``."""
+        (q_lens, row_starts, row_slot, row_pos, narrow_iota,
+         win_iota) = ragged_meta
+
+        def attn_r(q_a, k_a, v_a, cos_t, sin_t, kp, vp, tables, lens,
+                   ql, rs, sl, pos_r, nwin, win):
+            r = b * l                       # packed rows (b == 1)
+            qh = q_a.reshape(r, self.num_heads, self.head_dim)
+            kh = k_a.reshape(r, self.num_kv_heads, self.head_dim)
+            vh = v_a.reshape(r, self.num_kv_heads, self.head_dim)
+            pos = jnp.clip(pos_r.astype(jnp.int32), 0,
+                           cos_t.shape[0] - 1)            # [R]
+            cos = cos_t[pos]                              # [R, D/2]
+            sin = sin_t[pos]
+            qh = _rope_rotate(qh, cos[:, None, :], sin[:, None, :])
+            kh = _rope_rotate(kh, cos[:, None, :], sin[:, None, :])
+            out, kp2, vp2 = ragged_paged_attention_decode(
+                qh, kh, vh, kp, vp, tables, lens, ql, rs, sl, pos_r,
+                nwin, win, self.head_dim)
+            return (out.reshape(b, l, self.num_heads * self.head_dim),
+                    kp2, vp2)
+
+        ctx, kp2, vp2 = apply_jax(
+            "llama_attention_ragged", attn_r, q, k, v, rope_cos,
+            rope_sin, kv_cache[0], kv_cache[1], block_tables,
+            cache_lens, q_lens, row_starts, row_slot, row_pos,
+            narrow_iota, win_iota, n_outputs=3)
         ctx = constraint(ctx, None, None, "mp")
         return self.o_proj(ctx), (kp2, vp2)
 
@@ -394,7 +469,8 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None, block_tables=None, cache_lens=None):
+                position_ids=None, block_tables=None, cache_lens=None,
+                ragged_meta=None):
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         new_cache = None
@@ -403,7 +479,8 @@ class LlamaDecoderLayer(Layer):
                                           attention_mask, kv_cache, offset,
                                           position_ids=position_ids,
                                           block_tables=block_tables,
-                                          cache_lens=cache_lens)
+                                          cache_lens=cache_lens,
+                                          ragged_meta=ragged_meta)
         else:
             h = self.self_attn(h, rope_cos, rope_sin, attention_mask)
             # tag for the "save_attn" selective remat policy: keep the
@@ -440,13 +517,14 @@ class LlamaModel(Layer):
 
     def forward(self, input_ids, attention_mask=None, position_ids=None,
                 caches=None, offset=None, block_tables=None,
-                cache_lens=None):
+                cache_lens=None, ragged_meta=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
         if caches is not None:
             # decode path: full rope tables + per-layer kv caches
             # (dense [B, S, H, D] pairs, or — with block_tables — the
-            # shared paged (k_pool, v_pool) per layer)
+            # shared paged (k_pool, v_pool) per layer; with
+            # ragged_meta, ONE packed mixed-batch row buffer)
             cos, sin = self._rope_cos, self._rope_sin
             new_caches = []
             for layer, kv in zip(self.layers, caches):
@@ -454,7 +532,8 @@ class LlamaModel(Layer):
                                kv_cache=kv, offset=offset,
                                position_ids=position_ids,
                                block_tables=block_tables,
-                               cache_lens=cache_lens)
+                               cache_lens=cache_lens,
+                               ragged_meta=ragged_meta)
                 new_caches.append(kv2)
             return self.norm(h), new_caches
         l = h.shape[1]
@@ -520,13 +599,14 @@ class LlamaForCausalLM(Layer, GenerationMixin):
 
     def forward(self, input_ids, labels=None, attention_mask=None,
                 position_ids=None, caches=None, offset=None,
-                block_tables=None, cache_lens=None):
+                block_tables=None, cache_lens=None, ragged_meta=None):
         if caches is not None:
             h, new_caches = self.llama(input_ids, attention_mask,
                                        position_ids, caches=caches,
                                        offset=offset,
                                        block_tables=block_tables,
-                                       cache_lens=cache_lens)
+                                       cache_lens=cache_lens,
+                                       ragged_meta=ragged_meta)
             return self._head_and_loss(h, None), new_caches
         h = self.llama(input_ids, attention_mask, position_ids)
         return self._head_and_loss(h, labels)

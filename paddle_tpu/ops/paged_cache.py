@@ -44,8 +44,9 @@ import numpy as np
 
 __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "write_prefill", "write_decode", "write_tokens",
-           "gather_dense", "chain_hashes", "iter_chain_hashes",
-           "copy_blocks", "pool_sharding", "pool_head_slice"]
+           "write_rows", "gather_dense", "chain_hashes",
+           "iter_chain_hashes", "copy_blocks", "pool_sharding",
+           "pool_head_slice", "ragged_row_meta"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -323,11 +324,19 @@ def write_decode(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
     k_new/v_new: [S, H_kv, D]; block_tables: [S, MB]; cache_lens: [S]
     (valid length BEFORE this token — i.e. the write position).
     Inactive slots' tables hold the null block, so their writes are
-    harmless by construction."""
+    harmless by construction. Positions past the table's reach are
+    routed to the null block (the ragged serving step parks slots it
+    must NOT write — e.g. mid-prefill slots inside the draft loop's
+    scan — at an overflow position rather than clamping onto their
+    last live block)."""
     bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
     lens = cache_lens.astype(jnp.int32)
+    blk = lens // bs
     bi = jnp.take_along_axis(block_tables.astype(jnp.int32),
-                             (lens // bs)[:, None], axis=1)[:, 0]  # [S]
+                             jnp.minimum(blk, mb - 1)[:, None],
+                             axis=1)[:, 0]                         # [S]
+    bi = jnp.where(blk < mb, bi, NULL_BLOCK)
     off = lens % bs
     k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
@@ -364,6 +373,65 @@ def write_tokens(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
     k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
     v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
     return k_pool, v_pool
+
+
+def write_rows(k_pool, v_pool, block_tables, row_slot, row_pos,
+               k_new, v_new):
+    """Append a RAGGED mixed batch: row ``r`` of ``k_new/v_new``
+    ([R, H_kv, D]) lands at cache position ``row_pos[r]`` of slot
+    ``row_slot[r]`` — the per-row generalization of ``write_decode``
+    (every row its own slot) and ``write_tokens`` (a slot may own any
+    number of consecutive rows). One scatter serves decode rows
+    (1/slot), speculative verify windows (gamma+1/slot) and prefill
+    chunk rows in a single launch. Pad rows carry an overflow
+    ``row_pos`` (past the table's reach) and are routed to the null
+    block, so the packed buffer's static width never writes anything
+    live."""
+    bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
+    pos = row_pos.astype(jnp.int32)
+    slot = row_slot.astype(jnp.int32)
+    blk = pos // bs
+    bi = block_tables.astype(jnp.int32)[slot, jnp.minimum(blk, mb - 1)]
+    bi = jnp.where((pos >= 0) & (blk < mb), bi, NULL_BLOCK)   # [R]
+    off = pos % bs
+    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def ragged_row_meta(q_lens, base_lens, total_rows, overflow_pos):
+    """Host-side row layout of ONE ragged mixed-batch step: slot ``s``
+    contributes ``q_lens[s]`` consecutive rows (0 = inactive this tick)
+    whose cache positions start at ``base_lens[s]``; rows are packed in
+    slot order into a fixed ``total_rows`` buffer.
+
+    Returns ``(row_slot [R], row_pos [R], row_starts [S],
+    last_rows [S])`` int32 — pad rows (past the packed total) carry
+    slot 0 and ``overflow_pos`` so device writes null-route and reads
+    are discarded; ``last_rows[s]`` is the row whose logits continue
+    slot ``s`` (its only row for decode, the window head for verify,
+    the final prompt row for a completing prefill; 0 for rowless
+    slots — the caller discards those)."""
+    q = np.asarray(q_lens, np.int64).reshape(-1)
+    base = np.asarray(base_lens, np.int64).reshape(-1)
+    if int(q.sum()) > int(total_rows):
+        raise ValueError(
+            f"ragged batch of {int(q.sum())} rows exceeds the "
+            f"executable's row budget ({int(total_rows)})")
+    row_slot = np.zeros(int(total_rows), np.int32)
+    row_pos = np.full(int(total_rows), int(overflow_pos), np.int32)
+    row_starts = np.zeros(len(q), np.int32)
+    last_rows = np.zeros(len(q), np.int32)
+    r = 0
+    for s, n in enumerate(map(int, q)):
+        row_starts[s] = r
+        if n:
+            row_slot[r:r + n] = s
+            row_pos[r:r + n] = base[s] + np.arange(n)
+            last_rows[s] = r + n - 1
+        r += n
+    return row_slot, row_pos, row_starts, last_rows
 
 
 def copy_blocks(pools, src, dst):

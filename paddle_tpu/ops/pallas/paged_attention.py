@@ -39,6 +39,22 @@ rows at ``cache_lens + t``, attending to every previously cached
 block (possibly mapped from the content-addressed prefix cache) plus
 its own in-chunk causal prefix — prefill, verify, and decode are one
 kernel body at three ``t_q`` widths.
+
+The RAGGED MIXED-BATCH variant (``ragged_paged_attention``) goes the
+rest of the way per *Ragged Paged Attention*: ONE invocation consumes
+a packed row buffer ``[R, H, D]`` holding every live query row of a
+serving tick — decoding slots (1 row), speculative verify windows
+(gamma+1 rows) and prefill chunks (up to ``chunk`` rows) — partitioned
+by scalar-prefetched per-slot ``q_lens``/``row_starts``. The grid is
+``(slot, window_row, kv_head, block)``: the q/out BlockSpec chases
+``row_starts[s] + t`` into the packed buffer (dead rows — ``t >=
+q_lens[s]`` — are routed to a trailing scratch row and predicated
+off), and each row keeps the verify variant's causal bound
+``lens + t``. The XLA fallback scatters the packed rows into the
+per-slot padded ``[S, W, H, D]`` layout and calls the SAME
+``_xla_paged_verify`` einsum, so every row is bitwise the per-width
+fallback's output — the serving engine's CPU parity between the
+ragged step and the per-width zoo is exact by construction.
 """
 from __future__ import annotations
 
@@ -53,7 +69,10 @@ import numpy as np
 
 __all__ = ["paged_decode_attention", "pallas_paged_attention",
            "paged_verify_attention", "pallas_paged_verify_attention",
-           "paged_attention_step", "sharded_paged_attention_step",
+           "ragged_paged_attention", "pallas_ragged_paged_attention",
+           "paged_attention_step", "ragged_attention_step",
+           "sharded_paged_attention_step",
+           "sharded_ragged_attention_step", "kernel_fallback_counts",
            "tp_shard_degree", "serving_tp_scope"]
 
 NEG_INF = np.float32(-1e30)
@@ -111,6 +130,58 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
             bound = ctx + jax.lax.broadcasted_iota(
                 jnp.int32, sc.shape, 0) // rep
         sc = jnp.where(cols < bound, sc, NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        p = jnp.exp(sc - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = alpha * acc_scr[:] + pv
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe_l = jnp.where(l == 0.0, np.float32(1.0), l)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+
+
+def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, q_ref,
+                   k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   scale, block_size, n_blocks):
+    """Ragged mixed-batch body: grid ``(slot, window_row, kv_head,
+    block)``. Each live grid row is window token ``t`` of slot ``s``
+    (the q/out BlockSpec chased ``row_starts[s] + t`` into the packed
+    buffer); its causal bound is the verify variant's ``lens + t``
+    (``lens_ref`` counts positions visible to the slot's FIRST window
+    token, itself included). Dead rows (``t >= q_lens[s]``) read/write
+    the trailing scratch row and skip all FLOPs."""
+    s = pl.program_id(0)
+    t = pl.program_id(1)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    ctx = lens_ref[s] + t          # cols < ctx visible to this row
+    @pl.when((t < qlens_ref[s]) & (j * block_size < ctx))
+    def _compute():
+        q = q_ref[0, 0]                       # [rep, D]
+        k = k_ref[0, :, 0, :]                 # [BS, D]
+        v = v_ref[0, :, 0, :]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        cols = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, sc.shape, 1)
+        sc = jnp.where(cols < ctx, sc, NEG_INF)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
@@ -248,10 +319,81 @@ try:  # pallas/tpu lowering may be absent on this jax build
         return out.reshape(s, hkv, t, rep, d).transpose(0, 2, 1, 3, 4) \
             .reshape(s, t, h, d)
 
+    def pallas_ragged_paged_attention(q, k_pool, v_pool, block_tables,
+                                      context_lens, q_lens, row_starts,
+                                      row_slot=None, w_max=None,
+                                      sm_scale=None, interpret=None):
+        """Ragged mixed-batch variant. q: [R, H, D] — ONE packed row
+        buffer holding every live query row of a serving tick, slot
+        ``s`` owning rows ``row_starts[s] .. row_starts[s] +
+        q_lens[s]``; ``context_lens[s]`` = positions visible to the
+        slot's first row, itself included (row ``t`` sees
+        ``context_lens[s] + t``). ``w_max`` is the static per-slot
+        row-count ceiling (the grid's window dimension). ``row_slot``
+        is accepted for fallback-signature parity and unused here.
+        Returns [R, H, D]; rows past a slot's ``q_lens`` are never
+        read or written (dead grid rows target a trailing scratch
+        row)."""
+        r, h, d = q.shape
+        nb, bs, hkv, _ = k_pool.shape
+        s, mb = block_tables.shape
+        w = int(w_max)
+        rep = h // hkv
+        scale = np.float32(sm_scale if sm_scale is not None
+                           else 1.0 / math.sqrt(d))
+        # trailing scratch row r: dead grid rows park their (skipped)
+        # reads and (zero) writes there so live packed rows are never
+        # clobbered
+        q4 = jnp.concatenate(
+            [q.reshape(r, hkv, rep, d),
+             jnp.zeros((1, hkv, rep, d), q.dtype)], axis=0)
+        kernel = functools.partial(
+            _ragged_kernel, scale=scale, block_size=bs, n_blocks=mb)
+
+        def q_map(si, t, g, j, qlens, starts, tables, lens):
+            return (jnp.where(t < qlens[si], starts[si] + t, r),
+                    g, 0, 0)
+
+        def kv_block(si, t, g, j, qlens, starts, tables, lens):
+            return (tables[si, j], 0, g, 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(s, w, hkv, mb),
+            in_specs=[
+                pl.BlockSpec((1, 1, rep, d), q_map),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+                pl.BlockSpec((1, bs, 1, d), kv_block),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rep, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((rep, 128), jnp.float32),
+                pltpu.VMEM((rep, 128), jnp.float32),
+                pltpu.VMEM((rep, d), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((r + 1, hkv, rep, d),
+                                           q.dtype),
+            compiler_params=_CompilerParams(
+                # slot and window dims revisit the scratch row on dead
+                # steps, so both stay sequential; kv_head blocks are
+                # disjoint
+                dimension_semantics=("arbitrary", "arbitrary",
+                                     "parallel", "arbitrary")),
+            interpret=_interpret() if interpret is None else interpret,
+        )(q_lens.astype(jnp.int32), row_starts.astype(jnp.int32),
+          block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+          q4, k_pool, v_pool)
+        return out[:r].reshape(r, h, d)
+
     _kernel_import_error = None
 except Exception as _e:  # pragma: no cover - environment dependent
     pallas_paged_attention = None
     pallas_paged_verify_attention = None
+    pallas_ragged_paged_attention = None
     _kernel_import_error = _e
 
 
@@ -314,6 +456,75 @@ def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
     return out.reshape(s, t, h, d)
 
 
+def _xla_ragged_paged(q, k_pool, v_pool, block_tables, context_lens,
+                      q_lens, row_starts, row_slot, w_narrow, w_max,
+                      sm_scale=None):
+    """Ragged gather fallback in TWO lanes, both pure
+    ``_xla_paged_verify`` calls so every live row stays BITWISE the
+    sequential per-width fallback's output (softmax rows are
+    independent — the batched window width never changes a value;
+    test-pinned in f32 AND bf16):
+
+    - **narrow lane**: every slot's first ``w_narrow`` rows (the
+      decode / speculative-verify width, ``gamma + 1``) as one padded
+      ``[S, w_narrow]`` verify — exactly the per-width decode/verify
+      fallback's compute.
+    - **wide lane**: THE single slot carrying more than ``w_narrow``
+      rows (a prefill chunk; the serving engine schedules at most ONE
+      wide slot per tick — the op contract) as one ``[1, w_max]``
+      verify against its dynamically gathered table row.
+
+    Attention FLOPs therefore scale with ``S * w_narrow + w_max`` —
+    the live row count — instead of the ``S * w_max`` a naively padded
+    layout would pay on every decode-only tick. Pad/dead rows produce
+    garbage the caller discards."""
+    r, h, d = q.shape
+    s = block_tables.shape[0]
+    wn = int(w_narrow)
+    w = int(w_max)
+    lens32 = q_lens.astype(jnp.int32)
+    starts = row_starts.astype(jnp.int32)
+    slot = row_slot.astype(jnp.int32)
+    local = jnp.arange(r, dtype=jnp.int32) - starts[slot]      # [R]
+    live = (local >= 0) & (local < lens32[slot]) & (local < w)
+    # narrow lane: dead/pad rows scatter into (and gather from) a
+    # garbage slot S; the K/V stays per-SLOT dense views, exactly the
+    # per-width fallbacks' traffic
+    nar = live & (local < wn)
+    q_pad = jnp.zeros((s + 1, wn, h, d), q.dtype)
+    q_pad = q_pad.at[jnp.where(nar, slot, s),
+                     jnp.where(nar, jnp.minimum(local, wn - 1),
+                               0)].set(q)
+    out_n = _xla_paged_verify(q_pad[:s], k_pool, v_pool, block_tables,
+                              context_lens, sm_scale=sm_scale)
+    out = out_n[jnp.clip(slot, 0, s - 1),
+                jnp.clip(local, 0, wn - 1)]                    # [R,H,D]
+    if w <= wn:
+        return out
+
+    def _with_wide(o):
+        # wide lane: the unique slot with q_lens > w_narrow
+        wide = jnp.argmax(lens32).astype(jnp.int32)
+        ws = starts[wide]
+        rows_idx = jnp.clip(ws + jnp.arange(w, dtype=jnp.int32),
+                            0, r - 1)
+        out_w = _xla_paged_verify(
+            q[rows_idx][None], k_pool, v_pool,
+            block_tables[wide][None], context_lens[wide][None],
+            sm_scale=sm_scale)[0]                              # [W,H,D]
+        use_w = (slot == wide) & (lens32[wide] > wn) & live
+        return jnp.where(use_w[:, None, None],
+                         out_w[jnp.clip(local, 0, w - 1)], o)
+
+    # a decode/verify-only tick carries no wide slot: skip the whole
+    # wide-lane gather + einsum at runtime (when a wide slot exists
+    # the branch output is bitwise the unconditional merge — the
+    # merge mask was all-false without one), so steady-state ticks
+    # cost the per-width verify, not verify + a dead chunk pass
+    return jax.lax.cond(jnp.max(lens32) > wn, _with_wide,
+                        lambda o: o, out)
+
+
 def _kernel_eligible(q, k_pool):
     # block_size must be a whole number of sublane tiles for the pool
     # dtype: 8 for f32, 16 for bf16/f16, 32 for int8/fp8
@@ -324,12 +535,34 @@ def _kernel_eligible(q, k_pool):
 
 
 _fallback_warned = set()    # paths that already logged their fallback
+_fallback_counts = {}       # path -> times the kernel was refused
+
+
+def kernel_fallback_counts() -> dict:
+    """Per-entry-point count of Pallas-kernel refusals (TPU backend
+    falling back to the XLA gather path). Mirrored into
+    ``ServingEngine.stats()["kernel_fallbacks"]`` so a production
+    engine silently losing the kernel is visible in telemetry, not
+    just a one-shot warning."""
+    return dict(_fallback_counts)
 
 
 def _warn_fallback(kind, q_shape, pool_shape, kernel_missing):
-    """One-time (per entry point) TPU diagnostic: running the gather
-    fallback in production means the decode/verify hot loop lost the
-    kernel — say why, once for each path (the reasons can differ)."""
+    """TPU diagnostic: running the gather fallback in production means
+    the decode/verify hot loop lost the kernel. Every refusal bumps
+    the ``serving_kernel_fallback`` monitor counter (JSONL-exported,
+    mirrored in engine ``stats()``); the warning itself fires once per
+    entry point (the reasons can differ)."""
+    _fallback_counts[kind] = _fallback_counts.get(kind, 0) + 1
+    try:
+        from ... import monitor
+        monitor.counter(
+            "serving_kernel_fallback",
+            "paged-attention entry points routed to the XLA gather "
+            "fallback on a TPU backend (kernel missing or shape "
+            "ineligible)", labels=("path",)).labels(path=kind).inc()
+    except Exception:       # pragma: no cover - never break the trace
+        pass
     if kind in _fallback_warned:
         return
     _fallback_warned.add(kind)
@@ -388,6 +621,103 @@ def paged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
     out = paged_verify_attention(qh, kp2, vp2, block_tables, lens + 1,
                                  sm_scale=sm_scale)
     return out, kp2, vp2
+
+
+def ragged_paged_attention(q, k_pool, v_pool, block_tables,
+                           context_lens, q_lens, row_starts, row_slot,
+                           narrow_iota, win_iota, sm_scale=None):
+    """Ragged mixed-batch paged attention over ONE packed row buffer;
+    q: [R, H, D] (every live query row of a serving tick, partitioned
+    by per-slot ``q_lens``/``row_starts``; ``row_slot[r]`` names row
+    ``r``'s slot). ``context_lens[s]`` = positions visible to slot
+    ``s``'s FIRST row, itself included. ``narrow_iota``/``win_iota``
+    are iotas whose SHAPES carry the static widths through the traced
+    call: ``w_narrow`` (= gamma+1, the decode/verify width every slot
+    may use) and ``w_max`` (the chunk ceiling — AT MOST ONE slot per
+    call may carry more than ``w_narrow`` rows; the serving scheduler
+    guarantees it). Routes to the ragged Pallas grid on TPU, the
+    two-lane verify fallback elsewhere."""
+    import types
+    wn = int(narrow_iota.shape[0])
+    w = int(win_iota.shape[0])
+    q_tok = types.SimpleNamespace(
+        shape=(block_tables.shape[0], q.shape[1], q.shape[2]))
+    use_kernel = False
+    try:
+        use_kernel = jax.default_backend() == "tpu" \
+            and pallas_ragged_paged_attention is not None \
+            and _kernel_eligible(q_tok, k_pool)
+    except Exception:
+        use_kernel = False
+    if jax.default_backend() == "tpu" and not use_kernel:
+        _warn_fallback("ragged_paged_attention", q.shape, k_pool.shape,
+                       pallas_ragged_paged_attention is None)
+    if use_kernel:
+        return pallas_ragged_paged_attention(
+            q, k_pool, v_pool, block_tables, context_lens, q_lens,
+            row_starts, row_slot=row_slot, w_max=w, sm_scale=sm_scale)
+    return _xla_ragged_paged(q, k_pool, v_pool, block_tables,
+                             context_lens, q_lens, row_starts,
+                             row_slot, wn, w, sm_scale=sm_scale)
+
+
+def ragged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
+                          cache_lens, q_lens, row_starts, row_slot,
+                          row_pos, narrow_iota, win_iota,
+                          sm_scale=None):
+    """Write + attend for the ragged mixed-batch serving step: scatter
+    this tick's per-row K/V ([R, H_kv, D]) into the pool at
+    ``(row_slot, row_pos)`` (pad rows null-route) and attend each
+    packed query row against its slot's length-bounded block list —
+    decode, speculative verify and chunked prefill in ONE launch.
+    ``cache_lens[s]`` is the slot's valid length BEFORE this tick's
+    first row. Also the per-shard body of the tensor-parallel wrapper
+    below. Returns ``(out [R, H, D], k_pool, v_pool)``."""
+    from ..paged_cache import write_rows
+    lens = cache_lens.astype(jnp.int32)
+    kp2, vp2 = write_rows(k_pool, v_pool, block_tables, row_slot,
+                          row_pos, kh, vh)
+    out = ragged_paged_attention(qh, kp2, vp2, block_tables, lens + 1,
+                                 q_lens, row_starts, row_slot,
+                                 narrow_iota, win_iota,
+                                 sm_scale=sm_scale)
+    return out, kp2, vp2
+
+
+def sharded_ragged_attention_step(qh, kh, vh, k_pool, v_pool,
+                                  block_tables, cache_lens, q_lens,
+                                  row_starts, row_slot, row_pos,
+                                  narrow_iota, win_iota,
+                                  sm_scale=None):
+    """Tensor-parallel ``ragged_attention_step``: the same write+attend
+    body inside ``shard_map`` over the mesh's ``mp`` axis — q/k/v
+    ``[R, H, D]`` and the pools split on their head dim (each shard a
+    contiguous kv_head group, exactly the per-width wrapper's cut),
+    block tables, lengths and ALL row metadata replicated. No
+    collective inside; the step's only cross-shard traffic stays the
+    engine's logits gather."""
+    import jax.sharding as _js
+    from ...distributed.shard_utils import current_mesh, shard_map_compat
+    P = _js.PartitionSpec
+    mesh = current_mesh()
+    heads = P(None, "mp", None)           # [R, H, D] head split
+    pool = P(None, None, "mp", None)
+    rows = P(None)
+
+    def local(q, k, v, kp, vp, tables, lens, ql, rs, sl, pos, nwin,
+              win):
+        return ragged_attention_step(q, k, v, kp, vp, tables, lens,
+                                     ql, rs, sl, pos, nwin, win,
+                                     sm_scale=sm_scale)
+
+    f = shard_map_compat(
+        local, mesh,
+        in_specs=(heads, heads, heads, pool, pool, P(None, None),
+                  rows, rows, rows, rows, rows, rows, rows),
+        out_specs=(heads, pool, pool))
+    return f(qh, kh, vh, k_pool, v_pool, block_tables, cache_lens,
+             q_lens, row_starts, row_slot, row_pos, narrow_iota,
+             win_iota)
 
 
 _SERVING_TP = threading.local()   # thread-scoped like in_manual_region

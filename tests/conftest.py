@@ -32,6 +32,9 @@ import pytest
 # here so the tier keeps fitting its budget. (test_speculative.py's
 # 61s rollback property stays tier-1: that file's own
 # test_tier1_no_slow_marker guard pins every spec test to the tier.)
+# r7 re-sweep (ragged mixed-batch serving): tier-1 measured 779s with
+# the new test_ragged_batch.py aboard (slowest new test 6.6s — under
+# the ~9s line), so no new entries.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s

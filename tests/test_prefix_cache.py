@@ -185,8 +185,10 @@ def test_prefix_reuse_and_exactness_shared_system_prompt(llama_tiny):
     assert st["prefix_tokens_reused"] > 0
     assert 0.0 < st["prefix_hit_rate"] < 1.0
     assert st["cached_blocks"] > 0
-    # one engine, ONE prefill executable — no bucket zoo
-    assert st["prefill_compiles"] == 1
+    # one engine, ONE executable total — the ragged step subsumed the
+    # prefill path entirely (no separate chunk exec, no bucket zoo)
+    assert st["executables_compiled"] == 1
+    assert st["prefill_compiles"] == 0
 
 
 def test_cow_never_mutates_shared_block(llama_tiny):
@@ -369,12 +371,13 @@ def test_zero_steadystate_prefill_recompiles(llama_tiny):
     eng.serve([rng.randint(1, 128, (n,)) for n in (4, 9, 23)],
               max_new_tokens=4)
     st0 = eng.stats()
-    assert st0["prefill_compiles"] == 1
+    assert st0["executables_compiled"] == 1
     eng.serve([rng.randint(1, 128, (n,)) for n in (13, 2, 31, 7)],
               max_new_tokens=5)
     st1 = eng.stats()
     eng.shutdown()
-    assert st1["prefill_compiles"] == 1, "steady-state prefill recompile"
+    assert st1["executables_compiled"] == 1, \
+        "steady-state recompile (ragged step must stay ONE executable)"
     assert st1["decode_compiles"] == 1
     assert st1["prefill_chunks"] > st0["prefill_chunks"]
 
@@ -410,7 +413,9 @@ def test_draft_model_prefill_is_one_executable(llama_tiny):
     eng.shutdown()
     for a, b in zip(got, want):
         np.testing.assert_array_equal(a, b)
-    assert st["prefill_compiles"] == 2      # chunk + draft-chunk
+    # ragged step + fused draft step (prime + proposal scan): exactly
+    # two executables, down from the per-model zoo
+    assert st["executables_compiled"] == 2
     assert st["prefix_blocks_reused"] > 0
 
 

@@ -1,0 +1,433 @@
+"""Ragged mixed-batch serving (ISSUE 7): ONE executable per engine
+consumes decode rows + speculative verify windows + prefill chunk rows
+as a single packed ragged batch. Covered here: the ragged row-layout
+helper and per-row pool scatter, interpret-mode parity of the ragged
+Pallas grid vs the XLA fallback on mixed batches (slots at block
+boundaries, zero-row/retired slots), bitwise equality of the fallback
+vs each sequential per-width path (T=1 decode, gamma+1 verify, chunk
+prefill), engine-level greedy token-exactness ragged ON vs OFF across
+Llama / GPT / int8 / speculative (ngram + draft model) / prefix-cache
+paths and under TP=2, the 1-executable (2 with draft) steady-state pin
+with zero recompiles under concurrent admissions, the
+``PADDLE_TPU_RAGGED_BATCH=0`` kill switch, and the
+``serving_kernel_fallback`` telemetry satellite.
+
+Tier-1 guard: every test here must run in the standard
+``-m 'not slow'`` sweep — ``test_tier1_no_slow_marker`` pins that.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.inference import ServingConfig, ServingEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture
+def llama_tiny():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, ffn=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _serve_waves(model, ragged, monkeypatch, prompts, max_new=6,
+                 waves=2, draft=None, **kw):
+    """Serve ``waves`` rounds of the same prompts with the ragged path
+    forced ON or OFF; returns (outputs, stats)."""
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_BATCH", "1" if ragged else "0")
+    base = dict(num_slots=2, block_size=8, max_model_len=96,
+                prefill_chunk=8, min_prefill_bucket=8)
+    base.update(kw)
+    eng = ServingEngine(model, ServingConfig(**base), draft_model=draft)
+    outs = []
+    for _ in range(waves):
+        outs += eng.serve(list(prompts), max_new_tokens=max_new)
+    st = eng.stats()
+    eng.shutdown()
+    return outs, st
+
+
+def _assert_equal_streams(a, b, tag):
+    assert len(a) == len(b)
+    for i, (x, y) in enumerate(zip(a, b)):
+        np.testing.assert_array_equal(
+            x, y, err_msg=f"{tag}: request {i} diverged")
+
+
+# ------------------------------------------------------------ row layout
+# + per-row scatter primitives
+
+
+def test_ragged_row_meta_layout():
+    from paddle_tpu.ops.paged_cache import ragged_row_meta
+    q_lens = [1, 3, 0, 5]
+    base = [10, 4, 0, 0]
+    row_slot, row_pos, starts, last = ragged_row_meta(q_lens, base, 12,
+                                                      999)
+    assert starts.tolist() == [0, 1, 4, 4]
+    assert last.tolist() == [0, 3, 0, 8]
+    assert row_slot.tolist() == [0, 1, 1, 1, 3, 3, 3, 3, 3, 0, 0, 0]
+    assert row_pos.tolist() == [10, 4, 5, 6, 0, 1, 2, 3, 4, 999, 999,
+                                999]
+    with pytest.raises(ValueError, match="row budget"):
+        ragged_row_meta([7, 7], [0, 0], 12, 999)
+
+
+def test_write_rows_matches_write_tokens_and_null_routes():
+    """The per-row scatter must land each row exactly where the
+    multi-token append would, and overflow rows (pad sentinel) must hit
+    the null block, never a slot's live blocks."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_cache as pc
+    rng = np.random.RandomState(3)
+    S, T, H, D, BS, MB = 2, 4, 2, 4, 4, 3
+    kp0, vp0 = pc.init_pool(1 + S * MB, BS, H, D, jnp.float32)
+    tables = jnp.asarray(
+        (1 + np.arange(S * MB, dtype=np.int32)).reshape(S, MB))
+    lens = np.asarray([3, 6], np.int64)
+    k = jnp.asarray(rng.randn(S, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(S, T, H, D), jnp.float32)
+    want_k, want_v = pc.write_tokens(kp0, vp0, tables,
+                                     jnp.asarray(lens), k, v)
+    # same writes expressed as one packed ragged batch + 2 pad rows
+    row_slot, row_pos, _, _ = pc.ragged_row_meta(
+        [T, T], lens, 2 * T + 2, MB * BS)
+    kr = jnp.concatenate([k.reshape(S * T, H, D),
+                          jnp.asarray(rng.randn(2, H, D), jnp.float32)])
+    vr = jnp.concatenate([v.reshape(S * T, H, D),
+                          jnp.asarray(rng.randn(2, H, D), jnp.float32)])
+    got_k, got_v = pc.write_rows(kp0, vp0, tables,
+                                 jnp.asarray(row_slot),
+                                 jnp.asarray(row_pos), kr, vr)
+    # live blocks identical; pad rows only touched the null block
+    np.testing.assert_array_equal(np.asarray(got_k[1:]),
+                                  np.asarray(want_k[1:]))
+    np.testing.assert_array_equal(np.asarray(got_v[1:]),
+                                  np.asarray(want_v[1:]))
+    assert np.asarray(got_k)[0].any()
+
+
+def test_write_decode_overflow_routes_to_null():
+    """The ragged draft scan parks must-not-write slots at an overflow
+    position: write_decode routes it to the null block instead of
+    clamping onto the slot's last live block."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_cache as pc
+    rng = np.random.RandomState(5)
+    kp, vp = pc.init_pool(4, 4, 2, 4, jnp.float32)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    k1 = jnp.asarray(rng.randn(1, 2, 4), jnp.float32)
+    kp2, _ = pc.write_decode(kp, vp, tables,
+                             jnp.asarray([8], jnp.int32), k1, k1)
+    assert not np.asarray(kp2)[1:].any()      # live blocks untouched
+    assert np.asarray(kp2)[0].any()           # null block absorbed it
+
+
+# ------------------------------------------------------- kernel parity
+
+
+def _mixed_batch(rng, S=4, H=8, Hkv=4, D=64, BS=8, MB=6):
+    """A ragged batch exercising every width: decode row, verify
+    window, chunk at a block boundary, and a zero-row (retired) slot."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_cache as pc
+    NB = 1 + S * MB
+    kp = jnp.asarray(rng.randn(NB, BS, Hkv, D), jnp.float32)
+    vp = jnp.asarray(rng.randn(NB, BS, Hkv, D), jnp.float32)
+    tables = np.zeros((S, MB), np.int32)
+    base = np.asarray([5, 15, 0, 24], np.int64)   # 15+3, 24 block-edge
+    q_lens = np.asarray([1, 3, 0, 8], np.int64)
+    alloc = pc.BlockAllocator(NB)
+    for s in range(S):
+        n = pc.blocks_for(int(base[s]) + int(q_lens[s]), BS)
+        if n:
+            tables[s, :n] = alloc.alloc(n)
+    R, W = 16, 8
+    row_slot, row_pos, row_starts, _ = pc.ragged_row_meta(
+        q_lens, base, R, MB * BS)
+    q = jnp.asarray(rng.randn(R, H, D), jnp.float32)
+    return (q, kp, vp, jnp.asarray(tables), jnp.asarray(base + 1),
+            jnp.asarray(q_lens), jnp.asarray(row_starts),
+            jnp.asarray(row_slot), W, q_lens, row_starts)
+
+
+def test_ragged_fallback_bitwise_equals_per_width_paths():
+    """The issue's CPU-parity bar: every live row of the ragged XLA
+    fallback is BITWISE the sequential per-width fallback's output —
+    T=1 decode (``_xla_paged_attention``), gamma+1 verify and chunk
+    prefill (``_xla_paged_verify``)."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    rng = np.random.RandomState(0)
+    (q, kp, vp, tables, ctx, ql, rs, sl, W,
+     q_lens, row_starts) = _mixed_batch(rng)
+    # narrow width 3 (the verify window); the chunk slot is the ONE
+    # wide slot — the two-lane fallback contract
+    out = pa._xla_ragged_paged(q, kp, vp, tables, ctx, ql, rs, sl, 3,
+                               W)
+    # decode slot (1 row)
+    ref = pa._xla_paged_attention(q[0:1], kp, vp, tables[0:1], ctx[0:1])
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(ref[0]))
+    # verify window (3 rows) + chunk (8 rows, block-boundary start)
+    for s, (s0, n) in ((1, (1, 3)), (3, (4, 8))):
+        ref = pa._xla_paged_verify(q[s0:s0 + n][None], kp, vp,
+                                   tables[s:s + 1], ctx[s:s + 1])
+        np.testing.assert_array_equal(np.asarray(out[s0:s0 + n]),
+                                      np.asarray(ref[0]))
+
+
+def test_ragged_kernel_matches_fallback_interpret():
+    """The ragged Pallas grid (interpret mode on CPU) agrees with the
+    gather fallback on a mixed batch including a NULL/zero-row slot and
+    block-boundary starts."""
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    if pa.pallas_ragged_paged_attention is None:
+        pytest.skip("pallas unavailable on this jax build")
+    rng = np.random.RandomState(1)
+    (q, kp, vp, tables, ctx, ql, rs, sl, W,
+     q_lens, row_starts) = _mixed_batch(rng)
+    ref = pa._xla_ragged_paged(q, kp, vp, tables, ctx, ql, rs, sl, 3,
+                               W)
+    out = pa.pallas_ragged_paged_attention(q, kp, vp, tables, ctx, ql,
+                                           rs, w_max=W, interpret=True)
+    # compare live rows only (dead/pad rows are garbage by contract)
+    for s, n in enumerate(map(int, np.asarray(q_lens))):
+        s0 = int(row_starts[s])
+        np.testing.assert_allclose(
+            np.asarray(out[s0:s0 + n]), np.asarray(ref[s0:s0 + n]),
+            rtol=1e-5, atol=1e-5,
+            err_msg=f"slot {s} rows diverged")
+
+
+# ----------------------------------------------- engine-level exactness
+# ragged ON vs OFF
+
+
+def test_ragged_exact_llama_with_prefix_cache(llama_tiny, monkeypatch):
+    rng = np.random.RandomState(0)
+    sysp = rng.randint(1, 128, (24,))
+    prompts = [np.concatenate([sysp, rng.randint(1, 128, (t,))])
+               for t in (5, 9, 3)]
+    want, st_off = _serve_waves(llama_tiny, False, monkeypatch, prompts)
+    got, st_on = _serve_waves(llama_tiny, True, monkeypatch, prompts)
+    _assert_equal_streams(got, want, "llama ragged vs legacy")
+    assert st_on["ragged_batch"] is True
+    assert st_off["ragged_batch"] is False
+    assert st_on["prefix_blocks_reused"] > 0    # cache composes
+    assert st_on["executables_compiled"] == 1
+    assert st_off["executables_compiled"] > 1   # the zoo
+
+
+def test_ragged_exact_gpt(monkeypatch):
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(3)
+    m = GPTForCausalLM(GPTConfig.tiny(vocab=96, hidden=64, layers=2,
+                                      heads=4))
+    m.eval()
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(1, 96, (n,)).astype(np.int64)
+               for n in (5, 11, 8)]
+    want, _ = _serve_waves(m, False, monkeypatch, prompts, max_new=4,
+                           waves=1, max_model_len=64)
+    got, st = _serve_waves(m, True, monkeypatch, prompts, max_new=4,
+                           waves=1, max_model_len=64)
+    _assert_equal_streams(got, want, "gpt ragged vs legacy")
+    assert st["executables_compiled"] == 1
+
+
+def test_ragged_exact_int8(monkeypatch):
+    from paddle_tpu.nn.quant import quantize_for_inference
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, ffn=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    assert quantize_for_inference(m) > 0
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(1, 128, (n,)).astype(np.int64)
+               for n in (6, 10)]
+    want, _ = _serve_waves(m, False, monkeypatch, prompts, max_new=4,
+                           waves=1, max_model_len=64)
+    got, st = _serve_waves(m, True, monkeypatch, prompts, max_new=4,
+                           waves=1, max_model_len=64)
+    _assert_equal_streams(got, want, "int8 ragged vs legacy")
+    assert st["executables_compiled"] == 1
+
+
+def test_ragged_exact_speculative_ngram(llama_tiny, monkeypatch):
+    rng = np.random.RandomState(4)
+    sysp = np.tile(rng.randint(1, 128, (8,)), 3)
+    prompts = [np.concatenate([sysp, rng.randint(1, 128, (t,))])
+               for t in (4, 7)]
+    want, _ = _serve_waves(llama_tiny, False, monkeypatch, prompts,
+                           max_new=8, num_speculative_tokens=3)
+    got, st = _serve_waves(llama_tiny, True, monkeypatch, prompts,
+                           max_new=8, num_speculative_tokens=3)
+    _assert_equal_streams(got, want, "spec-ngram ragged vs legacy")
+    assert st["executables_compiled"] == 1
+    assert st["spec_tokens_proposed"] > 0
+
+
+def test_ragged_exact_speculative_draft_model(llama_tiny, monkeypatch):
+    paddle.seed(13)
+    draft = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab=128, hidden=32, layers=1, heads=2, kv_heads=2, ffn=64))
+    draft.eval()
+    rng = np.random.RandomState(3)
+    sysp = rng.randint(1, 128, (16,))
+    prompts = [np.concatenate([sysp, rng.randint(1, 128, (t,))])
+               for t in (5, 11)]
+    want, _ = _serve_waves(llama_tiny, False, monkeypatch, prompts,
+                           draft=draft, num_speculative_tokens=2,
+                           drafter="model")
+    got, st = _serve_waves(llama_tiny, True, monkeypatch, prompts,
+                           draft=draft, num_speculative_tokens=2,
+                           drafter="model")
+    _assert_equal_streams(got, want, "spec-draft ragged vs legacy")
+    # target ragged step + fused draft (prime + scan): exactly two
+    assert st["executables_compiled"] == 2
+
+
+@pytest.mark.skipif(
+    __import__("jax").device_count() < 2,
+    reason="needs a multi-device mesh")
+def test_ragged_exact_tp2(llama_tiny, monkeypatch):
+    """TP composes unchanged: the ragged step under tp_degree=2 is
+    token-exact vs the single-device ragged engine and still shows
+    EXACTLY ONE explicit collective (the logits all_gather)."""
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_BATCH", "1")
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, 128, (n,)).astype(np.int64)
+               for n in (5, 9, 13)]
+
+    def serve(tp):
+        eng = ServingEngine(llama_tiny, ServingConfig(
+            num_slots=2, block_size=8, max_model_len=64, tp_degree=tp,
+            prefill_chunk=8))
+        outs = eng.serve(list(prompts), max_new_tokens=5)
+        st = eng.stats()
+        census = eng.collective_census()
+        eng.shutdown()
+        return outs, st, census
+
+    ref, st1, _ = serve(1)
+    got, st2, census = serve(2)
+    _assert_equal_streams(got, ref, "ragged tp=2")
+    assert st2["tp_degree"] == 2
+    assert st2["executables_compiled"] == 1
+    rows = [r for r in census["decode"]
+            if r["op"] != "sharding_constraint"]
+    assert len(rows) == 1 and rows[0]["op"] == "all_gather"
+    assert rows[0]["axis"] == "mp" and rows[0]["count"] == 1
+
+
+# ------------------------------------------- one-executable steady state
+# + kill switch + telemetry
+
+
+def test_ragged_one_executable_with_concurrent_admissions(
+        llama_tiny, monkeypatch):
+    """The tentpole pin: with admissions landing WHILE other slots
+    decode (the mixed regime that used to interleave chunk executables
+    between decode launches), the engine still compiles exactly ONE
+    executable and never recompiles across waves."""
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_BATCH", "1")
+    rng = np.random.RandomState(2)
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=3, block_size=8, max_model_len=64, prefill_chunk=8))
+    rids = [eng.submit(rng.randint(1, 128, (n,)), 6) for n in (4, 9)]
+    for _ in range(3):
+        eng.step()
+    # admissions mid-flight: prefill rows must ride the SAME executable
+    rids += [eng.submit(rng.randint(1, 128, (n,)), 5)
+             for n in (23, 2, 17)]
+    while eng.num_queued or eng.num_active:
+        eng.step()
+    st = eng.stats()
+    done = eng.run()
+    eng.shutdown()
+    assert st["executables_compiled"] == 1, \
+        f"ragged engine must stay at ONE executable, got {st}"
+    assert st["decode_compiles"] == 1
+    assert st["prefill_compiles"] == 0
+    assert sorted(done) == sorted(rids)
+    assert st["prefill_chunks"] >= sum(
+        -(-n // 8) for n in (4, 9, 23, 2, 17))
+
+
+def test_ragged_kill_switch_restores_zoo(llama_tiny, monkeypatch):
+    """PADDLE_TPU_RAGGED_BATCH=0 (and ServingConfig(ragged_batch=
+    False)) restore the per-width executables with identical greedy
+    tokens."""
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(1, 128, (n,)) for n in (5, 12, 21)]
+    on, st_on = _serve_waves(llama_tiny, True, monkeypatch, prompts,
+                             max_new=5, waves=1)
+    off, st_off = _serve_waves(llama_tiny, False, monkeypatch, prompts,
+                               max_new=5, waves=1)
+    _assert_equal_streams(on, off, "kill switch")
+    assert st_on["executables_compiled"] == 1
+    # legacy zoo: decode + the chunk prefill executable at minimum
+    assert st_off["executables_compiled"] >= 2
+    assert st_off["prefill_compiles"] >= 1
+    monkeypatch.delenv("PADDLE_TPU_RAGGED_BATCH")
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64,
+        ragged_batch=False, prefill_chunk=8))
+    got = eng.serve([prompts[0]], max_new_tokens=5)
+    eng.shutdown()
+    np.testing.assert_array_equal(got[0], on[0])
+    assert eng.stats()["ragged_batch"] is False
+
+
+def test_ragged_stats_keys_and_fallback_counter(llama_tiny,
+                                                monkeypatch, tmp_path):
+    """Satellites: stats() always exposes executables_compiled /
+    ragged_batch / kernel_fallbacks (both paths), and _warn_fallback
+    bumps the serving_kernel_fallback monitor counter per occurrence
+    (not once per process) + it lands in the JSONL export."""
+    import json
+    from paddle_tpu.ops.pallas import paged_attention as pa
+    rng = np.random.RandomState(1)
+    for ragged in (True, False):
+        _, st = _serve_waves(llama_tiny, ragged, monkeypatch,
+                             [rng.randint(1, 128, (5,))], max_new=2,
+                             waves=1)
+        for k in ("executables_compiled", "ragged_batch",
+                  "kernel_fallbacks", "prefill_compiles",
+                  "decode_compiles"):
+            assert k in st, f"{k} missing (ragged={ragged})"
+    c = monitor.counter("serving_kernel_fallback", labels=("path",))
+    before = c.labels(path="test_path").value()
+    n0 = pa.kernel_fallback_counts().get("test_path", 0)
+    pa._warn_fallback("test_path", (1, 4, 64), (8, 8, 2, 64), False)
+    pa._warn_fallback("test_path", (1, 4, 64), (8, 8, 2, 64), False)
+    assert pa.kernel_fallback_counts()["test_path"] == n0 + 2
+    assert c.labels(path="test_path").value() == before + 2
+    path = monitor.export_jsonl(str(tmp_path / "metrics.jsonl"))
+    names = {json.loads(line)["name"] for line in open(path)}
+    assert "serving_kernel_fallback" in names
+
+
+def test_tier1_no_slow_marker():
+    """CI guard (the PR-4/5 pattern): every ragged-batch test runs in
+    the tier-1 ``-m 'not slow'`` sweep and the kernel parity test is
+    present."""
+    import tests.conftest as c
+    here = open(__file__).read()
+    assert "pytest.mark.slow" not in here.replace(
+        '"pytest.mark.slow"', "")
+    names = [ln.split("(")[0][4:] for ln in here.splitlines()
+             if ln.startswith("def test_")]
+    overlap = set(names) & set(c._SLOW_TESTS)
+    assert not overlap, f"tier-1 ragged tests marked slow: {overlap}"
+    assert "test_ragged_kernel_matches_fallback_interpret" in names
+    # every engine is torn down through _serve_waves (or explicitly):
+    # the allocator leak sweep guards each engine test
+    assert here.count(".shutdown()") >= 4, \
+        "engine shutdown (check_leaks) must guard these tests"

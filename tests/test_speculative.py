@@ -399,9 +399,10 @@ def test_spec_rollback_blocks_and_cache_match_fresh_prefill(llama_tiny):
     least ``blocks_for(cache_len)`` and at most
     ``blocks_for(cache_len + gamma + 1)`` live blocks (committed
     coverage, bounded overhang — anything past the next window's reach
-    is returned to the allocator) with a null tail, and (b) the
-    layer-0 K cache prefix equals a from-scratch prefill of the
-    committed tokens, token for token."""
+    is returned to the allocator; a mid-prefill slot instead holds its
+    admission allocation ``blocks_for(prompt)``) with a null tail, and
+    (b) the layer-0 K cache prefix equals a from-scratch prefill of
+    the committed tokens, token for token."""
     import jax.numpy as jnp
     from paddle_tpu.jit import _LayerBinder
     from paddle_tpu.ops import paged_cache as pc
@@ -442,16 +443,25 @@ def test_spec_rollback_blocks_and_cache_match_fresh_prefill(llama_tiny):
                 assert not eng._tables[i].any()
                 continue
             need = pc.blocks_for(slot.cache_len, eng._bs)
-            cap = pc.blocks_for(slot.cache_len + eng._gamma + 1,
-                                eng._bs)
+            if slot.pend_pos is not None:
+                # mid-prefill (ragged chunks land across ticks): the
+                # slot keeps its whole-prompt admission allocation and
+                # the cache covers exactly the prompt prefix so far
+                cap = pc.blocks_for(int(slot.prompt.size), eng._bs)
+                committed = slot.history[:slot.cache_len]
+            else:
+                cap = pc.blocks_for(slot.cache_len + eng._gamma + 1,
+                                    eng._bs)
+                # committed = prompt + emitted minus the pending one
+                committed = slot.history[:-1]
             assert need <= len(slot.blocks) <= cap, \
                 "window overhang blocks not trimmed"
             held = len(slot.blocks)
             assert list(eng._tables[i, :held]) == slot.blocks
             assert not eng._tables[i, held:].any()
-            # committed tokens = prompt + emitted minus the pending one
-            committed = slot.history[:-1]
             assert len(committed) == slot.cache_len
+            if slot.cache_len == 0:
+                continue
             live = np.asarray(pc.gather_dense(
                 eng._pools[0][0],
                 jnp.asarray(eng._tables[i][None])))[0, :slot.cache_len]
