@@ -29,7 +29,10 @@ took; static shapes throughout, as jit requires.
 """
 from __future__ import annotations
 
+import contextlib
 import math
+import os
+import threading
 from typing import List, Optional
 
 import jax
@@ -44,7 +47,8 @@ from .shard_utils import annotate_param, constraint, mesh_axis_size
 __all__ = ["MoELayer", "NaiveGate", "GShardGate", "SwitchGate",
            "moe_dispatch_combine", "moe_dispatch_combine_dropless",
            "moe_dispatch_combine_grouped", "moe_stats",
-           "reset_moe_stats", "ClipGradForMOEByGlobalNorm"]
+           "reset_moe_stats", "moe_fused_enabled", "serving_stats_tap",
+           "serving_rows_mask", "ClipGradForMOEByGlobalNorm"]
 
 
 from ..nn.clip import ClipGradByGlobalNorm as _ClipGradByGlobalNorm
@@ -624,10 +628,191 @@ def _expert_swiglu_grouped(xs, gate_up, down, group_sizes, dtype,
                        allow_pallas=allow_pallas)
 
 
+# ---------------------------------------------------------------------------
+# Fused-dispatch grouped MoE (ops/pallas/moe_gmm.py): the sort/dispatch
+# permutation folds into the grouped matmuls themselves — gather-on-read
+# lhs (the sorted packed buffer never reaches HBM), swiglu in the first
+# matmul's epilogue (the [m, 2f] projection never reaches HBM), and the
+# combine's unsort as the second matmul's scatter store. The custom VJP
+# below replays the same gather/scatter structure backward.
+# ---------------------------------------------------------------------------
+
+
+def moe_fused_enabled() -> bool:
+    """Kill switch: ``PADDLE_TPU_MOE_FUSED_GMM=0`` restores the
+    sort→pack→gmm path everywhere, bit-for-bit (the fused kernels are
+    never traced)."""
+    return os.environ.get("PADDLE_TPU_MOE_FUSED_GMM", "1") != "0"
+
+
+def _use_fused_gmm(n_rows, d_model, d_ffn, fused=None):
+    """Eligibility of the fused-dispatch kernels for this shape.
+    Returns ``False`` (sorted path), ``"tpu"`` (compiled kernels) or
+    ``"interpret"`` (Pallas interpreter — CPU tests set
+    ``PADDLE_TPU_MOE_FUSED_GMM=interpret`` to exercise the fused
+    graph end-to-end off-TPU). ``fused``: the per-call/config override
+    (``None`` = env default). Production gating mirrors
+    ``_use_megablox``: real TPU backend, MXU-scale row count, and
+    128-aligned dims so ``pick_tiling`` finds lane-aligned tiles."""
+    env = os.environ.get("PADDLE_TPU_MOE_FUSED_GMM", "1")
+    if env == "0" or fused is False:
+        return False
+    aligned = (d_model % 128 == 0 and d_ffn % 128 == 0
+               and n_rows % 128 == 0)
+    if env == "interpret":
+        return "interpret" if aligned else False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    return "tpu" if (n_rows >= 1024 and aligned) else False
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_moe_core(top_k, interpret, x, gate_up, down, gates, order,
+                    src_rows, gs):
+    """Fused dispatch→experts→combine over the sorted row partition:
+    ``y[s] = sum_k gates[s, k] * (swiglu expert of x[s])`` with the
+    sort (``src_rows = order // top_k``) fused into the first matmul's
+    load and the unsort (``order``) into the second's store. ``gs``
+    must sum to ``s * top_k`` (tail-padded last group, exactly as the
+    sorted path)."""
+    from ..ops.pallas.moe_gmm import gather_gmm_swiglu, scatter_gmm
+    h = gather_gmm_swiglu(x, src_rows, gate_up.astype(x.dtype), gs,
+                          interpret=interpret)
+    ys_tok = scatter_gmm(h, down.astype(x.dtype), gs, order,
+                         interpret=interpret)
+    s = x.shape[0]
+    picked = ys_tok.reshape(s, top_k, -1)
+    return jnp.einsum("sk,skd->sd", gates.astype(x.dtype), picked)
+
+
+def _fused_moe_fwd(top_k, interpret, x, gate_up, down, gates, order,
+                   src_rows, gs):
+    from ..ops.pallas.moe_gmm import gather_gmm_swiglu, scatter_gmm
+    h = gather_gmm_swiglu(x, src_rows, gate_up.astype(x.dtype), gs,
+                          interpret=interpret)
+    ys_tok = scatter_gmm(h, down.astype(x.dtype), gs, order,
+                         interpret=interpret)
+    s = x.shape[0]
+    picked = ys_tok.reshape(s, top_k, -1)
+    y = jnp.einsum("sk,skd->sd", gates.astype(x.dtype), picked)
+    return y, (x, gate_up, down, gates, order, src_rows, gs, h,
+               ys_tok)
+
+
+def _fused_moe_bwd(top_k, interpret, res, dy):
+    """Backward replays the SAME fused structure: the token-major
+    cotangent is gathered into sorted order by the first backward
+    matmul's load (``order`` drives it exactly like ``src_rows`` drove
+    the forward), and d(x) leaves the last backward matmul through the
+    scatter epilogue. The gate/up projection — never materialized
+    forward — is recomputed here with one extra gather-gmm (recompute
+    beats carrying an ``[m, 2f]`` residual through the step, same
+    trade as remat); weight grads run the tuned tgmm path on
+    materialized sorted operands (backward-only traffic)."""
+    from ..ops.pallas.moe_gmm import gather_gmm, scatter_gmm
+    x, gate_up, down, gates, order, src_rows, gs, h, ys_tok = res
+    s, d = x.shape
+    e = gate_up.shape[0]
+    picked = ys_tok.reshape(s, top_k, -1)
+    dgates = jnp.einsum("sd,skd->sk", dy.astype(jnp.float32),
+                        picked.astype(jnp.float32))
+    dpair_tok = (gates.astype(dy.dtype)[..., None] * dy[:, None, :]) \
+        .reshape(s * top_k, d)
+    dh = gather_gmm(dpair_tok, order, down.astype(dy.dtype), gs,
+                    transpose_rhs=True, interpret=interpret)
+    dpair_sorted = jnp.take(dpair_tok, order, axis=0)
+    ddown = _grouped_mm_drhs(h, dpair_sorted, gs, e)
+    gu = gather_gmm(x, src_rows, gate_up.astype(x.dtype), gs,
+                    interpret=interpret)
+    g_a, u_a = jnp.split(gu, 2, axis=-1)
+    g32 = g_a.astype(jnp.float32)
+    sig = jax.nn.sigmoid(g32)
+    dh32 = dh.astype(jnp.float32)
+    dg = dh32 * u_a.astype(jnp.float32) * sig * (1 + g32 * (1 - sig))
+    du = dh32 * (g32 * sig)
+    dgu = jnp.concatenate([dg, du], axis=-1).astype(x.dtype)
+    xs = jnp.take(x, src_rows, axis=0)
+    dguw = _grouped_mm_drhs(xs, dgu, gs, e)
+    dx_tok = scatter_gmm(dgu, gate_up.astype(x.dtype), gs, order,
+                         transpose_rhs=True, interpret=interpret)
+    dx = dx_tok.reshape(s, top_k, d).sum(axis=1)
+    return (dx.astype(x.dtype), dguw.astype(gate_up.dtype),
+            ddown.astype(down.dtype), dgates.astype(gates.dtype),
+            None, None, None)
+
+
+_fused_moe_core.defvjp(_fused_moe_fwd, _fused_moe_bwd)
+
+
+# -- serving-time routing telemetry tap -------------------------------------
+# The serving engine arms a per-thread sink while TRACING its
+# executables; an armed dispatch adds one tiny jax.debug.callback
+# (per-expert load fractions + routing entropy) that fires on every
+# EXECUTION of the compiled step — decode-time router telemetry with no
+# change to the model-step calling convention.
+_SERVING_TAP = threading.local()
+
+
+@contextlib.contextmanager
+def serving_stats_tap(sink):
+    """Arm ``sink(load [e] np.ndarray, entropy float)`` for every MoE
+    dispatch traced on this thread inside the context."""
+    prev = getattr(_SERVING_TAP, "sink", None)
+    _SERVING_TAP.sink = sink
+    try:
+        yield
+    finally:
+        _SERVING_TAP.sink = prev
+
+
+@contextlib.contextmanager
+def serving_rows_mask(mask):
+    """Arm a per-ROW validity mask (``[s]`` bool, traced) for MoE
+    dispatches traced inside the context. Serving executables run
+    fixed-shape row buffers whose PAD rows still route through the
+    dispatch — without the mask their (identical, meaningless) expert
+    picks would dominate the routing telemetry of a lightly loaded
+    tick, reading as hot-expert skew that isn't there. The engine's
+    ``_compile_*`` wrappers arm the step's live-row mask around the
+    model trace; the tap then counts only real rows."""
+    prev = getattr(_SERVING_TAP, "rows_mask", None)
+    _SERVING_TAP.rows_mask = mask
+    try:
+        yield
+    finally:
+        _SERVING_TAP.rows_mask = prev
+
+
+def _tap_routing(flat_e, e, top_k, counts):
+    """If a serving sink is armed (trace time), emit this dispatch's
+    per-expert load fractions and routing entropy (nats) at run time —
+    over LIVE rows only when a row mask is armed (pad rows of the
+    fixed-shape serving buffers are excluded; see
+    ``serving_rows_mask``)."""
+    sink = getattr(_SERVING_TAP, "sink", None)
+    if sink is None:
+        return
+    mask = getattr(_SERVING_TAP, "rows_mask", None)
+    if mask is not None \
+            and mask.shape[0] * top_k == flat_e.shape[0]:
+        valid = jnp.repeat(mask.astype(jnp.int32), top_k)
+        counts = jnp.zeros(e, jnp.int32).at[flat_e].add(valid,
+                                                        mode="drop")
+    total = jnp.maximum(jnp.sum(counts), 1).astype(jnp.float32)
+    load = counts.astype(jnp.float32) / total
+    ent = -jnp.sum(jnp.where(load > 0,
+                             load * jnp.log(jnp.maximum(load, 1e-12)),
+                             0.0))
+    jax.debug.callback(sink, load, ent)
+
+
 def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
                                   gate_up, down, normalize_gates=True,
                                   expert_axis=None, return_stats=False,
-                                  ep_buffer_factor=2.0):
+                                  ep_buffer_factor=2.0, fused=None):
     """DROPLESS dispatch → SwiGLU experts → combine (reference:
     capacity-free routing the fused-MoE kernels in
     ``phi/kernels/fusion/`` approximate; design follows the MegaBlocks
@@ -655,7 +840,7 @@ def moe_dispatch_combine_dropless(x, gate_logits, num_expert, top_k,
         x, gate_logits, num_expert, top_k, gate_up, down,
         capacity_factor=None, normalize_gates=normalize_gates,
         expert_axis=expert_axis, ep_buffer_factor=ep_buffer_factor,
-        return_stats=return_stats)
+        return_stats=return_stats, fused=fused)
 
 
 def moe_dispatch_combine_grouped(x, gate_logits, num_expert, top_k,
@@ -663,7 +848,7 @@ def moe_dispatch_combine_grouped(x, gate_logits, num_expert, top_k,
                                  normalize_gates=True,
                                  second_expert_policy="all",
                                  rng_key=None, expert_axis=None,
-                                 return_stats=False):
+                                 return_stats=False, fused=None):
     """GShard CAPACITY semantics on the grouped-matmul engine: same
     routing, same capacity rule (earlier tokens win their expert's
     slots), same gate zeroing for dropped pairs as the padded
@@ -697,14 +882,14 @@ def moe_dispatch_combine_grouped(x, gate_logits, num_expert, top_k,
         x, gate_logits, num_expert, top_k, gate_up, down,
         capacity_factor=capacity_factor, normalize_gates=normalize_gates,
         second_expert_policy=second_expert_policy, rng_key=rng_key,
-        expert_axis=expert_axis, return_stats=return_stats)
+        expert_axis=expert_axis, return_stats=return_stats, fused=fused)
 
 
 def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
                       *, capacity_factor, normalize_gates=True,
                       second_expert_policy="all", rng_key=None,
                       expert_axis=None, ep_buffer_factor=2.0,
-                      return_stats=False):
+                      return_stats=False, fused=None):
     """Shared engine behind the dropless and capacity-grouped paths:
     route → sort-group → grouped expert matmuls → combine, with the EP
     shard_map fast path when the expert axis is mesh-sharded."""
@@ -749,6 +934,7 @@ def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
 
     ep = mesh_axis_size(expert_axis) if expert_axis is not None else 1
     ep_drop = None
+    _tap_routing(flat_e, e, top_k, counts)
     from ..profiler import RecordEvent
     if ep > 1 and capacity_factor is None and e % ep == 0 \
             and s % ep == 0 and _env_mesh() is not None:
@@ -760,25 +946,54 @@ def _grouped_dispatch(x, gate_logits, num_expert, top_k, gate_up, down,
         if ep > 1:
             gate_up = _ep_constraint(gate_up, expert_axis)
             down = _ep_constraint(down, expert_axis)
-        # local sorted grouped-matmul path: all s*k pairs flow through
-        # the grouped matmuls (capacity-dropped pairs are zero-gated at
-        # combine — same total rows as dropless, no capacity padding);
-        # pairs skipped by random routing sort into the tail and are
-        # absorbed into the last group. When the expert axis IS sharded
-        # but the shard_map fast path was ineligible (non-divisible
-        # e/s), GSPMD owns the partitioning — the opaque Pallas kernel
-        # can't be partitioned, so force the ragged_dot lowering (the
-        # r5 gate, kept exactly where it is still required).
-        with RecordEvent("moe:dispatch"):
-            gs = counts.at[e - 1].add(
-                jnp.int32(s * top_k) - jnp.sum(counts, dtype=jnp.int32))
-            xs = _expand_sort(x, order // top_k, rank, top_k)  # [s*k,d]
-        with RecordEvent("moe:expert_mm"):
-            ys = _expert_swiglu_grouped(xs, gate_up, down, gs, x.dtype,
-                                        allow_pallas=(ep <= 1))
-        with RecordEvent("moe:combine"):
-            picked = _perm_rows(ys, rank, order).reshape(s, top_k, -1)
-            y = jnp.einsum("sk,skd->sd", gates, picked)
+        gs = counts.at[e - 1].add(
+            jnp.int32(s * top_k) - jnp.sum(counts, dtype=jnp.int32))
+        d_ffn = down.shape[1]
+        # inside a TP engine's trace GSPMD owns the partitioning (the
+        # expert weights arrive mp-sharded): opaque Pallas kernels —
+        # fused AND megablox — must stay off, exactly like the r5
+        # sharded-fallback ragged_dot gate
+        from ..ops.pallas.paged_attention import serving_tp_active
+        gspmd_tp = serving_tp_active()
+        fmode = _use_fused_gmm(s * top_k, d, d_ffn, fused=fused) \
+            if ep <= 1 and not gspmd_tp else False
+        if fmode:
+            # fused-dispatch path: the sort is the first matmul's
+            # gather-on-read load, swiglu its epilogue, the unsort the
+            # second matmul's scatter store — the packed [s*k, d]
+            # buffer and the [s*k, 2f] projection never reach HBM.
+            # Same routing, same gs tail-pad, so capacity zero-gating
+            # and random-skip absorption behave exactly as the sorted
+            # path they replace.
+            MOE_STATS["grouped_mm_calls"] += 2
+            MOE_STATS["grouped_mm_kernel"] = "fused_gmm"
+            with RecordEvent("moe:fused_dispatch_combine"):
+                y = _fused_moe_core(
+                    top_k, fmode == "interpret", x, gate_up, down,
+                    gates, order, (order // top_k).astype(jnp.int32),
+                    gs)
+        else:
+            # local sorted grouped-matmul path: all s*k pairs flow
+            # through the grouped matmuls (capacity-dropped pairs are
+            # zero-gated at combine — same total rows as dropless, no
+            # capacity padding); pairs skipped by random routing sort
+            # into the tail and are absorbed into the last group. When
+            # the expert axis IS sharded but the shard_map fast path
+            # was ineligible (non-divisible e/s), GSPMD owns the
+            # partitioning — the opaque Pallas kernel can't be
+            # partitioned, so force the ragged_dot lowering (the r5
+            # gate, kept exactly where it is still required).
+            with RecordEvent("moe:dispatch"):
+                xs = _expand_sort(x, order // top_k, rank,
+                                  top_k)                   # [s*k, d]
+            with RecordEvent("moe:expert_mm"):
+                ys = _expert_swiglu_grouped(
+                    xs, gate_up, down, gs, x.dtype,
+                    allow_pallas=(ep <= 1 and not gspmd_tp))
+            with RecordEvent("moe:combine"):
+                picked = _perm_rows(ys, rank, order) \
+                    .reshape(s, top_k, -1)
+                y = jnp.einsum("sk,skd->sd", gates, picked)
 
     # GShard load-balance aux (top-1 occupancy), as the padded path
     me = jnp.mean(probs, axis=0)

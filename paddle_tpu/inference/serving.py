@@ -145,6 +145,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import monitor
+from ..distributed import moe as _moe
 from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
@@ -227,6 +228,12 @@ class ServingConfig:
     # num_kv_heads / num_attention_heads / vocab_size (validated at
     # engine construction). Kill switch: PADDLE_TPU_SERVE_TP=0.
     tp_degree: int = 1
+    # MoE routing telemetry (serving_moe_expert_load /
+    # serving_moe_routing_entropy): each sparse layer's dispatch
+    # embeds one tiny host callback per executed tick. False (or
+    # PADDLE_TPU_MOE_TELEMETRY=0) traces the executables without the
+    # tap — zero callback cost, stats() moe_routing_entropy stays 0.0.
+    moe_telemetry: bool = True
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -235,6 +242,15 @@ class ServingConfig:
         if not isinstance(tp, int) or isinstance(tp, bool) or tp < 1:
             raise ValueError(
                 f"tp_degree must be a positive int, got {tp!r}")
+
+
+def _num_experts(cfg) -> int:
+    """Routed-expert count of a model config (0 = dense): the ONE
+    probe behind the MoE admission gate, the engine's ``_moe`` flag
+    and the TP divisibility check — a third MoE config field name
+    lands in exactly one place."""
+    return int(getattr(cfg, "num_experts", 0)
+               or getattr(cfg, "n_routed_experts", 0) or 0)
 
 
 @dataclass
@@ -332,6 +348,34 @@ class ServingEngine:
                 if reason is not None:
                     raise NotImplementedError(
                         f"draft model unusable: {reason}")
+        # -- MoE admission gate ---------------------------------------
+        # Dropless MoE serves: decode-time routing is tiny-batch and
+        # per-row, so packed serving rows (other slots' tokens, verify
+        # windows, prefill chunks) cannot perturb a row's expert
+        # outputs. Capacity routing stays excluded — the batched rows
+        # WOULD compete for each expert's capacity slots, making
+        # logits depend on batch composition (the bucketing/spec
+        # exclusion reasoning of PRs 3-4, applied to the engine
+        # itself).
+        for mdl, who in ((model, "model"), (draft_model, "draft model")):
+            c = getattr(mdl, "config", None) if mdl is not None else None
+            if _num_experts(c) and not getattr(c, "dropless", False):
+                raise NotImplementedError(
+                    f"capacity-routed MoE {who} cannot serve: batched "
+                    "slots' tokens would compete for expert capacity, "
+                    "so logits would depend on batch composition. Set "
+                    "config.dropless=True (grouped dropless routing) "
+                    "to serve this model.")
+        cfgm = getattr(model, "config", None)
+        self._moe = bool(_num_experts(cfgm))
+        # stats()['moe_fused_gmm'] reports whether the fused kernel
+        # ACTUALLY traced into one of this engine's executables
+        # (captured in _aot_compile from the MOE_STATS kernel stamp) —
+        # env kill switch, config twin, backend and shape gates all
+        # fold in by construction
+        self._moe_fused_traced = False
+        self._moe_tap_on = bool(getattr(cfg, "moe_telemetry", True)) \
+            and os.environ.get("PADDLE_TPU_MOE_TELEMETRY", "1") != "0"
         max_pos = getattr(getattr(model, "config", None),
                           "max_position_embeddings", None)
         if max_pos is not None and cfg.max_model_len + gamma > max_pos:
@@ -539,6 +583,24 @@ class ServingEngine:
                               for kp, vp in self._dpools)
         self._pool_bytes_per_shard = pool_bytes // self._tp
         self._m_tp_pool.set(self._pool_bytes_per_shard)
+        # MoE routing telemetry: per-expert load fractions + routing
+        # entropy of every dispatch the engine's executables run,
+        # observed at DECODE time through the trace-armed tap in
+        # distributed/moe.py (one tiny debug callback per sparse layer
+        # per tick). Metrics registered unconditionally so stats() and
+        # the JSONL export always carry the keys.
+        self._m_moe_load = monitor.histogram(
+            "serving_moe_expert_load",
+            "per-expert share of routed (token, slot) pairs per "
+            "dispatch (0 = expert idle this step)",
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.2, 0.4, 0.6, 0.8, 1.0))
+        self._m_moe_entropy = monitor.gauge(
+            "serving_moe_routing_entropy",
+            "decode-time routing entropy, normalized to [0, 1] by "
+            "log(num_experts) (1 = perfectly balanced routing)")
+        self._moe_ent_last = 0.0
+        self._moe_load_max_last = 0.0
+        self._n_moe_dispatches = 0
         if gamma:
             self._m_spec_len = monitor.histogram(
                 "serving_spec_accepted_len",
@@ -1014,6 +1076,14 @@ class ServingEngine:
             "tp_collective_bytes_per_step": self._tp_step_bytes,
             "tp_collective_bytes_total": self._n_tp_bytes,
             "tp_pool_bytes_per_shard": self._pool_bytes_per_shard,
+            # MoE keys are ALWAYS present (False/0.0 for dense models)
+            # so dashboards and rollbacks never KeyError on a mixed
+            # fleet
+            "moe": self._moe,
+            "moe_fused_gmm": self._moe_fused_traced,
+            "moe_routing_entropy": self._moe_ent_last,
+            "moe_expert_load_max": self._moe_load_max_last,
+            "moe_dispatches": self._n_moe_dispatches,
         }
         if self._gamma:
             out.update({
@@ -1107,6 +1177,19 @@ class ServingEngine:
                     f"tp_degree={tp} does not divide the {who}'s "
                     f"vocab_size={v} (the logits all_gather needs an "
                     f"even vocab split)")
+            # MoE: the stacked expert weights shard their ffn dim over
+            # mp (gate_up [e, d, 2f] / down [e, f, d] PartitionSpecs),
+            # so the per-expert width must split evenly — reject here,
+            # before any compile, instead of silently replicating the
+            # largest parameter group in the model
+            f = getattr(c, "moe_intermediate_size", None)
+            if _num_experts(c) and f is not None and f % tp:
+                ok = [d_ for d_ in range(1, 17) if f % d_ == 0]
+                raise ValueError(
+                    f"tp_degree={tp} does not divide the {who}'s "
+                    f"moe_intermediate_size={f}: the stacked expert "
+                    f"gate_up/down projections shard their ffn dim "
+                    f"over mp (valid degrees for this model: {ok})")
         return Mesh(np.array(devs[:tp]), ("mp",))
 
     def _shard_params(self, binder):
@@ -1223,19 +1306,44 @@ class ServingEngine:
         ever builds flows through here, so ``executables_compiled`` in
         ``stats()`` is exact on the ragged AND legacy paths."""
         self._n_exec_compiled += 1
-        with self._trace_ctx(), _quiet_donation():
-            trace = getattr(jitted, "trace", None) \
-                if self._mesh is not None else None
-            if trace is not None:
-                traced = trace(*args)
-                exec_ = traced.lower().compile()
-                self._census[name] = monitor.collective_census(
-                    traced.jaxpr)
-                return exec_
-            # older jax: no jit().trace — the executable still compiles
-            # once, the census (and the byte counters it feeds) stays
-            # empty for this engine
-            return jitted.lower(*args).compile()
+        tap = _moe.serving_stats_tap(self._observe_moe_routing) \
+            if self._moe_tap_on else contextlib.nullcontext()
+        try:
+            with self._trace_ctx(), _quiet_donation(), tap:
+                trace = getattr(jitted, "trace", None) \
+                    if self._mesh is not None else None
+                if trace is not None:
+                    traced = trace(*args)
+                    exec_ = traced.lower().compile()
+                    self._census[name] = monitor.collective_census(
+                        traced.jaxpr)
+                    return exec_
+                # older jax: no jit().trace — the executable still
+                # compiles once, the census (and the byte counters it
+                # feeds) stays empty for this engine
+                return jitted.lower(*args).compile()
+        finally:
+            # which grouped kernel the trace just stamped: the honest
+            # source for stats()['moe_fused_gmm'] (env/config/backend/
+            # shape gates all folded in by construction)
+            if self._moe and \
+                    _moe.MOE_STATS["grouped_mm_kernel"] == "fused_gmm":
+                self._moe_fused_traced = True
+
+    def _observe_moe_routing(self, load, entropy):
+        """Run-time sink of the MoE routing tap (armed around every
+        executable trace): fed the per-expert load fractions and raw
+        routing entropy of each dispatch the compiled step executes.
+        Mirrors into the monitor registry AND the per-engine fields
+        ``stats()`` reports."""
+        load = np.asarray(load)
+        e = max(int(load.size), 2)
+        self._m_moe_load.observe_many(load)
+        ent = float(entropy) / float(np.log(e))
+        self._m_moe_entropy.set(ent)
+        self._moe_ent_last = ent
+        self._moe_load_max_last = float(load.max())
+        self._n_moe_dispatches += 1
 
     def collective_census(self) -> dict:
         """Per-executable jaxpr collective census (TP engines only):
@@ -1613,9 +1721,12 @@ class ServingEngine:
         later tick reuses the executable (shape change is impossible —
         slots, tables and lengths are static width)."""
         def decode(params, pools, tables, lens, toks, key):
-            logits, pools = self._model_step(
-                params, toks[:, None], pools, None,
-                block_tables=tables, cache_lens=lens)
+            # inactive slots (lens == 0) are pad rows — keep them out
+            # of the MoE routing telemetry
+            with _moe.serving_rows_mask(lens > 0):
+                logits, pools = self._model_step(
+                    params, toks[:, None], pools, None,
+                    block_tables=tables, cache_lens=lens)
             row = self._gather_logits(logits[:, -1, :])
             _, sub = jax.random.split(key)
             tok, _ = self._select(row, sub)
@@ -1649,9 +1760,11 @@ class ServingEngine:
 
         def chunk(params, ids, pools, table_row, pos, last, key):
             lens = jnp.reshape(pos.astype(jnp.int32), (1,))
-            logits, pools = self._model_step(
-                params, ids, pools, None,
-                block_tables=table_row[None], cache_lens=lens)
+            live = jnp.arange(c, dtype=jnp.int32) <= last
+            with _moe.serving_rows_mask(live):
+                logits, pools = self._model_step(
+                    params, ids, pools, None,
+                    block_tables=table_row[None], cache_lens=lens)
             row = jax.lax.dynamic_slice_in_dim(
                 logits, last, 1, axis=1)[:, 0, :]
             row = self._gather_logits(row)
@@ -1704,8 +1817,10 @@ class ServingEngine:
     def _compile_prefill(self, bucket, key):
         def prefill(params, ids, n_real, pools, table_row, key):
             dense = self.model.init_caches(1, bucket)
-            logits, dense = self._model_step(
-                params, ids, dense, jnp.zeros((), jnp.int32))
+            live = jnp.arange(bucket, dtype=jnp.int32) < n_real
+            with _moe.serving_rows_mask(live):
+                logits, dense = self._model_step(
+                    params, ids, dense, jnp.zeros((), jnp.int32))
             pools = [
                 _pc.write_prefill(kp, vp, table_row[None], dk, dv,
                                   n_real=n_real)
@@ -1741,7 +1856,15 @@ class ServingEngine:
             onehot_draft=self._draft_model is None,
             gather_logits=self._gather_logits
             if self._mesh is not None else None)
-        jitted = jax.jit(verify, donate_argnums=(1,))
+        g = self._gamma
+
+        def verify_masked(params, pools, tables, lens, *rest):
+            # inactive slots contribute gamma+1 pad rows each — keep
+            # them out of the MoE routing telemetry
+            with _moe.serving_rows_mask(jnp.repeat(lens > 0, g + 1)):
+                return verify(params, pools, tables, lens, *rest)
+
+        jitted = jax.jit(verify_masked, donate_argnums=(1,))
         args = [self._params, self._pools, self._dev(self._tables),
                 self._dev(lens), self._dev(toks)]
         if self._do_sample:
@@ -1790,9 +1913,14 @@ class ServingEngine:
             nwin = jnp.arange(g + 1, dtype=jnp.int32)
             win = jnp.arange(self._wmax, dtype=jnp.int32)
             meta = (q_lens, row_starts, row_slot, row_pos, nwin, win)
-            logits, pools = self._model_step(
-                params, ids[None, :], pools, None, block_tables=tables,
-                cache_lens=base, ragged_meta=meta)
+            # pad rows park at the overflow position — exclude them
+            # from the MoE routing telemetry (they'd read as
+            # hot-expert skew on lightly loaded ticks)
+            with _moe.serving_rows_mask(row_pos < self._overflow):
+                logits, pools = self._model_step(
+                    params, ids[None, :], pools, None,
+                    block_tables=tables, cache_lens=base,
+                    ragged_meta=meta)
             lg = logits[0]                          # [R, V(/tp)]
             if not g:
                 (key,) = rest
@@ -1868,10 +1996,12 @@ class ServingEngine:
                         nwin, win)
 
                 def _prime(dp):
-                    _, dp = self._draft_step(
-                        dparams, ids[None, :], dp, None,
-                        block_tables=tables, cache_lens=base,
-                        ragged_meta=meta)
+                    with _moe.serving_rows_mask(
+                            prime_pos < self._overflow):
+                        _, dp = self._draft_step(
+                            dparams, ids[None, :], dp, None,
+                            block_tables=tables, cache_lens=base,
+                            ragged_meta=meta)
                     return dp
 
                 # no pending prefill rows this tick -> the prime
@@ -1880,8 +2010,11 @@ class ServingEngine:
                 # steady-state recompiles)
                 dpools = jax.lax.cond(jnp.max(prime_q) > 0, _prime,
                                       lambda dp: dp, dpools)
-            props, qp, dpools = loop(dparams, dpools, tables,
-                                     scan_lens, cur, key)
+            # non-verifying slots scan at the overflow length — pad
+            # rows, excluded from the draft's routing telemetry
+            with _moe.serving_rows_mask(scan_lens < self._overflow):
+                props, qp, dpools = loop(dparams, dpools, tables,
+                                         scan_lens, cur, key)
             if qp is None:
                 return props, dpools
             return props, qp, dpools
@@ -1901,7 +2034,12 @@ class ServingEngine:
             want_probs=self._do_sample,
             gather_logits=self._gather_logits
             if self._mesh is not None else None)
-        jitted = jax.jit(loop, donate_argnums=(1,))
+
+        def draft_masked(dparams, dpools, tables, lens, cur, key):
+            with _moe.serving_rows_mask(lens > 0):
+                return loop(dparams, dpools, tables, lens, cur, key)
+
+        jitted = jax.jit(draft_masked, donate_argnums=(1,))
         return self._aot_compile(
             "draft", jitted,
             (self._dparams, self._dpools, self._dev(self._tables),

@@ -58,6 +58,9 @@ class DeepseekMoeConfig:
     # fast path when expert_axis is mesh-sharded) vs GShard capacity
     dropless: bool = False
     ep_buffer_factor: float = 2.0
+    # fused-dispatch grouped matmuls (ops/pallas/moe_gmm.py); False (or
+    # PADDLE_TPU_MOE_FUSED_GMM=0) pins the sort->pack->gmm path
+    moe_fused_gmm: bool = True
     dtype: str = "float32"
 
     @staticmethod
@@ -107,14 +110,16 @@ class DeepseekMoeBlock(Layer):
                     normalize_gates=cfg.norm_topk_prob,
                     expert_axis=cfg.expert_axis,
                     ep_buffer_factor=getattr(cfg, "ep_buffer_factor",
-                                             2.0))
+                                             2.0),
+                    fused=getattr(cfg, "moe_fused_gmm", None))
             from ..distributed.moe import moe_dispatch_combine_grouped
             return moe_dispatch_combine_grouped(
                 x_arr, logit_arr, cfg.n_routed_experts,
                 cfg.num_experts_per_tok, gate_up, down,
                 capacity_factor=cfg.capacity_factor,
                 expert_axis=cfg.expert_axis,
-                normalize_gates=cfg.norm_topk_prob)
+                normalize_gates=cfg.norm_topk_prob,
+                fused=getattr(cfg, "moe_fused_gmm", None))
 
         y, aux = apply_jax("deepseek_moe_block", f, x2, logits,
                            self.experts.gate_up_proj,
@@ -143,14 +148,18 @@ class DeepseekMoeDecoderLayer(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None):
+                position_ids=None, block_tables=None, cache_lens=None,
+                ragged_meta=None):
         h = self.input_layernorm(hidden_states)
         new_cache = None
         if kv_cache is not None:
             a, new_cache = self.self_attn(h, rope_cos, rope_sin,
                                           attention_mask, kv_cache,
                                           offset,
-                                          position_ids=position_ids)
+                                          position_ids=position_ids,
+                                          block_tables=block_tables,
+                                          cache_lens=cache_lens,
+                                          ragged_meta=ragged_meta)
         else:
             a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
         h = hidden_states + a
@@ -184,7 +193,8 @@ class DeepseekMoeModel(Layer):
         self._rope_sin = Tensor(sin)
 
     def forward(self, input_ids, attention_mask=None, caches=None,
-                offset=None, position_ids=None):
+                offset=None, position_ids=None, block_tables=None,
+                cache_lens=None, ragged_meta=None):
         input_ids = batch_shard(input_ids)
         h = self.embed_tokens(input_ids)
         if caches is not None:
@@ -193,7 +203,10 @@ class DeepseekMoeModel(Layer):
                 h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
                                      attention_mask, kv_cache=kv,
                                      offset=offset,
-                                     position_ids=position_ids)
+                                     position_ids=position_ids,
+                                     block_tables=block_tables,
+                                     cache_lens=cache_lens,
+                                     ragged_meta=ragged_meta)
                 new_caches.append(kv2)
             return self.norm(h), None, new_caches
         l = h.shape[1]
@@ -242,12 +255,33 @@ class DeepseekMoeForCausalLM(Layer, GenerationMixin):
             for _ in range(cfg.num_hidden_layers)
         ]
 
+    def init_paged_caches(self, num_blocks: int, block_size: int,
+                          sharding=None):
+        """Zeroed per-layer paged (k_pool, v_pool) — the shared serving
+        cache layout (see ``ops/paged_cache.py``), identical protocol
+        to Llama/Qwen2-MoE."""
+        from ..ops.paged_cache import init_pool
+        import jax.numpy as jnp
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [
+            init_pool(num_blocks, block_size, cfg.num_key_value_heads,
+                      head_dim, jnp.dtype(getattr(cfg, "dtype",
+                                                  "float32")),
+                      sharding=sharding)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
     def forward(self, input_ids, labels=None, attention_mask=None,
-                caches=None, offset=None, position_ids=None):
+                caches=None, offset=None, position_ids=None,
+                block_tables=None, cache_lens=None, ragged_meta=None):
         if caches is not None:
             h, _, new_caches = self.deepseek(input_ids, attention_mask,
                                              caches=caches, offset=offset,
-                                             position_ids=position_ids)
+                                             position_ids=position_ids,
+                                             block_tables=block_tables,
+                                             cache_lens=cache_lens,
+                                             ragged_meta=ragged_meta)
             return self._logits(h), new_caches
         h, aux_total = self.deepseek(input_ids, attention_mask)
         logits = self._logits(h)

@@ -63,6 +63,11 @@ class Qwen2MoeConfig:
     # EP exchange-slot bound, in multiples of the balanced per-shard
     # load (see moe._dropless_ep); >= the EP degree is exactly dropless
     ep_buffer_factor: float = 2.0
+    # fused-dispatch grouped matmuls (ops/pallas/moe_gmm.py): the sort
+    # gather rides the first expert matmul's load, swiglu its epilogue,
+    # the combine unsort the second's scatter store. False (or
+    # PADDLE_TPU_MOE_FUSED_GMM=0) pins the sort->pack->gmm path.
+    moe_fused_gmm: bool = True
     dtype: str = "float32"
 
     @staticmethod
@@ -145,6 +150,7 @@ class Qwen2MoeSparseBlock(Layer):
                     expert_axis=cfg.expert_axis,
                     ep_buffer_factor=getattr(cfg, "ep_buffer_factor",
                                              2.0),
+                    fused=getattr(cfg, "moe_fused_gmm", None),
                     return_stats=collect)
             else:
                 # capacity semantics on the grouped-matmul engine
@@ -158,6 +164,7 @@ class Qwen2MoeSparseBlock(Layer):
                     capacity_factor=cfg.capacity_factor,
                     expert_axis=cfg.expert_axis,
                     normalize_gates=cfg.norm_topk_prob,
+                    fused=getattr(cfg, "moe_fused_gmm", None),
                     return_stats=collect)
             if collect:
                 y, aux, stats = out
@@ -221,17 +228,26 @@ class Qwen2MoeDecoderLayer(Layer):
 
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
-                position_ids=None):
+                position_ids=None, block_tables=None, cache_lens=None,
+                ragged_meta=None):
         """Returns ``(h, aux_loss)`` uniformly (zero aux for dense
         layers) so the remat and non-remat paths carry the router loss
-        identically; with ``kv_cache``, ``(h, aux_loss, new_cache)``."""
+        identically; with ``kv_cache``, ``(h, aux_loss, new_cache)``.
+        ``block_tables``/``cache_lens``/``ragged_meta`` select the
+        paged / ragged mixed-batch serving attention (vanilla GQA — the
+        Llama kernels run unmodified; only the MLP differs, and MoE
+        dispatch is per-row, so packed serving rows route exactly like
+        a dense batch)."""
         h = self.input_layernorm(hidden_states)
         new_cache = None
         if kv_cache is not None:
             a, new_cache = self.self_attn(h, rope_cos, rope_sin,
                                           attention_mask, kv_cache,
                                           offset,
-                                          position_ids=position_ids)
+                                          position_ids=position_ids,
+                                          block_tables=block_tables,
+                                          cache_lens=cache_lens,
+                                          ragged_meta=ragged_meta)
         else:
             a = self.self_attn(h, rope_cos, rope_sin, attention_mask)
         h = hidden_states + a
@@ -264,7 +280,8 @@ class Qwen2MoeModel(Layer):
         self._rope_sin = Tensor(sin)
 
     def forward(self, input_ids, attention_mask=None, caches=None,
-                offset=None, position_ids=None):
+                offset=None, position_ids=None, block_tables=None,
+                cache_lens=None, ragged_meta=None):
         """Returns ``(h, total_aux_loss)``; with ``caches``,
         ``(h, total_aux_loss, new_caches)``."""
         input_ids = batch_shard(input_ids)
@@ -275,7 +292,10 @@ class Qwen2MoeModel(Layer):
                 h, _aux, kv2 = layer(h, self._rope_cos, self._rope_sin,
                                      attention_mask, kv_cache=kv,
                                      offset=offset,
-                                     position_ids=position_ids)
+                                     position_ids=position_ids,
+                                     block_tables=block_tables,
+                                     cache_lens=cache_lens,
+                                     ragged_meta=ragged_meta)
                 new_caches.append(kv2)
             return self.norm(h), None, new_caches
         l = h.shape[1]
@@ -323,12 +343,34 @@ class Qwen2MoeForCausalLM(Layer, GenerationMixin):
             for _ in range(cfg.num_hidden_layers)
         ]
 
+    def init_paged_caches(self, num_blocks: int, block_size: int,
+                          sharding=None):
+        """Zeroed per-layer paged (k_pool, v_pool) — the shared serving
+        cache (see ``ops/paged_cache.py``); same layout/protocol as
+        Llama's, so the serving engine and ``generate(
+        cache_impl="paged")`` run MoE unmodified on the attention
+        side."""
+        from ..ops.paged_cache import init_pool
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [
+            init_pool(num_blocks, block_size, cfg.num_key_value_heads,
+                      head_dim, jnp.dtype(getattr(cfg, "dtype",
+                                                  "float32")),
+                      sharding=sharding)
+            for _ in range(cfg.num_hidden_layers)
+        ]
+
     def forward(self, input_ids, labels=None, attention_mask=None,
-                caches=None, offset=None, position_ids=None):
+                caches=None, offset=None, position_ids=None,
+                block_tables=None, cache_lens=None, ragged_meta=None):
         if caches is not None:
             h, _, new_caches = self.qwen2_moe(input_ids, attention_mask,
                                               caches=caches, offset=offset,
-                                              position_ids=position_ids)
+                                              position_ids=position_ids,
+                                              block_tables=block_tables,
+                                              cache_lens=cache_lens,
+                                              ragged_meta=ragged_meta)
             return self._logits(h), new_caches
         h, aux_total = self.qwen2_moe(input_ids, attention_mask)
         logits = self._logits(h)

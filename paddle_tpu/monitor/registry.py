@@ -202,6 +202,31 @@ class Histogram(_Metric):
     def observe(self, value):
         self._observe(self._key(None), value)
 
+    def observe_many(self, values):
+        """Bulk observe: bin the whole vector once (numpy) and add the
+        counts under ONE lock acquisition — the hot-path form for
+        per-step vector observations (e.g. per-expert MoE load), where
+        a python observe() loop per element would serialize on the
+        lock thousands of times per decode step."""
+        import numpy as _np
+        values = _np.asarray(values, dtype=float).reshape(-1)
+        if values.size == 0:
+            return
+        idx = _np.searchsorted(_np.asarray(self.buckets), values,
+                               side="left")
+        binned = _np.bincount(idx, minlength=len(self.buckets) + 1)
+        key = self._key(None)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = {"count": 0, "sum": 0.0,
+                      "buckets": [0] * (len(self.buckets) + 1)}
+                self._values[key] = st
+            st["count"] += int(values.size)
+            st["sum"] += float(values.sum())
+            for i, n in enumerate(binned):
+                st["buckets"][i] += int(n)
+
     def _get(self, key):
         with self._lock:
             st = self._values.get(key)

@@ -73,7 +73,8 @@ __all__ = ["paged_decode_attention", "pallas_paged_attention",
            "paged_attention_step", "ragged_attention_step",
            "sharded_paged_attention_step",
            "sharded_ragged_attention_step", "kernel_fallback_counts",
-           "tp_shard_degree", "serving_tp_scope"]
+           "tp_shard_degree", "serving_tp_scope",
+           "serving_tp_active"]
 
 NEG_INF = np.float32(-1e30)
 
@@ -739,6 +740,26 @@ def serving_tp_scope():
         yield
     finally:
         _SERVING_TP.on = prev
+
+
+def serving_tp_active() -> bool:
+    """True while tracing inside a TP engine's ``serving_tp_scope``
+    with a live ``mp`` mesh (and not already inside a manual region) —
+    the condition under which GSPMD owns the partitioning of any op in
+    the trace. Non-attention callers (the MoE grouped matmuls) use
+    this to keep opaque Pallas kernels OFF such traces: an opaque
+    pallas_call cannot be partitioned, so they must take their XLA
+    lowering there (the same reasoning as the r5 ragged_dot gate)."""
+    if not getattr(_SERVING_TP, "on", False):
+        return False
+    try:
+        from ...distributed.shard_utils import (current_mesh,
+                                                in_manual_region)
+    except Exception:       # pragma: no cover - partial install
+        return False
+    mesh = current_mesh()
+    return (mesh is not None and int(mesh.shape.get("mp", 1)) > 1
+            and not in_manual_region())
 
 
 def tp_shard_degree(num_heads, num_kv_heads) -> int:

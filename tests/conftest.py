@@ -35,6 +35,11 @@ import pytest
 # r7 re-sweep (ragged mixed-batch serving): tier-1 measured 779s with
 # the new test_ragged_batch.py aboard (slowest new test 6.6s — under
 # the ~9s line), so no new entries.
+# r8 re-sweep (MoE serving + fused dispatch): tier-1 measured 647-813s
+# across two solo runs with the 16 new test_moe_serving.py tests
+# aboard (562 passed; slowest new test 9.1s — the qwen2 ragged-ON/OFF
+# engine pairing, right AT the line but the tier keeps >=57s of
+# headroom), so no new entries.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s
