@@ -237,6 +237,39 @@ def main(argv=None):
           f"{st_moe['moe_routing_entropy']:.2f} over "
           f"{st_moe['moe_dispatches']} dispatches, "
           f"{st_moe['executables_compiled']} executable")
+
+    # ---- 8. int8 KV cache: half the KV bytes per decode step
+    # The block pool stores int8 K/V + per-(block, position, head)
+    # absmax scales; kernels dequantize in VMEM after the block load.
+    # Quantization perturbs logits, so int8-vs-fp is a token MATCH
+    # RATE budget (>= 0.99 on the serving bench; a trained chain model
+    # should be exact) — while pool bytes and KV bytes/step halve.
+    kv_prompts = [np.asarray([7] + chain(7, n), np.int32)
+                  for n in (3, 9, 5)]
+    eng = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96,
+        prefill_chunk=16))
+    fp_outs = eng.serve(list(kv_prompts), max_new_tokens=6)
+    st_fp = eng.stats()
+    eng.shutdown()
+    eng = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96,
+        prefill_chunk=16, kv_cache_dtype="int8"))
+    q8_outs = eng.serve(list(kv_prompts), max_new_tokens=6)
+    st_q8 = eng.stats()
+    eng.shutdown()
+    tot = sum(len(a) for a in fp_outs)
+    hit = sum(int((np.asarray(a) == np.asarray(b)).sum())
+              for a, b in zip(fp_outs, q8_outs))
+    match = hit / tot
+    assert match >= 0.99, \
+        f"int8 KV match rate {match:.3f} below the 0.99 budget"
+    assert st_q8["kv_pool_bytes"] < 0.6 * st_fp["kv_pool_bytes"]
+    print(f"int8 KV cache: match rate {match:.2f} vs fp, pool "
+          f"{st_q8['kv_pool_bytes']}B vs {st_fp['kv_pool_bytes']}B "
+          f"({st_q8['kv_pool_bytes'] / st_fp['kv_pool_bytes']:.2f}x), "
+          f"KV bytes/step {st_q8['kv_bytes_per_step']} vs "
+          f"{st_fp['kv_bytes_per_step']}")
     return n_ok / 12.0, losses
 
 

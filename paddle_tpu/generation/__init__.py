@@ -51,6 +51,12 @@ class GenerationConfig:
     # layout (ops/paged_cache.py + the ragged paged-attention kernel)
     cache_impl: str = "dense"
     kv_block_size: int = 16            # paged cache block size
+    # None/'auto' = pool in the model dtype (bit-for-bit the
+    # pre-quantization layout); 'int8' = quantized block pool (int8
+    # data + per-(block, position, head) absmax scales — half the KV
+    # HBM stream per decode step). Paged cache only. Env twin:
+    # PADDLE_TPU_KV_INT8 (0 = kill switch, 1 = on when unset here).
+    kv_cache_dtype: Optional[str] = None
     # left-pad prompts up to power-of-two length buckets so varied
     # prompt lengths reuse ONE compiled decode loop per bucket
     pad_prompt_to_bucket: bool = True
@@ -245,7 +251,8 @@ class GenerationMixin:
         return run
 
     def _build_run_paged(self, binder, buffers, b, prompt_len, max_new,
-                         select, eos, pad, with_scores, block_size):
+                         select, eos, pad, with_scores, block_size,
+                         kv_cache_dtype=None):
         """Paged-KV twin of ``_build_run``: prefill goes through the
         dense cached path (bit-identical numerics), its K/V scatter into
         a block pool (contiguous static block tables — generate() owns
@@ -263,7 +270,12 @@ class GenerationMixin:
 
         def run(params_a, ids_a, key):
             tables = jnp.asarray(tables_np)
-            pools = self.init_paged_caches(num_blocks, block_size)
+            # kwarg passed only when set, so pre-quantization
+            # duck-typed models keep working on the default path
+            pools = self.init_paged_caches(
+                num_blocks, block_size,
+                **({"kv_cache_dtype": kv_cache_dtype}
+                   if kv_cache_dtype else {}))
             dense = self.init_caches(b, prompt_len)
             logits, dense = model_step(params_a, ids_a, dense,
                                        jnp.zeros((), jnp.int32))
@@ -312,7 +324,7 @@ class GenerationMixin:
                  pad_token_id=None, seed=None, attention_mask=None,
                  cache_impl=None, pad_prompt_to_bucket=None,
                  num_speculative_tokens=None, draft_model=None,
-                 spec_ngram_max=None, **kwargs):
+                 spec_ngram_max=None, kv_cache_dtype=None, **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
         log-probability of the chosen tokens per sequence (for beam
@@ -329,7 +341,8 @@ class GenerationMixin:
                 "diversity_rate, length_penalty, early_stopping, "
                 "eos_token_id, pad_token_id, seed, cache_impl "
                 "(dense|paged), pad_prompt_to_bucket, "
-                "num_speculative_tokens, draft_model, spec_ngram_max")
+                "num_speculative_tokens, draft_model, spec_ngram_max, "
+                "kv_cache_dtype (None|'int8')")
         cfg = generation_config or GenerationConfig()
         if max_length is not None and max_new_tokens is None:
             max_new_tokens = max_length  # PaddleNLP: length of generation
@@ -361,6 +374,27 @@ class GenerationMixin:
         if cache_impl not in ("dense", "paged"):
             raise ValueError(
                 f"cache_impl {cache_impl!r}; supported: dense, paged")
+        # -- KV-pool quantization (paged cache only) ------------------
+        from ..ops import paged_cache as _pcq
+        _kv_req = kv_cache_dtype if kv_cache_dtype is not None \
+            else getattr(cfg, "kv_cache_dtype", None)
+        if _kv_req not in (None, "auto"):
+            # an EXPLICIT int8 request rides the paged layout (the
+            # dense cache has no block pool to quantize) — auto-select
+            # it like speculative decoding does, and reject an
+            # explicit dense request instead of silently ignoring the
+            # option
+            _pcq.resolve_kv_cache_dtype(_kv_req)    # validate early
+            if _explicit_cache_impl == "dense":
+                raise ValueError(
+                    "kv_cache_dtype requires the paged cache; it "
+                    "cannot run with an explicit cache_impl='dense'")
+            cache_impl = "paged"
+        # env twin consulted only where a block pool exists — the
+        # PADDLE_TPU_KV_INT8=1 fleet default must not flip dense
+        # decode paths
+        kv_dtype = _pcq.resolve_kv_cache_dtype(_kv_req) \
+            if cache_impl == "paged" else None
         if pad_prompt_to_bucket is None:
             pad_prompt_to_bucket = getattr(cfg, "pad_prompt_to_bucket",
                                            True)
@@ -483,13 +517,17 @@ class GenerationMixin:
             self._check_lengths(prompt_len, max_new + gamma)
             ngram_max = int(cfg.spec_ngram_max if spec_ngram_max
                             is None else spec_ngram_max)
+            # the speculative loop rides the paged pool, so the env
+            # twin / config quantization request applies to it
+            kv_dtype = _pcq.resolve_kv_cache_dtype(_kv_req)
             if not hasattr(self, "_generate_jit_cache"):
                 self._generate_jit_cache = {}
             jit_key = ("spec", b, prompt_len, max_new, gamma,
                        do_sample, temperature, top_k, top_p, eos, pad,
                        id(draft_model) if draft_model is not None
                        else None, ngram_max,
-                       int(getattr(cfg, "kv_block_size", 16)))
+                       int(getattr(cfg, "kv_block_size", 16)),
+                       kv_dtype)
             runner = self._generate_jit_cache.get(jit_key)
             _label = type(self).__name__
             if runner is None:
@@ -500,7 +538,8 @@ class GenerationMixin:
                     gamma, do_sample=do_sample, temperature=temperature,
                     top_k=top_k, top_p=top_p, eos=eos, pad=pad,
                     block_size=int(getattr(cfg, "kv_block_size", 16)),
-                    draft_model=draft_model, ngram_max=ngram_max)
+                    draft_model=draft_model, ngram_max=ngram_max,
+                    kv_cache_dtype=kv_dtype)
                 self._generate_jit_cache[jit_key] = runner
             else:
                 _gen_cache_events.labels(model=_label,
@@ -552,7 +591,8 @@ class GenerationMixin:
                 run = self._build_run_paged(
                     binder, buffers, b, prompt_len, max_new, select,
                     eos, pad, with_scores=True,
-                    block_size=int(getattr(cfg, "kv_block_size", 16)))
+                    block_size=int(getattr(cfg, "kv_block_size", 16)),
+                    kv_cache_dtype=kv_dtype)
             else:
                 run = self._build_run(binder, buffers, b, prompt_len,
                                       max_new, select, eos, pad,
@@ -561,7 +601,8 @@ class GenerationMixin:
                                       is not None)
             jit_key = (b, prompt_len, max_new, do_sample, temperature,
                        top_k, top_p, eos, pad,
-                       attention_mask is not None, cache_impl)
+                       attention_mask is not None, cache_impl,
+                       kv_dtype)
 
         if not hasattr(self, "_generate_jit_cache"):
             self._generate_jit_cache = {}

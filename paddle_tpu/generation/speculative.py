@@ -340,9 +340,14 @@ class SpecGenerator:
 
     def __init__(self, model, binder, buffers, b, prompt_len, max_new,
                  gamma, *, do_sample, temperature, top_k, top_p, eos,
-                 pad, block_size, draft_model=None, ngram_max=3):
+                 pad, block_size, draft_model=None, ngram_max=3,
+                 kv_cache_dtype=None):
         from ..ops import paged_cache as _pc
         from . import _select_token
+        # kwarg forwarded only when set — pre-quantization duck-typed
+        # models keep working on the default path
+        _kv_kw = {"kv_cache_dtype": kv_cache_dtype} \
+            if kv_cache_dtype else {}
 
         self.b, self.max_new, self.gamma = b, int(max_new), int(gamma)
         self.eos, self.pad = int(eos), int(pad)
@@ -365,7 +370,8 @@ class SpecGenerator:
 
         def prefill(params, ids, key):
             tables = jnp.asarray(self._tables_np)
-            pools = model.init_paged_caches(num_blocks, block_size)
+            pools = model.init_paged_caches(num_blocks, block_size,
+                                            **_kv_kw)
             dense = model.init_caches(b, prompt_len)
             logits, dense = model_step(params, ids, dense,
                                        jnp.zeros((), jnp.int32))
@@ -392,7 +398,8 @@ class SpecGenerator:
             def dprefill(dparams, ids):
                 tables = jnp.asarray(self._tables_np)
                 pools = draft_model.init_paged_caches(num_blocks,
-                                                      block_size)
+                                                      block_size,
+                                                      **_kv_kw)
                 dense = draft_model.init_caches(b, prompt_len)
                 _, dense = draft_step(dparams, ids, dense,
                                       jnp.zeros((), jnp.int32))

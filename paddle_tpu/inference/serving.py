@@ -91,6 +91,21 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   ragged_batch=False)``) restores the per-width executables
   bit-for-bit. See docs/OPS.md "Ragged mixed-batch serving".
 
+- **Quantized KV cache** (``ServingConfig(kv_cache_dtype="int8")`` /
+  env twin ``PADDLE_TPU_KV_INT8``): the block pool stores int8 K/V
+  plus per-(block, position, head) absmax scales
+  (``ops/paged_cache.QuantKV``) — every write path quantizes on store
+  through one shared scatter, the Pallas kernels dequantize tiles in
+  VMEM after the block load, and the XLA fallbacks mirror the same
+  math through ``gather_dense``. Steady-state decode is HBM-bound on
+  KV reads, so bytes/step halve (~0.53x pool bytes vs bf16) and
+  ~2x the slots fit a fixed pool budget. Prefix caching, COW,
+  speculative rollback, chunked prefill, the ragged engine and TP all
+  compose (stored bytes are a pure function of the tokens; the scale
+  pool shards on the same kv_head cut). Default (None) keeps the fp
+  pool bit-for-bit; ``PADDLE_TPU_KV_INT8=0`` is the kill switch. See
+  docs/OPS.md "KV cache quantization".
+
 - **Tensor-parallel serving** (``ServingConfig(tp_degree=N)``): every
   serving executable — batched decode, fixed-gamma verify, fixed-chunk
   prefill, the draft loop and the ``copy_blocks`` COW — is sharded
@@ -228,6 +243,19 @@ class ServingConfig:
     # num_kv_heads / num_attention_heads / vocab_size (validated at
     # engine construction). Kill switch: PADDLE_TPU_SERVE_TP=0.
     tp_degree: int = 1
+    # KV-pool quantization: None/'auto' = pool in the model dtype
+    # (bit-for-bit the pre-quantization layout); 'int8' = quantized
+    # block pool (int8 data + per-(block, position, head) f32 absmax
+    # scales — ~0.53x the bf16 pool bytes, half the KV HBM stream per
+    # decode step, ~2x admissible slots at a fixed pool byte budget).
+    # Composes with prefix caching/COW (quantize-on-store makes cached
+    # bytes a pure function of the tokens), speculative verify/
+    # rollback, chunked prefill, the ragged engine and TP (the scale
+    # pool shards on the same kv_head cut). Env twin
+    # PADDLE_TPU_KV_INT8: 0 = kill switch (fp pool, bit-for-bit), 1 =
+    # int8 when this field is left None. On TPU use block_size=32 (the
+    # int8 sublane tile) to keep the Pallas kernel eligible.
+    kv_cache_dtype: Optional[str] = None
     # MoE routing telemetry (serving_moe_expert_load /
     # serving_moe_routing_entropy): each sparse layer's dispatch
     # embeds one tiny host callback per executed tick. False (or
@@ -431,6 +459,11 @@ class ServingEngine:
         self._chunk = max(1, min(int(cfg.prefill_chunk),
                                  int(cfg.max_model_len)))
         self._chunk_budget = int(cfg.max_prefill_chunks_per_step)
+        # KV-pool quantization: resolved ONCE at construction (config
+        # + PADDLE_TPU_KV_INT8 env twin) — "int8" or None; raises on
+        # an unsupported request before any pool is built
+        self._kv_dtype = _pc.resolve_kv_cache_dtype(
+            getattr(cfg, "kv_cache_dtype", None))
         # content-hash chain seed: hashes are only comparable within
         # one (model architecture, config, cache layout) world
         self._fp = self._model_fingerprint(model)
@@ -576,13 +609,41 @@ class ServingEngine:
         self._m_tp_pool = monitor.gauge(
             "serving_tp_pool_bytes_per_shard",
             "KV block-pool bytes each shard holds (kv_head slice)")
-        pool_bytes = sum(int(kp.nbytes) + int(vp.nbytes)
-                         for kp, vp in self._pools)
+        pool_bytes = _pc.pool_bytes(self._pools)
+        target_pool_bytes = pool_bytes
         if self._draft_model is not None:
-            pool_bytes += sum(int(kp.nbytes) + int(vp.nbytes)
-                              for kp, vp in self._dpools)
+            pool_bytes += _pc.pool_bytes(self._dpools)
         self._pool_bytes_per_shard = pool_bytes // self._tp
         self._m_tp_pool.set(self._pool_bytes_per_shard)
+        # -- KV-pool telemetry (quantization observability) -----------
+        # registered unconditionally, so stats()/JSONL always carry the
+        # keys — fp engines report the fp numbers, consumers never
+        # KeyError on a mixed or rolled-back fleet
+        self._kv_dtype_name = "int8" if self._kv_dtype == "int8" \
+            else str(jnp.dtype(self._pools[0][0].dtype))
+        self._kv_pool_bytes = pool_bytes            # data + scales
+        # bytes ONE cached position costs across all target layers
+        # (int8: data + scale rows) — the analytic per-step KV read
+        # gauge multiplies this by the tick's attended positions
+        self._kv_pos_bytes = target_pool_bytes / float(
+            self._pools[0][0].shape[0] * self._bs)
+        self._kv_step_bytes_last = 0
+        self._kv_read_pend = 0      # legacy-path chunk reads this tick
+        monitor.info(
+            "serving_kv_cache_dtype",
+            "KV block-pool storage dtype of the most recent engine "
+            "(int8 = quantized pool + absmax scales)").set(
+            self._kv_dtype_name)
+        self._m_kv_pool = monitor.gauge(
+            "serving_kv_pool_bytes",
+            "total KV block-pool bytes (all layers + scale pools, "
+            "target and draft models, every shard)")
+        self._m_kv_pool.set(pool_bytes)
+        self._m_kv_step = monitor.gauge(
+            "serving_kv_bytes_per_step",
+            "analytic target-pool KV bytes the last engine tick's "
+            "attention streamed from HBM (attended positions x bytes "
+            "per cached position; int8 pools count data + scales)")
         # MoE routing telemetry: per-expert load fractions + routing
         # entropy of every dispatch the engine's executables run,
         # observed at DECODE time through the trace-armed tap in
@@ -667,6 +728,8 @@ class ServingEngine:
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and s.pend_pos is None]
         if not active:
+            if self._kv_read_pend:      # prefill-only tick: the chunk
+                self._note_kv_read(0)   # reads ARE the tick's traffic
             return emitted
         self._ensure_blocks(active)
 
@@ -693,6 +756,7 @@ class ServingEngine:
             self._m_tp_bytes.inc(self._tp_step_bytes)
             self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
+        self._note_kv_read(int(lens.sum()) + len(active))
         for i in active:
             slot = self._slots[i]
             tok = int(out[i])
@@ -721,6 +785,8 @@ class ServingEngine:
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and s.pend_pos is None]
         if not active:
+            if self._kv_read_pend:      # prefill-only tick
+                self._note_kv_read(0)
             return emitted
         g = self._gamma
         # room for the full window: positions cache_len .. cache_len+g
@@ -772,6 +838,9 @@ class ServingEngine:
             self._m_tp_bytes.inc(self._tp_step_bytes)
             self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
+        # window row t attends lens + t + 1 positions
+        self._note_kv_read((g + 1) * int(lens.sum())
+                           + len(active) * (g + 1) * (g + 2) // 2)
         for i in active:
             self._commit_verify_window(i, out[i], accept[i], emitted)
         if self._n_spec_proposed:
@@ -970,6 +1039,9 @@ class ServingEngine:
             self._m_tp_bytes.inc(self._tp_step_bytes)
             self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / n_slots)
+        # packed row t of slot s attends base[s] + t + 1 positions
+        self._note_kv_read(int((q_lens * base).sum())
+                           + int((q_lens * (q_lens + 1) // 2).sum()))
 
         # -- commit decode / verify rows -------------------------------
         if not g:
@@ -1069,6 +1141,12 @@ class ServingEngine:
             "cow_copies": self._n_cow,
             "cache_evictions": self._alloc.evictions,
             "cached_blocks": self._alloc.cached_blocks,
+            # KV-pool quantization keys are ALWAYS present (fp engines
+            # report the fp dtype/bytes), so dashboards never KeyError
+            # across a mixed fleet or a PADDLE_TPU_KV_INT8=0 rollback
+            "kv_cache_dtype": self._kv_dtype_name,
+            "kv_pool_bytes": self._kv_pool_bytes,
+            "kv_bytes_per_step": self._kv_step_bytes_last,
             "tp_degree": self._tp,
             # always present (0 / full pool when single-device), so a
             # tp_degree>1 request downgraded by the PADDLE_TPU_SERVE_TP=0
@@ -1134,14 +1212,17 @@ class ServingEngine:
     # -- tensor parallelism -------------------------------------------
 
     def _init_caches(self, mdl, nb):
-        """Per-layer paged pools. The ``sharding`` kwarg is passed only
-        under TP, so duck-typed models implementing the pre-TP
-        two-argument ``init_paged_caches(num_blocks, block_size)``
-        protocol keep working at tp_degree=1."""
+        """Per-layer paged pools. The ``sharding``/``kv_cache_dtype``
+        kwargs are passed only when needed (TP / int8), so duck-typed
+        models implementing the pre-TP two-argument
+        ``init_paged_caches(num_blocks, block_size)`` protocol keep
+        working on the default path."""
+        kw = {}
         if self._pool_sharding is not None:
-            return mdl.init_paged_caches(nb, self._bs,
-                                         sharding=self._pool_sharding)
-        return mdl.init_paged_caches(nb, self._bs)
+            kw["sharding"] = self._pool_sharding
+        if self._kv_dtype is not None:
+            kw["kv_cache_dtype"] = self._kv_dtype
+        return mdl.init_paged_caches(nb, self._bs, **kw)
 
     @staticmethod
     def _build_tp_mesh(model, draft_model, tp: int) -> Mesh:
@@ -1541,6 +1622,11 @@ class ServingEngine:
         table_dev = slot.pend_row
         while budget is None or budget > 0:
             part = slot.prompt[slot.pend_pos:slot.pend_pos + c]
+            # chunk row t attends pend_pos + t + 1 positions — folded
+            # into this tick's KV-read gauge at the next _note_kv_read
+            n_part = int(part.size)
+            self._kv_read_pend += n_part * slot.pend_pos \
+                + n_part * (n_part + 1) // 2
             ids = np.full((1, c), self._pad, np.int32)
             ids[0, :part.size] = part
             ids_dev = self._dev(ids)
@@ -1602,6 +1688,19 @@ class ServingEngine:
         emitted.append((slot.rid, tok))
         if tok == self._eos or slot.max_new <= 1:
             self._retire(i)
+
+    def _note_kv_read(self, positions):
+        """Analytic KV HBM traffic of one tick: ``positions`` cache
+        positions attended x bytes per position (the quantization win
+        shows up here directly — int8 halves the multiplier). Folds in
+        (and drains) the chunk-prefill positions the legacy path
+        accumulated earlier in the same tick (``_kv_read_pend``) — on
+        the ragged path prefill rows ride the one launch and are
+        already counted."""
+        b = int((positions + self._kv_read_pend) * self._kv_pos_bytes)
+        self._kv_read_pend = 0
+        self._kv_step_bytes_last = b
+        self._m_kv_step.set(b)
 
     def _sync_cache_metrics(self):
         """Mirror allocator-side eviction counts into the monitor

@@ -256,19 +256,20 @@ class DeepseekMoeForCausalLM(Layer, GenerationMixin):
         ]
 
     def init_paged_caches(self, num_blocks: int, block_size: int,
-                          sharding=None):
+                          sharding=None, kv_cache_dtype=None):
         """Zeroed per-layer paged (k_pool, v_pool) — the shared serving
         cache layout (see ``ops/paged_cache.py``), identical protocol
-        to Llama/Qwen2-MoE."""
+        to Llama/Qwen2-MoE. ``kv_cache_dtype="int8"``: quantized
+        ``QuantKV`` pools."""
         from ..ops.paged_cache import init_pool
         import jax.numpy as jnp
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dtype = jnp.dtype(getattr(cfg, "dtype", "float32")) \
+            if kv_cache_dtype is None else kv_cache_dtype
         return [
             init_pool(num_blocks, block_size, cfg.num_key_value_heads,
-                      head_dim, jnp.dtype(getattr(cfg, "dtype",
-                                                  "float32")),
-                      sharding=sharding)
+                      head_dim, dtype, sharding=sharding)
             for _ in range(cfg.num_hidden_layers)
         ]
 

@@ -267,16 +267,19 @@ class GPTForCausalLM(Layer, GenerationMixin):
         ]
 
     def init_paged_caches(self, num_blocks: int, block_size: int,
-                          sharding=None):
+                          sharding=None, kv_cache_dtype=None):
         """Per-layer paged (k_pool, v_pool) for serving (MHA: kv head
         count equals the query head count). ``sharding``: the
-        tensor-parallel kv_head split (``pool_sharding(mesh)``)."""
+        tensor-parallel kv_head split (``pool_sharding(mesh)``);
+        ``kv_cache_dtype="int8"``: quantized ``QuantKV`` pools."""
         from ..ops.paged_cache import init_pool
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dtype = jnp.float32 if kv_cache_dtype is None \
+            else kv_cache_dtype
         return [
             init_pool(num_blocks, block_size, cfg.num_attention_heads,
-                      head_dim, jnp.float32, sharding=sharding)
+                      head_dim, dtype, sharding=sharding)
             for _ in range(cfg.num_hidden_layers)
         ]
 

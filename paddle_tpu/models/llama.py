@@ -625,19 +625,24 @@ class LlamaForCausalLM(Layer, GenerationMixin):
         ]
 
     def init_paged_caches(self, num_blocks: int, block_size: int,
-                          sharding=None):
+                          sharding=None, kv_cache_dtype=None):
         """Zeroed per-layer paged (k_pool, v_pool), each
         [num_blocks, block_size, H_kv, D] — the shared serving cache
         (block 0 is the null block; see ``ops/paged_cache.py``).
         ``sharding``: tensor-parallel pool placement (normally
         ``ops.paged_cache.pool_sharding(mesh)`` — the kv_head split),
-        so each shard materializes only its slice."""
+        so each shard materializes only its slice. ``kv_cache_dtype``:
+        ``"int8"`` builds quantized ``QuantKV`` pools (int8 data +
+        per-(block, position, head) absmax scales); None keeps the
+        model dtype — bit-for-bit the pre-quantization layout."""
         from ..ops.paged_cache import init_pool
         cfg = self.config
         head_dim = cfg.hidden_size // cfg.num_attention_heads
+        dtype = jnp.dtype(cfg.dtype) if kv_cache_dtype is None \
+            else kv_cache_dtype
         return [
             init_pool(num_blocks, block_size, cfg.num_key_value_heads,
-                      head_dim, jnp.dtype(cfg.dtype), sharding=sharding)
+                      head_dim, dtype, sharding=sharding)
             for _ in range(cfg.num_hidden_layers)
         ]
 

@@ -544,8 +544,12 @@ def binomial(count, prob, name=None):
     key = _random.next_key()
 
     def f(n, p):
+        # under x64, jax's _btrs sampler mixes f64 internal constants
+        # with the operand dtype and lax.clamp rejects f32 operands —
+        # widen to the mode's default float so the dtypes agree
+        ft = jnp.float64 if _jax.config.jax_enable_x64 else jnp.float32
         return _jax.random.binomial(
-            key, n.astype(jnp.float32), p.astype(jnp.float32)
+            key, n.astype(ft), p.astype(ft)
         ).astype(jnp.int64)
     from ._dispatch import nodiff
     return nodiff(f, count, prob)

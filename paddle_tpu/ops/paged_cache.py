@@ -32,13 +32,32 @@ prefills the suffix; a shared block that must be appended into is
 copy-on-write duplicated (``copy_blocks`` — one device block copy).
 The ragged decode attention that READS this layout is
 ``ops/pallas/paged_attention.py``.
+
+**Quantized pools** (``kv_cache_dtype="int8"`` /
+``PADDLE_TPU_KV_INT8=1``): steady-state decode is HBM-bandwidth-bound
+on KV reads, and the fp pool is the hard ceiling on concurrent slots.
+Each pool half becomes a :class:`QuantKV` — an int8 data pool
+``[NB, BS, H_kv, D]`` plus a per-(block, position, head) f32 absmax
+scale pool ``[NB, BS, H_kv]`` — halving the bytes every
+paged-attention step streams and roughly doubling block capacity at a
+fixed byte budget. Every write path quantizes on store through ONE
+shared scatter helper (``_store``), so the stored bytes are a pure
+function of the written rows: prefix-cached blocks hold bitwise the
+int8 the cold path would recompute, COW copies data+scales together,
+and the Pallas kernels / XLA fallbacks dequantize with identical math
+(block load -> f32 * scale -> activation dtype). Scale granularity is
+per TOKEN per head — not per block — because the write paths are
+position scatters: a block-wide absmax would need a read-modify-write
+requantization of the whole block on every appended token.
 """
 from __future__ import annotations
 
 import functools
 import hashlib
+import os
 from collections import OrderedDict
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,7 +65,9 @@ __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "write_prefill", "write_decode", "write_tokens",
            "write_rows", "gather_dense", "chain_hashes",
            "iter_chain_hashes", "copy_blocks", "pool_sharding",
-           "pool_head_slice", "ragged_row_meta"]
+           "pool_head_slice", "ragged_row_meta", "QuantKV",
+           "kv_quantize", "kv_dequantize", "resolve_kv_cache_dtype",
+           "pool_bytes", "scale_sharding"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -56,6 +77,102 @@ NULL_BLOCK = 0
 def blocks_for(n_tokens: int, block_size: int) -> int:
     """Blocks needed to hold ``n_tokens`` cache positions."""
     return -(-int(n_tokens) // int(block_size))
+
+
+class QuantKV:
+    """One half (K or V) of an int8-quantized block pool: ``data`` int8
+    ``[NB, BS, H_kv, D]`` + ``scale`` f32 ``[NB, BS, H_kv]`` (symmetric
+    per-(block, position, head) absmax / 127). Registered as a jax
+    pytree, so it rides everywhere a plain pool array rides — jit
+    arguments, donation, shard_map specs, the models' cache tuples —
+    and every op in this module (and the paged-attention kernels)
+    branches on it explicitly. ``shape``/``dtype``/``nbytes`` mirror
+    the data pool so host-side shape logic and byte accounting keep
+    working unchanged."""
+
+    _is_kv_quant_pool = True          # duck-typed marker (framework)
+    __slots__ = ("data", "scale")
+
+    def __init__(self, data, scale):
+        self.data = data
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    @property
+    def nbytes(self):
+        return int(self.data.nbytes) + int(self.scale.nbytes)
+
+    def __repr__(self):             # pragma: no cover - debugging aid
+        return (f"QuantKV(data={self.data.shape} int8, "
+                f"scale={self.scale.shape})")
+
+
+jax.tree_util.register_pytree_node(
+    QuantKV,
+    lambda p: ((p.data, p.scale), None),
+    lambda _, children: QuantKV(*children))
+
+
+def kv_quantize(x):
+    """Symmetric per-(row, head) absmax int8 quantization of K/V rows:
+    ``x [..., D]`` -> ``(int8 [..., D], f32 scale [...])`` with
+    ``scale = absmax / 127`` over the head_dim. All-zero rows store
+    scale 0 (dequant gives exact zeros — the null block and untouched
+    pool positions stay zero)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax * np.float32(1.0 / 127.0)
+    safe = jnp.where(scale > 0, scale, np.float32(1.0))
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -127.0, 127.0)
+    return q.astype(jnp.int8), scale
+
+
+def kv_dequantize(data, scale, dtype=jnp.float32):
+    """Inverse of ``kv_quantize``: ``int8 [..., D] * f32 scale [...]``
+    -> ``dtype [..., D]``. The kernels and the gather fallback use the
+    SAME recipe (int8 -> f32 multiply -> cast), so both read identical
+    values from identical stored bytes."""
+    return (data.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def resolve_kv_cache_dtype(requested=None):
+    """Resolve the KV-pool quantization request to ``"int8"`` or
+    ``None`` (pool in the model dtype — the pre-quantization layout,
+    bit-for-bit). ``requested`` is the config value
+    (``ServingConfig.kv_cache_dtype`` / ``generate(kv_cache_dtype=)``);
+    the env twin ``PADDLE_TPU_KV_INT8`` composes the repo's usual way:
+    ``0`` is the kill switch (beats an explicit ``"int8"`` — rollback
+    is one env var, test-pinned bit parity), ``1`` turns int8 on when
+    the config leaves the choice open (``None``/``"auto"``)."""
+    env = os.environ.get("PADDLE_TPU_KV_INT8")
+    if env == "0":
+        return None
+    if requested is None or requested == "auto":
+        return "int8" if env == "1" else None
+    r = str(requested).lower()
+    if r == "int8":
+        return "int8"
+    raise ValueError(
+        f"kv_cache_dtype {requested!r}; supported: None/'auto' (pool "
+        "in the model dtype) or 'int8' (quantized pool; env twin "
+        "PADDLE_TPU_KV_INT8=1/0)")
+
+
+def pool_bytes(pools) -> int:
+    """Total bytes of a per-layer ``[(k, v), ...]`` pool list — int8
+    pools count data AND scales (telemetry/bench accounting)."""
+    return sum(int(kp.nbytes) + int(vp.nbytes) for kp, vp in pools)
 
 
 class BlockAllocator:
@@ -248,11 +365,31 @@ def init_pool(num_blocks: int, block_size: int, num_kv_heads: int,
               head_dim: int, dtype, sharding=None) -> tuple:
     """Zeroed (k_pool, v_pool), each [num_blocks, block_size, H_kv, D].
 
+    ``dtype="int8"`` (or ``jnp.int8``) builds QUANTIZED halves: each is
+    a :class:`QuantKV` of an int8 data pool plus the f32 scale pool
+    ``[NB, BS, H_kv]`` — ~0.53x the bytes of the bf16 pool at D=64
+    (0.5x data + 4/D scale overhead), the serving capacity/bandwidth
+    win. Zero-filled scales dequantize to exact zeros.
+
     ``sharding`` (tensor-parallel serving): a ``jax.sharding.Sharding``
     — normally ``pool_sharding(mesh)``, the kv_heads split — the pool
     is created under, so each shard materializes only its contiguous
-    kv_head slice and no resharding transfer ever happens."""
+    kv_head slice and no resharding transfer ever happens. A quantized
+    pool's scale half shards on the SAME kv_head cut
+    (``scale_sharding``)."""
     shape = (num_blocks, block_size, num_kv_heads, head_dim)
+    quant = dtype == "int8" or jnp.dtype(dtype) == jnp.int8
+    if quant:
+        sshape = shape[:3]
+        if sharding is not None:
+            mk = _sharded_zeros(shape, jnp.dtype(jnp.int8), sharding)
+            mks = _sharded_zeros(sshape, jnp.dtype(jnp.float32),
+                                 scale_sharding(sharding))
+            return (QuantKV(mk(), mks()), QuantKV(mk(), mks()))
+        return (QuantKV(jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(sshape, jnp.float32)),
+                QuantKV(jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(sshape, jnp.float32)))
     if sharding is not None:
         # compile the zeros INTO the sharding: each device writes only
         # its own slice, so a pool sized near per-chip HBM x tp never
@@ -283,6 +420,15 @@ def pool_sharding(mesh):
     return NamedSharding(mesh, PartitionSpec(None, None, "mp", None))
 
 
+def scale_sharding(data_sharding):
+    """Scale-pool twin of ``pool_sharding``: the ``[NB, BS, H_kv]``
+    scale pool splits on the SAME kv_head cut as its int8 data pool
+    (drop the trailing head_dim entry of the data spec)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    spec = tuple(data_sharding.spec) + (None,) * 3
+    return NamedSharding(data_sharding.mesh, PartitionSpec(*spec[:3]))
+
+
 def pool_head_slice(pool, shard: int, tp: int):
     """The contiguous kv_head slice shard ``shard`` of ``tp`` owns —
     the per-shard view the TP attention computes on (tests/debugging;
@@ -291,7 +437,26 @@ def pool_head_slice(pool, shard: int, tp: int):
     if hkv % tp:
         raise ValueError(f"kv_heads={hkv} not divisible by tp={tp}")
     per = hkv // tp
+    if isinstance(pool, QuantKV):
+        return QuantKV(
+            pool.data[:, :, shard * per:(shard + 1) * per, :],
+            pool.scale[:, :, shard * per:(shard + 1) * per])
     return pool[:, :, shard * per:(shard + 1) * per, :]
+
+
+def _store(pool, bi, off, rows):
+    """THE scatter-on-store every write path funnels through
+    (``write_prefill`` / ``write_decode`` / ``write_tokens`` /
+    ``write_rows``, K and V sides): fp pools store the rows cast to the
+    pool dtype; int8 pools quantize on store, landing data and
+    per-(position, head) scales at the SAME ``[bi, off]`` indices — so
+    null-routing/masking decided upstream covers both halves, and the
+    int8 path is written exactly once."""
+    if isinstance(pool, QuantKV):
+        q, s = kv_quantize(rows)
+        return QuantKV(pool.data.at[bi, off].set(q),
+                       pool.scale.at[bi, off].set(s))
+    return pool.at[bi, off].set(rows.astype(pool.dtype))
 
 
 def write_prefill(k_pool, v_pool, block_tables, k_new, v_new,
@@ -313,9 +478,7 @@ def write_prefill(k_pool, v_pool, block_tables, k_new, v_new,
             jnp.asarray(n_real, jnp.int32), (-1, 1))
         bi = jnp.where(valid, bi, NULL_BLOCK)
     off = jnp.broadcast_to(pos % bs, (b, p))                 # [B, P]
-    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
-    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
-    return k_pool, v_pool
+    return _store(k_pool, bi, off, k_new), _store(v_pool, bi, off, v_new)
 
 
 def write_decode(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
@@ -338,9 +501,7 @@ def write_decode(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
                              axis=1)[:, 0]                         # [S]
     bi = jnp.where(blk < mb, bi, NULL_BLOCK)
     off = lens % bs
-    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
-    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
-    return k_pool, v_pool
+    return _store(k_pool, bi, off, k_new), _store(v_pool, bi, off, v_new)
 
 
 def write_tokens(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
@@ -370,9 +531,7 @@ def write_tokens(k_pool, v_pool, block_tables, cache_lens, k_new, v_new):
                              jnp.minimum(blk, mb - 1), axis=1)  # [S, T]
     bi = jnp.where(blk < mb, bi, NULL_BLOCK)
     off = pos % bs
-    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
-    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
-    return k_pool, v_pool
+    return _store(k_pool, bi, off, k_new), _store(v_pool, bi, off, v_new)
 
 
 def write_rows(k_pool, v_pool, block_tables, row_slot, row_pos,
@@ -395,9 +554,7 @@ def write_rows(k_pool, v_pool, block_tables, row_slot, row_pos,
     bi = block_tables.astype(jnp.int32)[slot, jnp.minimum(blk, mb - 1)]
     bi = jnp.where((pos >= 0) & (blk < mb), bi, NULL_BLOCK)   # [R]
     off = pos % bs
-    k_pool = k_pool.at[bi, off].set(k_new.astype(k_pool.dtype))
-    v_pool = v_pool.at[bi, off].set(v_new.astype(v_pool.dtype))
-    return k_pool, v_pool
+    return _store(k_pool, bi, off, k_new), _store(v_pool, bi, off, v_new)
 
 
 def ragged_row_meta(q_lens, base_lens, total_rows, overflow_pos):
@@ -440,18 +597,30 @@ def copy_blocks(pools, src, dst):
     traced int32 scalars, so ONE jitted executable (donate the pools)
     serves every COW — the cost is a single block's K/V bytes per
     layer, no host roundtrip. The caller then swaps ``dst`` into the
-    slot's block table and drops its reference on ``src``."""
-    out = []
-    for kp, vp in pools:
-        out.append((kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src])))
-    return out
+    slot's block table and drops its reference on ``src``. Quantized
+    pools copy data AND scales (a COW'd block must dequantize to the
+    identical values its source holds)."""
+    def cp(pool):
+        if isinstance(pool, QuantKV):
+            return QuantKV(pool.data.at[dst].set(pool.data[src]),
+                           pool.scale.at[dst].set(pool.scale[src]))
+        return pool.at[dst].set(pool[src])
+
+    return [(cp(kp), cp(vp)) for kp, vp in pools]
 
 
 def gather_dense(pool, block_tables):
     """[S, MB*BS, H_kv, D] dense view of each slot's cache (positions
     beyond the slot's length read whatever the pooled blocks hold — the
     caller masks by length). The jnp fallback attention and tests use
-    this; the TPU kernel never materializes it."""
+    this; the TPU kernel never materializes it. Quantized pools come
+    back DEQUANTIZED to f32, and the fallbacks keep that f32 through
+    their dots — the kernels' in-VMEM dequant recipe,
+    value-for-value."""
     s, mb = block_tables.shape
-    g = pool[block_tables.astype(jnp.int32)]    # [S, MB, BS, H, D]
+    tables = block_tables.astype(jnp.int32)
+    if isinstance(pool, QuantKV):
+        g = kv_dequantize(pool.data[tables], pool.scale[tables])
+    else:
+        g = pool[tables]                        # [S, MB, BS, H, D]
     return g.reshape(s, mb * pool.shape[1], pool.shape[2], pool.shape[3])

@@ -55,6 +55,19 @@ per-slot padded ``[S, W, H, D]`` layout and calls the SAME
 ``_xla_paged_verify`` einsum, so every row is bitwise the per-width
 fallback's output — the serving engine's CPU parity between the
 ragged step and the per-width zoo is exact by construction.
+
+QUANTIZED POOLS (``paged_cache.QuantKV`` — int8 data + per-(block,
+position, head) f32 absmax scales): all three kernel variants take
+the scale pools as two extra block-chased operands and dequantize
+each K/V tile in VMEM right after its DMA (int8 -> f32 * scale, kept
+f32 through the dots — accuracy over MXU rate on a bandwidth-bound
+op), so the HBM stream per decode step halves while the softmax math
+is unchanged. The gather fallbacks read the SAME stored
+bytes through ``paged_cache.gather_dense`` (which applies the
+identical dequant recipe), so fallback-vs-interpret-kernel parity
+holds for int8 pools exactly as for fp pools. Kernel eligibility
+follows the pool dtype's sublane tile: int8 pools need
+``block_size % 32 == 0`` on TPU (use ``block_size=32``).
 """
 from __future__ import annotations
 
@@ -94,15 +107,35 @@ def _interpret() -> bool:
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, block_size,
-                   n_blocks, t_q=1, rep=None):
+def _dequant_tile(k_ref, sc_ref):
+    """In-VMEM dequant of one pooled K/V block tile after its DMA:
+    int8 ``[BS, D]`` x per-(position, head) f32 scale ``[BS]``. The
+    result STAYS f32 through the dots (accuracy over MXU rate on a
+    bandwidth-bound op: re-rounding to bf16 would stack a second
+    ~0.2% grid error on the int8 step and measurably cost greedy
+    token-match) — the same recipe ``paged_cache.kv_dequantize`` runs
+    in the gather fallback, so kernel and fallback read identical
+    values from identical stored bytes."""
+    return (k_ref[0, :, 0, :].astype(jnp.float32)
+            * sc_ref[0, :, 0][:, None])
+
+
+def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_size, n_blocks, t_q=1, rep=None,
+                   quantized=False):
     """Shared body for single-token decode (``t_q=1``) and the
     speculative multi-query verify window (``t_q=gamma+1``): the
     ``t_q * rep`` softmax rows carry a per-row causal bound — row
     ``r`` belongs to window token ``t = r // rep`` and may see cache
     positions ``< lens_ref[s] + t`` (``lens_ref`` counts positions
-    visible to window token 0, that token itself included)."""
+    visible to window token 0, that token itself included).
+    ``quantized`` pools ride two extra per-(position, head) scale
+    operands; each K/V block tile dequantizes in VMEM right after its
+    DMA — the HBM stream stays int8."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -118,8 +151,13 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * block_size < ctx + (t_q - 1))
     def _compute():
         q = q_ref[0, 0]                       # [t_q * rep, D]
-        k = k_ref[0, :, 0, :]                 # [BS, D]
-        v = v_ref[0, :, 0, :]
+        if quantized:
+            q = q.astype(jnp.float32)         # match the f32 dequant
+            k = _dequant_tile(k_ref, ks_ref)
+            v = _dequant_tile(v_ref, vs_ref)
+        else:
+            k = k_ref[0, :, 0, :]             # [BS, D]
+            v = v_ref[0, :, 0, :]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -152,15 +190,20 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, q_ref,
-                   k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                   scale, block_size, n_blocks):
+                   k_ref, v_ref, *rest, scale, block_size, n_blocks,
+                   quantized=False):
     """Ragged mixed-batch body: grid ``(slot, window_row, kv_head,
     block)``. Each live grid row is window token ``t`` of slot ``s``
     (the q/out BlockSpec chased ``row_starts[s] + t`` into the packed
     buffer); its causal bound is the verify variant's ``lens + t``
     (``lens_ref`` counts positions visible to the slot's FIRST window
     token, itself included). Dead rows (``t >= q_lens[s]``) read/write
-    the trailing scratch row and skip all FLOPs."""
+    the trailing scratch row and skip all FLOPs. ``quantized``: same
+    extra scale operands + in-VMEM dequant as ``_decode_kernel``."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, m_scr, l_scr, acc_scr = rest
     s = pl.program_id(0)
     t = pl.program_id(1)
     j = pl.program_id(3)
@@ -175,8 +218,13 @@ def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, q_ref,
     @pl.when((t < qlens_ref[s]) & (j * block_size < ctx))
     def _compute():
         q = q_ref[0, 0]                       # [rep, D]
-        k = k_ref[0, :, 0, :]                 # [BS, D]
-        v = v_ref[0, :, 0, :]
+        if quantized:
+            q = q.astype(jnp.float32)         # match the f32 dequant
+            k = _dequant_tile(k_ref, ks_ref)
+            v = _dequant_tile(v_ref, vs_ref)
+        else:
+            k = k_ref[0, :, 0, :]             # [BS, D]
+            v = v_ref[0, :, 0, :]
         sc = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -209,27 +257,43 @@ try:  # pallas/tpu lowering may be absent on this jax build
 
     from .flash_attention_kernel import _CompilerParams
 
+    def _unpack_pools(k_pool, v_pool):
+        """(k_data, v_data, [k_scale, v_scale] or [], quantized):
+        quantized pools split into the int8 data operands plus the
+        scale operands the kernels dequantize with."""
+        from ..paged_cache import QuantKV
+        if isinstance(k_pool, QuantKV):
+            return (k_pool.data, v_pool.data,
+                    [k_pool.scale, v_pool.scale], True)
+        return k_pool, v_pool, [], False
+
     def pallas_paged_attention(q, k_pool, v_pool, block_tables,
                                context_lens, sm_scale=None,
                                interpret=None):
-        """q: [S, H, D]; pools: [NB, BS, H_kv, D]; block_tables:
+        """q: [S, H, D]; pools: [NB, BS, H_kv, D] (or ``QuantKV`` int8
+        pools — dequantized per block tile in VMEM); block_tables:
         [S, MB] int32; context_lens: [S] int32 (valid positions per
         slot, current token included). Returns [S, H, D]."""
         s, h, d = q.shape
         nb, bs, hkv, _ = k_pool.shape
+        kd, vd, scales, quant = _unpack_pools(k_pool, v_pool)
         mb = block_tables.shape[1]
         rep = h // hkv
         scale = np.float32(sm_scale if sm_scale is not None
                            else 1.0 / math.sqrt(d))
         q4 = q.reshape(s, hkv, rep, d)
         kernel = functools.partial(
-            _decode_kernel, scale=scale, block_size=bs, n_blocks=mb)
+            _decode_kernel, scale=scale, block_size=bs, n_blocks=mb,
+            quantized=quant)
 
         def kv_block(si, g, j, tables, lens):
             # chase the slot's block table; out-of-range grid steps read
             # the null block (tables are null-filled past the slot's
             # allocation) and are predicated off in the kernel
             return (tables[si, j], 0, g, 0)
+
+        def sc_block(si, g, j, tables, lens):
+            return (tables[si, j], 0, g)
 
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
@@ -240,7 +304,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
                              (si, g, 0, 0)),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
-            ],
+            ] + [pl.BlockSpec((1, bs, 1), sc_block)] * len(scales),
             out_specs=pl.BlockSpec((1, 1, rep, d),
                                    lambda si, g, j, tables, lens:
                                    (si, g, 0, 0)),
@@ -259,7 +323,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
                                      "arbitrary")),
             interpret=_interpret() if interpret is None else interpret,
         )(block_tables.astype(jnp.int32),
-          context_lens.astype(jnp.int32), q4, k_pool, v_pool)
+          context_lens.astype(jnp.int32), q4, kd, vd, *scales)
         return out.reshape(s, h, d)
 
     def pallas_paged_verify_attention(q, k_pool, v_pool, block_tables,
@@ -272,6 +336,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
         positions). Returns [S, T, H, D]."""
         s, t, h, d = q.shape
         nb, bs, hkv, _ = k_pool.shape
+        kd, vd, scales, quant = _unpack_pools(k_pool, v_pool)
         mb = block_tables.shape[1]
         rep = h // hkv
         scale = np.float32(sm_scale if sm_scale is not None
@@ -282,10 +347,13 @@ try:  # pallas/tpu lowering may be absent on this jax build
             .reshape(s, hkv, t * rep, d)
         kernel = functools.partial(
             _decode_kernel, scale=scale, block_size=bs, n_blocks=mb,
-            t_q=t, rep=rep)
+            t_q=t, rep=rep, quantized=quant)
 
         def kv_block(si, g, j, tables, lens):
             return (tables[si, j], 0, g, 0)
+
+        def sc_block(si, g, j, tables, lens):
+            return (tables[si, j], 0, g)
 
         rows = t * rep
         grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -297,7 +365,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
                              (si, g, 0, 0)),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
-            ],
+            ] + [pl.BlockSpec((1, bs, 1), sc_block)] * len(scales),
             out_specs=pl.BlockSpec((1, 1, rows, d),
                                    lambda si, g, j, tables, lens:
                                    (si, g, 0, 0)),
@@ -316,7 +384,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
                                      "arbitrary")),
             interpret=_interpret() if interpret is None else interpret,
         )(block_tables.astype(jnp.int32),
-          context_lens.astype(jnp.int32), q4, k_pool, v_pool)
+          context_lens.astype(jnp.int32), q4, kd, vd, *scales)
         return out.reshape(s, hkv, t, rep, d).transpose(0, 2, 1, 3, 4) \
             .reshape(s, t, h, d)
 
@@ -337,6 +405,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
         row)."""
         r, h, d = q.shape
         nb, bs, hkv, _ = k_pool.shape
+        kd, vd, scales, quant = _unpack_pools(k_pool, v_pool)
         s, mb = block_tables.shape
         w = int(w_max)
         rep = h // hkv
@@ -349,7 +418,8 @@ try:  # pallas/tpu lowering may be absent on this jax build
             [q.reshape(r, hkv, rep, d),
              jnp.zeros((1, hkv, rep, d), q.dtype)], axis=0)
         kernel = functools.partial(
-            _ragged_kernel, scale=scale, block_size=bs, n_blocks=mb)
+            _ragged_kernel, scale=scale, block_size=bs, n_blocks=mb,
+            quantized=quant)
 
         def q_map(si, t, g, j, qlens, starts, tables, lens):
             return (jnp.where(t < qlens[si], starts[si] + t, r),
@@ -358,6 +428,9 @@ try:  # pallas/tpu lowering may be absent on this jax build
         def kv_block(si, t, g, j, qlens, starts, tables, lens):
             return (tables[si, j], 0, g, 0)
 
+        def sc_block(si, t, g, j, qlens, starts, tables, lens):
+            return (tables[si, j], 0, g)
+
         grid_spec = pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(s, w, hkv, mb),
@@ -365,7 +438,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
                 pl.BlockSpec((1, 1, rep, d), q_map),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
                 pl.BlockSpec((1, bs, 1, d), kv_block),
-            ],
+            ] + [pl.BlockSpec((1, bs, 1), sc_block)] * len(scales),
             out_specs=pl.BlockSpec((1, 1, rep, d), q_map),
             scratch_shapes=[
                 pltpu.VMEM((rep, 128), jnp.float32),
@@ -387,7 +460,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
             interpret=_interpret() if interpret is None else interpret,
         )(q_lens.astype(jnp.int32), row_starts.astype(jnp.int32),
           block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-          q4, k_pool, v_pool)
+          q4, kd, vd, *scales)
         return out[:r].reshape(r, h, d)
 
     _kernel_import_error = None
@@ -412,20 +485,24 @@ def _xla_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     hkv = k_pool.shape[2]
     rep = h // hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    from ..paged_cache import gather_dense
+    from ..paged_cache import QuantKV, gather_dense
+    # quantized pools: gather_dense dequantizes to f32 and the math
+    # STAYS f32 (no re-round to the activation dtype) — the kernel's
+    # in-VMEM dequant recipe, value for value
+    ad = jnp.float32 if isinstance(k_pool, QuantKV) else q.dtype
     k = gather_dense(k_pool, block_tables)      # [S, L, Hkv, D]
     v = gather_dense(v_pool, block_tables)
     lens = context_lens.astype(jnp.int32)
     q5 = q.reshape(s, hkv, rep, d)
     scores = jnp.einsum(
-        "sgrd,slgd->sgrl", q5, k.astype(q.dtype),
+        "sgrd,slgd->sgrl", q5, k.astype(ad),
         preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(k.shape[1], dtype=jnp.int32)
     bias = jnp.where(pos[None, :] < lens[:, None], 0.0, -1e9)
     scores = scores + bias[:, None, None, :]
-    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("sgrl,slgd->sgrd", w, v.astype(q.dtype))
-    return out.reshape(s, h, d)
+    w = jax.nn.softmax(scores, axis=-1).astype(ad)
+    out = jnp.einsum("sgrl,slgd->sgrd", w, v.astype(ad))
+    return out.astype(q.dtype).reshape(s, h, d)
 
 
 def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
@@ -439,22 +516,25 @@ def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
     hkv = k_pool.shape[2]
     rep = h // hkv
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
-    from ..paged_cache import gather_dense
+    from ..paged_cache import QuantKV, gather_dense
+    # quantized pools: keep the dequantized f32 through the dots (the
+    # kernel's recipe — see _xla_paged_attention)
+    ad = jnp.float32 if isinstance(k_pool, QuantKV) else q.dtype
     k = gather_dense(k_pool, block_tables)      # [S, L, Hkv, D]
     v = gather_dense(v_pool, block_tables)
     lens = context_lens.astype(jnp.int32)
     q6 = q.reshape(s, t, hkv, rep, d)
     scores = jnp.einsum(
-        "stgrd,slgd->sgtrl", q6, k.astype(q.dtype),
+        "stgrd,slgd->sgtrl", q6, k.astype(ad),
         preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(k.shape[1], dtype=jnp.int32)
     bound = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
     bias = jnp.where(pos[None, None, :] < bound[:, :, None],
                      0.0, -1e9)                  # [S, T, L]
     scores = scores + bias[:, None, :, None, :]
-    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("sgtrl,slgd->stgrd", w, v.astype(q.dtype))
-    return out.reshape(s, t, h, d)
+    w = jax.nn.softmax(scores, axis=-1).astype(ad)
+    out = jnp.einsum("sgtrl,slgd->stgrd", w, v.astype(ad))
+    return out.astype(q.dtype).reshape(s, t, h, d)
 
 
 def _xla_ragged_paged(q, k_pool, v_pool, block_tables, context_lens,
@@ -685,6 +765,19 @@ def ragged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
     return out, kp2, vp2
 
 
+def _pool_pspec(pool):
+    """shard_map PartitionSpec tree for one pool half: the kv_head cut
+    on the data (``[NB, BS, H_kv, D]``); a quantized pool's scale half
+    (``[NB, BS, H_kv]``) rides the SAME cut — the spec mirrors the
+    ``QuantKV`` pytree structure so shard_map matches it leaf-wise."""
+    import jax.sharding as _js
+    from ..paged_cache import QuantKV
+    P = _js.PartitionSpec
+    if isinstance(pool, QuantKV):
+        return QuantKV(P(None, None, "mp", None), P(None, None, "mp"))
+    return P(None, None, "mp", None)
+
+
 def sharded_ragged_attention_step(qh, kh, vh, k_pool, v_pool,
                                   block_tables, cache_lens, q_lens,
                                   row_starts, row_slot, row_pos,
@@ -693,16 +786,16 @@ def sharded_ragged_attention_step(qh, kh, vh, k_pool, v_pool,
     """Tensor-parallel ``ragged_attention_step``: the same write+attend
     body inside ``shard_map`` over the mesh's ``mp`` axis — q/k/v
     ``[R, H, D]`` and the pools split on their head dim (each shard a
-    contiguous kv_head group, exactly the per-width wrapper's cut),
-    block tables, lengths and ALL row metadata replicated. No
-    collective inside; the step's only cross-shard traffic stays the
-    engine's logits gather."""
+    contiguous kv_head group, exactly the per-width wrapper's cut;
+    int8 pools' scale halves ride the same cut), block tables, lengths
+    and ALL row metadata replicated. No collective inside; the step's
+    only cross-shard traffic stays the engine's logits gather."""
     import jax.sharding as _js
     from ...distributed.shard_utils import current_mesh, shard_map_compat
     P = _js.PartitionSpec
     mesh = current_mesh()
     heads = P(None, "mp", None)           # [R, H, D] head split
-    pool = P(None, None, "mp", None)
+    kspec, vspec = _pool_pspec(k_pool), _pool_pspec(v_pool)
     rows = P(None)
 
     def local(q, k, v, kp, vp, tables, lens, ql, rs, sl, pos, nwin,
@@ -713,9 +806,9 @@ def sharded_ragged_attention_step(qh, kh, vh, k_pool, v_pool,
 
     f = shard_map_compat(
         local, mesh,
-        in_specs=(heads, heads, heads, pool, pool, P(None, None),
+        in_specs=(heads, heads, heads, kspec, vspec, P(None, None),
                   rows, rows, rows, rows, rows, rows, rows),
-        out_specs=(heads, pool, pool))
+        out_specs=(heads, kspec, vspec))
     return f(qh, kh, vh, k_pool, v_pool, block_tables, cache_lens,
              q_lens, row_starts, row_slot, row_pos, narrow_iota,
              win_iota)
@@ -809,7 +902,8 @@ def sharded_paged_attention_step(qh, kh, vh, k_pool, v_pool,
     from ...distributed.shard_utils import current_mesh, shard_map_compat
     P = _js.PartitionSpec
     mesh = current_mesh()
-    heads = P(None, None, "mp", None)     # q/k/v head dim AND pool kv dim
+    heads = P(None, None, "mp", None)     # q/k/v head dim
+    kspec, vspec = _pool_pspec(k_pool), _pool_pspec(v_pool)
 
     def local(q, k, v, kp, vp, tables, lens):
         return paged_attention_step(q, k, v, kp, vp, tables, lens,
@@ -817,9 +911,9 @@ def sharded_paged_attention_step(qh, kh, vh, k_pool, v_pool,
 
     f = shard_map_compat(
         local, mesh,
-        in_specs=(heads, heads, heads, heads, heads,
+        in_specs=(heads, heads, heads, kspec, vspec,
                   P(None, None), P(None)),
-        out_specs=(heads, heads, heads))
+        out_specs=(heads, kspec, vspec))
     return f(qh, kh, vh, k_pool, v_pool, block_tables, cache_lens)
 
 

@@ -40,6 +40,12 @@ import pytest
 # aboard (562 passed; slowest new test 9.1s — the qwen2 ragged-ON/OFF
 # engine pairing, right AT the line but the tier keeps >=57s of
 # headroom), so no new entries.
+# r10 re-sweep (int8 KV quantization): tier-1 measured 598s at the
+# session baseline; the 19 new test_kv_quant.py tests add ~36s
+# (slowest new test 3.7s — engine match-rate on GPT), and the two
+# triaged pre-existing failures now pass (binomial x64 widen, fused
+# MHA non-degenerate loss) with the interleaved-1F1B parity xfailed
+# (tracked in test_pipeline.py). No new entries.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s

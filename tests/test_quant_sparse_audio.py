@@ -359,7 +359,11 @@ def test_fused_mha_gradients_reach_qkv_weight():
         attn_dropout_rate=0.0,
         ln_scale=paddle.to_tensor(np.ones(E, np.float32)),
         ln_bias=paddle.to_tensor(np.zeros(E, np.float32)))
-    out.sum().backward()
+    # squared loss: a plain sum() through the post-LN has an exactly
+    # zero gradient (LN output is mean-centered, so the sum's
+    # derivative cancels analytically) — the strict >0 check below
+    # only ever passed on f32 roundoff noise
+    (out * out).sum().backward()
     for t, name in ((qkv_w, "qkv_weight"), (qkv_b, "qkv_bias"),
                     (lin_w, "linear_weight")):
         assert t.grad is not None, name
