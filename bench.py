@@ -790,6 +790,108 @@ def _kv_quant_bench():
     return out
 
 
+def _goodput_bench():
+    """Goodput under SLO (the ISSUE-11 observability bar): the
+    serving-bench model driven by the closed-loop load harness
+    (``inference/loadgen.py``). A closed-loop capacity probe at full
+    concurrency measures max sustainable QPS; the SLO is calibrated
+    from the probe's own latencies (3x p50 TTFT/TPOT — env overrides
+    ``BENCH_GOODPUT_SLO_TTFT_MS`` / ``BENCH_GOODPUT_SLO_ITL_MS`` for
+    real fleets), and two OPEN-loop arms then offer {0.6, 1.2}x
+    capacity — under and over the knee — reporting goodput (fraction
+    of requests meeting the TTFT+TPOT SLO) and client-side TTFT/ITL
+    p50/p99 vs offered load. The engine's always-on P² digests ride
+    along as ``engine_digests_cumulative`` — the server-side view of
+    the WHOLE session (warmup + capacity probe + both arms), so its
+    tails sit above the 0.6x arm's client-side numbers by
+    construction; compare per-arm latencies against the per-arm
+    client reports, not against this. On CPU the absolute latencies
+    are a structure proxy (``cpu_proxy``); the harness and the
+    goodput-vs-load shape are backend-independent."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.inference.loadgen import SLO, run_load
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_GOODPUT_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_GOODPUT_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_GOODPUT_FFN",
+                                             5632)),
+        num_hidden_layers=int(os.environ.get("BENCH_GOODPUT_LAYERS",
+                                             8)),
+        num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=1024, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_GOODPUT_SLOTS", 8))
+    new = int(os.environ.get("BENCH_GOODPUT_NEW", 32))
+    n_req = int(os.environ.get("BENCH_GOODPUT_REQS", 24))
+    plens = [32, 64, 96, 160, 128, 48]
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (plens[i % len(plens)],))
+               for i in range(n_req)]
+
+    eng = ServingEngine(model, ServingConfig(
+        num_slots=slots, block_size=32, max_model_len=512,
+        max_new_tokens=new))
+    eng.serve([rng.randint(1, cfg.vocab_size, (p,)) for p in plens],
+              max_new_tokens=4)     # warmup: compile the executable
+    # 1) capacity: closed loop at full concurrency (self-throttling,
+    # so this is the max sustainable request rate, not an SLO test)
+    probe = run_load(eng, [p.copy() for p in prompts], mode="closed",
+                     concurrency=slots, max_new_tokens=new)
+    cap_qps = max(probe["achieved_qps"], 1e-3)
+    # 2) SLO from the probe's own p50s (the 3x budget keeps goodput
+    # non-trivial on any backend without hand-tuned absolute numbers)
+    slo = SLO(
+        ttft_ms=float(os.environ.get(
+            "BENCH_GOODPUT_SLO_TTFT_MS",
+            3.0 * max(probe["ttft_p50_ms"], 1.0))),
+        itl_ms=float(os.environ.get(
+            "BENCH_GOODPUT_SLO_ITL_MS",
+            3.0 * max(probe["tpot_p50_ms"], 1.0))))
+    # 3) open-loop arms under and over the capacity knee
+    arms = {}
+    for frac in (0.6, 1.2):
+        rep = run_load(eng, [p.copy() for p in prompts],
+                       qps=round(frac * cap_qps, 3), mode="open",
+                       max_new_tokens=new, slo=slo, seed=1)
+        arms[f"offered_{frac}x"] = rep
+    target = arms["offered_0.6x"]
+    st = eng.stats()
+    eng.shutdown()
+    out = {
+        "capacity_probe": probe,
+        "slo": {"ttft_ms": round(slo.ttft_ms, 3),
+                "itl_ms": round(slo.itl_ms, 3)},
+        **arms,
+        "target_arm": "offered_0.6x",
+        "goodput_at_qps": target["goodput"],
+        "target_qps": target["offered_qps"],
+        "ttft_p99_ms": target["ttft_p99_ms"],
+        "itl_p99_ms": target["itl_p99_ms"],
+        # server-side P² digests over the WHOLE session (warmup +
+        # probe + both arms) — NOT comparable 1:1 with the target
+        # arm's client-side percentiles
+        "engine_digests_cumulative": {k: st[k] for k in
+                                      ("ttft_ms", "itl_ms",
+                                       "queue_wait_ms", "e2e_ms")},
+        "requests_per_arm": n_req, "num_slots": slots,
+        "max_new_tokens": new,
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model, eng
+    gc.collect()
+    return out
+
+
 def _spec_serving_bench():
     """Speculative serving throughput (the ISSUE-4 bar): a mixed-length
     REPETITIVE-text workload (tiled phrases — the prompt-lookup regime:
@@ -1539,6 +1641,10 @@ def main():
     except Exception as exc:
         kv_quant = {"error": repr(exc)}
     try:
+        goodput = _goodput_bench()
+    except Exception as exc:
+        goodput = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -1557,6 +1663,7 @@ def main():
               "serving_tp": serving_tp,
               "serving_ragged": serving_ragged,
               "kv_quant": kv_quant,
+              "goodput": goodput,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -1574,7 +1681,8 @@ def main():
             for k, v in detail.items()
             if k not in ("decode", "serving", "speculative",
                          "serving_prefix", "serving_tp",
-                         "serving_ragged", "kv_quant", "flashmask",
+                         "serving_ragged", "kv_quant", "goodput",
+                         "flashmask",
                          "moe_profile", "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
@@ -1652,8 +1760,25 @@ def main():
              if isinstance(kv_quant, dict) else None,
              "kv_quant_slots_ratio":
              kv_quant.get("slots_ratio")
-             if isinstance(kv_quant, dict) else None},
+             if isinstance(kv_quant, dict) else None,
+             "goodput_at_qps":
+             goodput.get("goodput_at_qps")
+             if isinstance(goodput, dict) else None,
+             "goodput_target_qps":
+             goodput.get("target_qps")
+             if isinstance(goodput, dict) else None,
+             "ttft_p99_ms":
+             goodput.get("ttft_p99_ms")
+             if isinstance(goodput, dict) else None,
+             "itl_p99_ms":
+             goodput.get("itl_p99_ms")
+             if isinstance(goodput, dict) else None},
     }
+    # trajectory contract (ISSUE 11 CI satellite): the goodput SLO
+    # keys must be present in every round's summary — fail loudly if
+    # a refactor drops them instead of silently losing the trend line
+    for k in ("goodput_at_qps", "ttft_p99_ms", "itl_p99_ms"):
+        assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:
         here = os.path.dirname(os.path.abspath(__file__))

@@ -21,6 +21,7 @@ import numpy as np
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "GenerationPredictor", "create_generation_predictor",
            "ServingConfig", "ServingEngine", "ServingRequest",
+           "SLO", "run_load",
            "PrecisionType", "PlaceType", "get_version"]
 
 
@@ -30,6 +31,10 @@ def __getattr__(name):
     if name in ("ServingConfig", "ServingEngine", "ServingRequest"):
         from . import serving
         return getattr(serving, name)
+    if name in ("SLO", "RequestRecord", "run_load", "summarize",
+                "poisson_arrivals", "uniform_arrivals"):
+        from . import loadgen
+        return getattr(loadgen, name)
     raise AttributeError(name)
 
 

@@ -1,0 +1,213 @@
+"""Closed-loop SLO / goodput load generator for ``ServingEngine``.
+
+The harness every serving feature proves itself against (ROADMAP "an
+async serving front door ... closed-loop load-generator measuring
+goodput under SLO"): drive an engine under a TIMED arrival process and
+measure what a client would see —
+
+- **open loop** (the default): requests arrive on a fixed schedule at a
+  target QPS (seeded-Poisson or uniform gaps) whether or not the engine
+  keeps up — the regime where queueing delay and tail latency actually
+  appear (a closed loop self-throttles and can never overload the
+  engine, which is exactly what hides SLO violations).
+- **closed loop**: a fixed number of in-flight requests, each replaced
+  on completion — measures capacity (max sustainable throughput), used
+  here to calibrate the open-loop offered load.
+
+Per-request metrics are CLIENT-side (wall-clock around ``submit()`` and
+the streaming callback): TTFT = submit -> first streamed token, ITL =
+gaps between consecutive streamed tokens, TPOT = mean ITL, e2e =
+submit -> last token. A request **meets SLO** when ``ttft <=
+slo.ttft_ms`` AND ``tpot <= slo.itl_ms``; **goodput** is the fraction
+of SUBMITTED requests meeting SLO — a request that never completes
+counts against it (the throughput the fleet can charge for, vs the
+tok/s it merely emits). The engine's own always-on P²
+digests measure the same quantities server-side; the two agree to
+within digest error plus callback overhead.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["SLO", "RequestRecord", "poisson_arrivals",
+           "uniform_arrivals", "run_load", "summarize"]
+
+
+@dataclass
+class SLO:
+    """Per-request latency budget: a request is 'good' when its TTFT
+    and its mean inter-token latency (TPOT) both fit."""
+    ttft_ms: float = 1000.0
+    itl_ms: float = 200.0
+
+
+@dataclass
+class RequestRecord:
+    """Client-side timeline of one request (monotonic seconds)."""
+    rid: int
+    arrival_s: float                    # scheduled arrival offset
+    submit_t: float                     # actual submit() wall time
+    token_t: List[float] = field(default_factory=list)
+
+    @property
+    def completed(self) -> bool:
+        return bool(self.token_t)
+
+    @property
+    def ttft_ms(self) -> float:
+        return 1000.0 * (self.token_t[0] - self.submit_t)
+
+    @property
+    def itl_ms(self) -> List[float]:
+        return [1000.0 * (b - a)
+                for a, b in zip(self.token_t, self.token_t[1:])]
+
+    @property
+    def tpot_ms(self) -> float:
+        """Mean time-per-output-token after the first."""
+        gaps = self.itl_ms
+        return float(np.mean(gaps)) if gaps else 0.0
+
+    @property
+    def e2e_ms(self) -> float:
+        return 1000.0 * (self.token_t[-1] - self.submit_t)
+
+    def meets(self, slo: SLO) -> bool:
+        return self.completed and self.ttft_ms <= slo.ttft_ms \
+            and self.tpot_ms <= slo.itl_ms
+
+
+def poisson_arrivals(n: int, qps: float, seed: int = 0) -> np.ndarray:
+    """Open-loop Poisson arrival offsets (seconds from start): i.i.d.
+    exponential gaps at rate ``qps`` — the memoryless process real
+    front-door traffic approximates."""
+    rng = np.random.RandomState(seed)
+    return np.cumsum(rng.exponential(1.0 / float(qps), size=n))
+
+
+def uniform_arrivals(n: int, qps: float) -> np.ndarray:
+    """Deterministic fixed-gap arrivals at ``qps`` (no burstiness —
+    the lower bound on queueing delay at a given offered load)."""
+    return (1.0 + np.arange(n)) / float(qps)
+
+
+def run_load(engine, prompts: Sequence[np.ndarray], *,
+             qps: Optional[float] = None, mode: str = "open",
+             concurrency: Optional[int] = None,
+             max_new_tokens: Optional[int] = None,
+             slo: Optional[SLO] = None, arrival: str = "poisson",
+             seed: int = 0) -> dict:
+    """Serve ``prompts`` through ``engine`` under a timed arrival
+    process and return the goodput report (:func:`summarize`).
+
+    ``mode="open"`` (requires ``qps``): requests are submitted when
+    their scheduled arrival time passes, independent of engine
+    progress. ``mode="closed"`` (``concurrency``, default
+    ``num_slots``): a fixed number in flight, each completion admits
+    the next — reported ``achieved_qps`` is then the engine's capacity
+    at that concurrency.
+
+    The engine's ``stream_callback`` is chained, not replaced: an
+    application callback installed at construction still fires.
+    """
+    if mode not in ("open", "closed"):
+        raise ValueError(f"mode must be open|closed, got {mode!r}")
+    if mode == "open" and not qps:
+        raise ValueError("open-loop mode needs a target qps")
+    slo = slo or SLO()
+    n = len(prompts)
+    records: Dict[int, RequestRecord] = {}
+
+    prev_cb = engine._stream
+
+    def _record(rid, tok):
+        rec = records.get(rid)
+        if rec is not None:
+            rec.token_t.append(time.monotonic())
+        if prev_cb is not None:
+            prev_cb(rid, tok)
+
+    if mode == "open":
+        offsets = poisson_arrivals(n, qps, seed) \
+            if arrival == "poisson" else uniform_arrivals(n, qps)
+    else:
+        offsets = np.zeros(n)
+        concurrency = int(concurrency
+                          or engine.config.num_slots)
+
+    engine._stream = _record
+    t_start = time.monotonic()
+    try:
+        idx = 0
+        while idx < n or engine.num_queued or engine.num_active:
+            now = time.monotonic() - t_start
+            if mode == "open":
+                while idx < n and offsets[idx] <= now:
+                    rid = engine.submit(prompts[idx], max_new_tokens)
+                    records[rid] = RequestRecord(
+                        rid, float(offsets[idx]), time.monotonic())
+                    idx += 1
+            else:
+                while idx < n and (engine.num_queued
+                                   + engine.num_active) < concurrency:
+                    rid = engine.submit(prompts[idx], max_new_tokens)
+                    records[rid] = RequestRecord(
+                        rid, now, time.monotonic())
+                    idx += 1
+            if engine.num_queued or engine.num_active:
+                engine.step()
+            elif idx < n:
+                # idle until the next scheduled arrival (open loop)
+                time.sleep(min(max(offsets[idx] - (
+                    time.monotonic() - t_start), 0.0), 0.01))
+        wall = time.monotonic() - t_start
+    finally:
+        engine._stream = prev_cb
+
+    offered = float(qps) if mode == "open" else \
+        (n / wall if wall > 0 else 0.0)
+    return summarize(list(records.values()), slo, wall,
+                     offered_qps=offered, mode=mode)
+
+
+def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,
+              offered_qps: Optional[float] = None,
+              mode: str = "open") -> dict:
+    """Aggregate client-side records into the goodput report."""
+    done = [r for r in records if r.completed]
+    ttfts = np.asarray([r.ttft_ms for r in done]) \
+        if done else np.zeros(0)
+    itls = np.asarray([g for r in done for g in r.itl_ms])
+    tpots = np.asarray([r.tpot_ms for r in done]) \
+        if done else np.zeros(0)
+    e2es = np.asarray([r.e2e_ms for r in done]) \
+        if done else np.zeros(0)
+    n_tokens = sum(len(r.token_t) for r in done)
+
+    def pct(arr, q):
+        return round(float(np.percentile(arr, q)), 3) if arr.size \
+            else 0.0
+
+    good = sum(r.meets(slo) for r in done)
+    return {
+        "mode": mode,
+        "requests": len(records),
+        "completed": len(done),
+        "goodput": round(good / len(records), 4) if records else 0.0,
+        "slo": {"ttft_ms": slo.ttft_ms, "itl_ms": slo.itl_ms},
+        "offered_qps": None if offered_qps is None
+        else round(offered_qps, 3),
+        "achieved_qps": round(len(done) / wall_s, 3)
+        if wall_s > 0 else 0.0,
+        "tokens_per_sec": round(n_tokens / wall_s, 1)
+        if wall_s > 0 else 0.0,
+        "ttft_p50_ms": pct(ttfts, 50), "ttft_p99_ms": pct(ttfts, 99),
+        "itl_p50_ms": pct(itls, 50), "itl_p99_ms": pct(itls, 99),
+        "tpot_p50_ms": pct(tpots, 50), "tpot_p99_ms": pct(tpots, 99),
+        "e2e_p50_ms": pct(e2es, 50), "e2e_p99_ms": pct(e2es, 99),
+        "wall_s": round(wall_s, 3),
+    }

@@ -136,17 +136,36 @@ impossible by construction (no preemption path needed).
 
 Telemetry (monitor registry, exported in the JSONL dump):
 ``serving_slot_occupancy`` gauge, ``serving_batch_utilization`` /
-``serving_queue_wait_ms`` histograms, ``serving_tokens_total`` /
+``serving_queue_wait_ms`` histograms (the latter labeled by terminal
+outcome: admitted | cancelled | rejected | shutdown, so pre-admission
+exits leave a record too), ``serving_tokens_total`` /
 ``serving_decode_steps`` / ``serving_decode_compiles`` /
 ``serving_prefill_compiles`` / ``serving_requests_completed`` /
 ``serving_prefix_blocks_reused`` / ``serving_prefix_tokens_reused`` /
 ``serving_cow_copies`` / ``serving_cache_evictions`` counters and the
 ``serving_prefix_hit_rate`` gauge.
+
+Request-lifecycle tracing + SLO digests (docs/OPS.md "Request tracing
+& SLO goodput"): every engine owns a span tracer
+(``monitor/tracing.py`` — one trace-viewer pid per engine, tid 0 the
+engine tick timeline, tid 1+i slot i, last tid the admission queue)
+recording ``submit -> queued -> admit (prefix-hit annotated) ->
+prefill chunk[i] -> decode/verify tick (rows, accepted_len, exec id)
+-> retired`` plus per-tick engine spans (occupancy, kernel-fallback
+count) on all three step paths; ``engine.dump_trace(path)`` writes
+Perfetto-loadable Chrome trace JSON. Kill switch ``PADDLE_TPU_TRACE=0``
+(bit-for-bit inert: tracing is host-side only). Independent of that
+switch, four always-on P² latency digests power ``stats()``'s
+``ttft_ms`` / ``itl_ms`` / ``queue_wait_ms`` / ``e2e_ms`` summaries and
+the ``serving_ttft_ms`` / ``serving_itl_ms`` /
+``serving_queue_wait_quantile_ms`` / ``serving_e2e_ms`` p50/p95/p99
+gauges.
 """
 from __future__ import annotations
 
 import contextlib
 import hashlib
+import itertools
 import os
 import time
 import warnings
@@ -161,10 +180,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import monitor
 from ..distributed import moe as _moe
+from ..monitor import tracing as _tracing
+from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
 __all__ = ["ServingConfig", "ServingRequest", "ServingEngine"]
+
+# trace-viewer pid per engine (and the stats() engine_id)
+_ENGINE_IDS = itertools.count()
 
 
 @contextlib.contextmanager
@@ -292,10 +316,11 @@ class ServingRequest:
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
                  "last_token", "n_emitted", "max_new", "history",
-                 "prompt", "pend_pos", "pend_row")
+                 "prompt", "pend_pos", "pend_row", "admit_t")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
                  max_new, history=None, prompt=None, pend_pos=None):
+        self.admit_t = time.monotonic()   # request-span start (trace)
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -565,7 +590,12 @@ class ServingEngine:
             "active slots / num_slots per decode step",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
         self._m_queue_wait = monitor.histogram(
-            "serving_queue_wait_ms", "submit -> admission wait")
+            "serving_queue_wait_ms",
+            "submit -> queue-exit wait, labeled by outcome (admitted |"
+            " cancelled | rejected | shutdown) — EVERY exit path "
+            "observes, so the distribution can't survivor-bias toward "
+            "admitted requests",
+            labels=("outcome",))
         self._m_tokens = monitor.counter(
             "serving_tokens_total", "tokens generated (all requests)")
         self._m_steps = monitor.counter(
@@ -662,6 +692,53 @@ class ServingEngine:
         self._moe_ent_last = 0.0
         self._moe_load_max_last = 0.0
         self._n_moe_dispatches = 0
+        # -- request-lifecycle tracing + SLO latency digests ----------
+        # One Tracer per engine (one trace-viewer pid): tid 0 is the
+        # engine tick timeline, tid 1+i slot i's request timeline, the
+        # last tid the admission queue. PADDLE_TPU_TRACE=0 leaves
+        # self._trace None and every call site skips — the killed hot
+        # path runs zero tracer instructions (tracing is host-only
+        # code either way, so executables and outputs are identical).
+        self._engine_id = next(_ENGINE_IDS)
+        self._tid_queue = cfg.num_slots + 1
+        self._trace = None
+        if _tracing.tracing_enabled():
+            tr = _tracing.Tracer(f"ServingEngine[{self._engine_id}]")
+            tr.set_thread(0, "engine")
+            for i in range(cfg.num_slots):
+                tr.set_thread(1 + i, f"slot {i}")
+            tr.set_thread(self._tid_queue, "queue")
+            self._trace = tr
+        # always-on per-engine SLO digests (P², bounded memory) —
+        # independent of the trace kill switch; surfaced as stats()
+        # keys, the serving_*_ms quantile gauges, and the JSONL/prom
+        # exports those gauges ride
+        self._d_ttft = LatencyDigest()
+        self._d_itl = LatencyDigest()
+        self._d_queue = LatencyDigest()
+        self._d_e2e = LatencyDigest()
+        self._submit_t = {}     # rid -> submit monotonic (live reqs)
+        self._last_emit = {}    # rid -> last token-emit monotonic
+        self._m_lat = {
+            "ttft": monitor.gauge(
+                "serving_ttft_ms",
+                "time-to-first-token quantiles (P2 digest; submit -> "
+                "first streamed token)", labels=("q",)),
+            "itl": monitor.gauge(
+                "serving_itl_ms",
+                "inter-token latency quantiles (P2 digest; gap "
+                "between consecutive streamed tokens of one request)",
+                labels=("q",)),
+            "queue_wait": monitor.gauge(
+                "serving_queue_wait_quantile_ms",
+                "queue-wait quantiles (P2 digest; terminal "
+                "cancelled/rejected/shutdown outcomes included)",
+                labels=("q",)),
+            "e2e": monitor.gauge(
+                "serving_e2e_ms",
+                "submit -> retirement latency quantiles (P2 digest)",
+                labels=("q",)),
+        }
         if gamma:
             self._m_spec_len = monitor.histogram(
                 "serving_spec_accepted_len",
@@ -680,29 +757,96 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens=None) -> int:
         """Queue one request; returns its request id. Tokens stream to
-        ``stream_callback`` as ``step()``/``run()`` produce them."""
-        ids = np.asarray(prompt, np.int32).reshape(-1)
-        if ids.size == 0:
-            raise ValueError("empty prompt")
-        max_new = int(self.config.max_new_tokens
-                      if max_new_tokens is None else max_new_tokens)
-        if max_new < 1:
-            raise ValueError(f"max_new_tokens must be >= 1, "
-                             f"got {max_new}")
-        if ids.size + max_new > self.config.max_model_len:
-            raise ValueError(
-                f"prompt ({ids.size}) + max_new_tokens ({max_new}) "
-                f"exceeds max_model_len ({self.config.max_model_len})")
-        worst = _pc.blocks_for(ids.size + max_new + self._gamma,
-                               self._bs)
-        if worst > self._alloc.num_blocks - 1:
-            raise ValueError(
-                f"request needs {worst} blocks; pool has only "
-                f"{self._alloc.num_blocks - 1}")
+        ``stream_callback`` as ``step()``/``run()`` produce them. A
+        validation rejection still leaves a terminal queue-wait
+        observation (outcome="rejected") so the latency digest sees
+        every request that touched the front door, not only the
+        admitted survivors."""
+        t0 = time.monotonic()
+        try:
+            ids = np.asarray(prompt, np.int32).reshape(-1)
+            if ids.size == 0:
+                raise ValueError("empty prompt")
+            max_new = int(self.config.max_new_tokens
+                          if max_new_tokens is None
+                          else max_new_tokens)
+            if max_new < 1:
+                raise ValueError(f"max_new_tokens must be >= 1, "
+                                 f"got {max_new}")
+            if ids.size + max_new > self.config.max_model_len:
+                raise ValueError(
+                    f"prompt ({ids.size}) + max_new_tokens "
+                    f"({max_new}) exceeds max_model_len "
+                    f"({self.config.max_model_len})")
+            worst = _pc.blocks_for(ids.size + max_new + self._gamma,
+                                   self._bs)
+            if worst > self._alloc.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {worst} blocks; pool has only "
+                    f"{self._alloc.num_blocks - 1}")
+        except ValueError:
+            wait = 1000.0 * (time.monotonic() - t0)
+            self._m_queue_wait.labels(outcome="rejected").observe(wait)
+            self._d_queue.observe(wait)
+            if self._trace is not None:
+                self._trace.instant("rejected", tid=self._tid_queue)
+            raise
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(ServingRequest(rid, ids, max_new))
+        req = ServingRequest(rid, ids, max_new)
+        self._queue.append(req)
+        self._submit_t[rid] = req.submit_time
+        if self._trace is not None:
+            self._trace.instant(
+                "submit", tid=self._tid_queue,
+                args={"rid": rid, "prompt_tokens": int(ids.size),
+                      "max_new": max_new})
         return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request still waiting in the admission queue.
+        Returns True when it was removed (its terminal queue-wait
+        observation lands with outcome="cancelled"); False when the id
+        is unknown or already admitted — mid-flight preemption is a
+        scheduler feature this engine does not implement yet (ROADMAP
+        "SLO-aware multi-tenant scheduling")."""
+        for k, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[k]
+                self._queue_exit(req, "cancelled")
+                return True
+        return False
+
+    def _trace_tick(self, t_tick, exec_name: str, path: str, **extra):
+        """One engine-tick span (tid 0) — ALL three step paths emit
+        through here so the tick-span schema (exec/path/queued/
+        kernel-fallback delta + per-path extras) cannot drift between
+        ragged and legacy traces. Caller guards on ``self._trace``."""
+        args = {"exec": exec_name, "path": path,
+                "queued": len(self._queue),
+                "kernel_fallbacks": int(sum(
+                    _pa.kernel_fallback_counts().values())
+                    - self._fallbacks0)}
+        args.update(extra)
+        self._trace.emit("tick", tid=0, t0=t_tick, args=args)
+
+    def _queue_exit(self, req, outcome: str) -> float:
+        """Terminal queue-wait observation — EVERY path a request
+        leaves the admission queue by (admitted / cancelled /
+        shutdown; submit rejections observe outcome="rejected"
+        directly) funnels through here, so neither the histogram nor
+        the digest can survivor-bias toward admitted requests."""
+        now = time.monotonic()
+        wait = 1000.0 * (now - req.submit_time)
+        self._m_queue_wait.labels(outcome=outcome).observe(wait)
+        self._d_queue.observe(wait)
+        if outcome != "admitted":       # request will never emit/retire
+            self._submit_t.pop(req.request_id, None)
+        if self._trace is not None:
+            self._trace.emit(
+                f"req{req.request_id} queued", tid=self._tid_queue,
+                t0=req.submit_time, t1=now, args={"outcome": outcome})
+        return wait
 
     @property
     def num_active(self) -> int:
@@ -723,6 +867,7 @@ class ServingEngine:
             return self._step_ragged()
         if self._gamma:
             return self._step_spec()
+        t_tick = time.monotonic()
         emitted = self._admit()
         self._advance_prefills(emitted)
         active = [i for i, s in enumerate(self._slots)
@@ -744,11 +889,13 @@ class ServingEngine:
             self._tables_dev = self._dev(self._tables)
         if self._decode_exec is None:
             self._decode_exec = self._compile_decode(lens, toks, sub)
+        t_l0 = time.monotonic()
         with _quiet_donation():
             out, self._pools = self._decode_exec(
                 self._params, self._pools, self._tables_dev,
                 self._dev(lens), self._dev(toks), sub)
         out = np.asarray(out)
+        t_sync = time.monotonic()
 
         self._m_steps.inc()
         self._n_decode_steps += 1
@@ -757,6 +904,9 @@ class ServingEngine:
             self._n_tp_bytes += self._tp_step_bytes
         self._m_util.observe(len(active) / cfg.num_slots)
         self._note_kv_read(int(lens.sum()) + len(active))
+        tr = self._trace
+        rid_of = {i: self._slots[i].rid for i in active} \
+            if tr is not None else None
         for i in active:
             slot = self._slots[i]
             tok = int(out[i])
@@ -768,6 +918,13 @@ class ServingEngine:
             emitted.append((slot.rid, tok))
             if tok == self._eos or slot.n_emitted >= slot.max_new:
                 self._retire(i)
+        if tr is not None:
+            for i in active:
+                tr.emit("decode tick", tid=1 + i, t0=t_l0, t1=t_sync,
+                        args={"rid": rid_of[i], "rows": 1})
+            self._trace_tick(
+                t_tick, "decode", "legacy", active=len(active),
+                occupancy=round(len(active) / cfg.num_slots, 3))
         return emitted
 
     def _step_spec(self) -> List[tuple]:
@@ -780,6 +937,7 @@ class ServingEngine:
         rejected tail is ``cache_len`` simply not advancing over it,
         plus ``_trim_blocks`` returning overhang blocks."""
         from ..generation import speculative as _spec
+        t_tick = time.monotonic()
         emitted = self._admit()
         self._advance_prefills(emitted)
         active = [i for i, s in enumerate(self._slots)
@@ -801,6 +959,7 @@ class ServingEngine:
         if self._tables_dev is None:
             self._tables_dev = self._dev(self._tables)
         lens_dev = self._dev(lens)
+        t_l0 = time.monotonic()         # draft + verify launch window
 
         dq = None
         if self._draft_model is not None:
@@ -831,6 +990,7 @@ class ServingEngine:
             out, accept, _logp, self._pools = self._verify_exec(*args)
         out = np.asarray(out)
         accept = np.asarray(accept)
+        t_sync = time.monotonic()
 
         self._m_steps.inc()
         self._n_decode_steps += 1
@@ -841,11 +1001,24 @@ class ServingEngine:
         # window row t attends lens + t + 1 positions
         self._note_kv_read((g + 1) * int(lens.sum())
                            + len(active) * (g + 1) * (g + 2) // 2)
+        tr = self._trace
+        rid_of = {i: self._slots[i].rid for i in active} \
+            if tr is not None else None
+        acc_lens = {}
         for i in active:
-            self._commit_verify_window(i, out[i], accept[i], emitted)
+            acc_lens[i] = self._commit_verify_window(
+                i, out[i], accept[i], emitted)
         if self._n_spec_proposed:
             self._m_spec_rate.set(
                 self._n_spec_accepted / self._n_spec_proposed)
+        if tr is not None:
+            for i in active:
+                tr.emit("verify tick", tid=1 + i, t0=t_l0, t1=t_sync,
+                        args={"rid": rid_of[i], "rows": g + 1,
+                              "accepted_len": acc_lens[i]})
+            self._trace_tick(
+                t_tick, "verify", "legacy", active=len(active),
+                occupancy=round(len(active) / cfg.num_slots, 3))
         return emitted
 
     def _commit_verify_window(self, i, out_row, accept_row, emitted):
@@ -856,7 +1029,9 @@ class ServingEngine:
         prefix, account acceptance, retire on EOS/max_new, else
         advance ``cache_len`` over the accepted prefix (rollback of
         the rejected tail = NOT advancing over it) and trim overhang
-        blocks."""
+        blocks. Returns the number of tokens emitted (the per-slot
+        ``accepted_len`` the trace annotates verify-tick spans
+        with)."""
         from ..generation import speculative as _spec
         g = self._gamma
         slot = self._slots[i]
@@ -888,6 +1063,7 @@ class ServingEngine:
             slot.cache_len += n_acc + 1
             slot.last_token = kept[-1]
             self._trim_blocks(i)
+        return len(kept)
 
     def _step_ragged(self) -> List[tuple]:
         """Ragged mixed-batch tick (the default path): pack every live
@@ -901,6 +1077,7 @@ class ServingEngine:
         ``q_lens``/``row_starts`` VALUES and steady state runs zero
         recompiles exactly like the per-width path it replaces."""
         from ..generation import speculative as _spec
+        t_tick = time.monotonic()
         emitted = self._admit()
         cfg = self.config
         g = self._gamma
@@ -1030,6 +1207,14 @@ class ServingEngine:
         args.append(sub)
         if self._ragged_exec is None:
             self._ragged_exec = self._compile_ragged_step(tuple(args))
+        tr = self._trace
+        if tr is not None:
+            # names/positions BEFORE the commit loops retire slots
+            rid_of = {i: self._slots[i].rid
+                      for i in active + list(given)}
+            pend_pos0 = {i: int(self._slots[i].pend_pos)
+                         for i in given}
+        t_l0 = time.monotonic()
         with _quiet_donation():
             outs = self._ragged_exec(*args)
 
@@ -1044,9 +1229,11 @@ class ServingEngine:
                            + int((q_lens * (q_lens + 1) // 2).sum()))
 
         # -- commit decode / verify rows -------------------------------
+        acc_lens = {}
         if not g:
             tok_arr = np.asarray(outs[0])
             self._pools = outs[1]
+            t_sync = time.monotonic()
             for i in active:
                 slot = self._slots[i]
                 tok = int(tok_arr[i])
@@ -1063,9 +1250,10 @@ class ServingEngine:
             out = np.asarray(outs[1])
             accept = np.asarray(outs[2])
             self._pools = outs[3]
+            t_sync = time.monotonic()
             for i in active:
-                self._commit_verify_window(i, out[i], accept[i],
-                                           emitted)
+                acc_lens[i] = self._commit_verify_window(
+                    i, out[i], accept[i], emitted)
             if self._n_spec_proposed:
                 self._m_spec_rate.set(
                     self._n_spec_accepted / self._n_spec_proposed)
@@ -1080,6 +1268,24 @@ class ServingEngine:
                 # the chunk's last row IS the final prompt row: its
                 # sampled logits are the request's first token
                 self._finish_prefill(i, int(tok_arr[i]), emitted)
+        if tr is not None:
+            for i in active:
+                args_i = {"rid": rid_of[i], "rows": int(q_lens[i])}
+                if g:
+                    args_i["accepted_len"] = acc_lens[i]
+                tr.emit("verify tick" if g else "decode tick",
+                        tid=1 + i, t0=t_l0, t1=t_sync, args=args_i)
+            for i, k in given.items():
+                tr.emit("prefill chunk", tid=1 + i, t0=t_l0,
+                        t1=t_sync,
+                        args={"rid": rid_of[i], "rows": int(k),
+                              "pos": pend_pos0[i]})
+            self._trace_tick(
+                t_tick, "verify" if g else "decode", "ragged",
+                rows=int(q_lens.sum()), active=len(active),
+                pending=len(pending),
+                occupancy=round(
+                    (len(active) + len(pending)) / n_slots, 3))
         return emitted
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -1162,6 +1368,19 @@ class ServingEngine:
             "moe_routing_entropy": self._moe_ent_last,
             "moe_expert_load_max": self._moe_load_max_last,
             "moe_dispatches": self._n_moe_dispatches,
+            # request-lifecycle tracing + SLO latency digests: ALWAYS
+            # present (zeroed summaries on an idle engine; the digests
+            # run regardless of the PADDLE_TPU_TRACE kill switch) —
+            # each *_ms value is a P² digest summary {count, mean,
+            # min, max, p50, p95, p99}
+            "engine_id": self._engine_id,
+            "tracing": self._trace is not None,
+            "trace_events": len(self._trace)
+            if self._trace is not None else 0,
+            "ttft_ms": self._d_ttft.summary(),
+            "itl_ms": self._d_itl.summary(),
+            "queue_wait_ms": self._d_queue.summary(),
+            "e2e_ms": self._d_e2e.summary(),
         }
         if self._gamma:
             out.update({
@@ -1183,12 +1402,35 @@ class ServingEngine:
         bijective hash index — raising RuntimeError on any leak or
         double-accounting. Call after draining (or at any quiescent
         point; live slots' blocks are passed as the expected live
-        set)."""
+        set). Requests still waiting in the admission queue are
+        drained with a terminal queue-wait observation
+        (outcome="shutdown") — they would otherwise leave no latency
+        record at all."""
+        while self._queue:
+            self._queue_exit(self._queue.popleft(), "shutdown")
+        self._sync_cache_metrics()
         if check_leaks:
             live = [b for s in self._slots if s is not None
                     for b in s.blocks]
             self._alloc.check_leaks(live)
         return True
+
+    # -- tracing ------------------------------------------------------
+
+    @property
+    def tracer(self):
+        """This engine's span tracer, or None when tracing is disabled
+        (``PADDLE_TPU_TRACE=0``)."""
+        return self._trace
+
+    def dump_trace(self, path: str):
+        """Write this engine's request-lifecycle trace as Chrome
+        trace-event JSON (load it at https://ui.perfetto.dev or
+        chrome://tracing). Returns the path written, or None when
+        tracing is disabled."""
+        if self._trace is None:
+            return None
+        return self._trace.dump_chrome_trace(path)
 
     @staticmethod
     def _model_fingerprint(model) -> bytes:
@@ -1445,8 +1687,18 @@ class ServingEngine:
 
     def _emit(self, rid, tok):
         """Single exit point for generated tokens (prefill's first token
-        AND every decode token) — the token counters live here so they
-        agree exactly with what clients receive."""
+        AND every decode token) — the token counters and the TTFT /
+        inter-token digests live here so they agree exactly with what
+        clients receive."""
+        now = time.monotonic()
+        prev = self._last_emit.get(rid)
+        if prev is None:                # this request's FIRST token
+            t0 = self._submit_t.get(rid)
+            if t0 is not None:
+                self._d_ttft.observe(1000.0 * (now - t0))
+        else:
+            self._d_itl.observe(1000.0 * (now - prev))
+        self._last_emit[rid] = now
         self._results[rid].append(tok)
         self._m_tokens.inc()
         self._n_tokens += 1
@@ -1503,8 +1755,7 @@ class ServingEngine:
             self._tables_dev = None
             # observe BEFORE prefill so the histogram measures queue
             # wait, not prefill execution/compile time
-            self._m_queue_wait.observe(
-                1000.0 * (time.monotonic() - req.submit_time))
+            self._queue_exit(req, "admitted")
             self._results[req.request_id] = []
             self._slots[i] = _Slot(
                 req.request_id, blocks, worst, cached, None,
@@ -1513,6 +1764,13 @@ class ServingEngine:
                 prompt=np.asarray(req.prompt, np.int32),
                 pend_pos=cached)
             self._m_occupancy.set(self.num_active)
+            if self._trace is not None:
+                self._trace.instant(
+                    "admit", tid=1 + i,
+                    args={"rid": req.request_id,
+                          "prefix_hit": cached > 0,
+                          "cached_tokens": int(cached),
+                          "prompt_tokens": n_real})
             if not self._chunked:
                 tok = self._prefill_bucketed(i, req, n_real)
                 self._finish_prefill(i, tok, emitted)
@@ -1631,6 +1889,7 @@ class ServingEngine:
             ids[0, :part.size] = part
             ids_dev = self._dev(ids)
             pos = self._dev(np.int32(slot.pend_pos))
+            t_c0 = time.monotonic()
             with _quiet_donation():
                 tok, self._pools = self._chunk_exec(
                     self._params, ids_dev, self._pools, table_dev,
@@ -1643,6 +1902,13 @@ class ServingEngine:
                     self._dpools = self._draft_chunk_exec(
                         self._dparams, ids_dev, self._dpools,
                         table_dev, pos)
+            if self._trace is not None:
+                self._trace.emit(
+                    f"prefill chunk[{slot.pend_pos // c}]",
+                    tid=1 + i, t0=t_c0,
+                    args={"rid": slot.rid,
+                          "pos": int(slot.pend_pos),
+                          "rows": n_part})
             self._n_prefill_chunks += 1
             slot.pend_pos += int(part.size)
             slot.cache_len = slot.pend_pos
@@ -1704,11 +1970,18 @@ class ServingEngine:
 
     def _sync_cache_metrics(self):
         """Mirror allocator-side eviction counts into the monitor
-        registry (the allocator stays monitor-free)."""
+        registry (the allocator stays monitor-free), and refresh the
+        SLO latency quantile gauges from the per-engine digests."""
         d = self._alloc.evictions - self._n_evictions_seen
         if d:
             self._m_evict.inc(d)
             self._n_evictions_seen = self._alloc.evictions
+        for key, dig in (("ttft", self._d_ttft), ("itl", self._d_itl),
+                         ("queue_wait", self._d_queue),
+                         ("e2e", self._d_e2e)):
+            g = self._m_lat[key]
+            for q, v in dig.quantiles().items():
+                g.labels(q=q).set(round(v, 3))
 
     def _prefill_bucketed(self, i, req, n_real) -> int:
         """Legacy bucketed prefill (``PADDLE_TPU_CHUNKED_PREFILL=0`` /
@@ -1724,6 +1997,7 @@ class ServingEngine:
         if exec_ is None:
             exec_ = self._compile_prefill(bucket, sub)
             self._prefill_execs[bucket] = exec_
+        t_p0 = time.monotonic()
         with _quiet_donation():
             tok, self._pools = exec_(
                 self._params, self._dev(ids),
@@ -1741,6 +2015,10 @@ class ServingEngine:
                     self._dparams, self._dev(ids),
                     self._dev(np.int32(n_real)), self._dpools,
                     self._dev(self._tables[i]))
+        if self._trace is not None:
+            self._trace.emit(
+                f"prefill bucket{bucket}", tid=1 + i, t0=t_p0,
+                args={"rid": req.request_id, "rows": n_real})
         return int(tok)
 
     def _ensure_blocks(self, active, horizon=1):
@@ -1781,6 +2059,21 @@ class ServingEngine:
 
     def _retire(self, i):
         slot = self._slots[i]
+        now = time.monotonic()
+        t0 = self._submit_t.pop(slot.rid, None)
+        if t0 is not None:
+            self._d_e2e.observe(1000.0 * (now - t0))
+        self._last_emit.pop(slot.rid, None)
+        if self._trace is not None:
+            # the request's whole residency on this slot, admission to
+            # retirement — per-tick decode/verify/prefill spans nest
+            # inside it on the same tid
+            self._trace.emit(
+                f"req{slot.rid}", tid=1 + i, t0=slot.admit_t, t1=now,
+                args={"tokens": slot.n_emitted,
+                      "cache_len": slot.cache_len})
+            self._trace.instant("retired", tid=1 + i,
+                                args={"rid": slot.rid})
         if self._prefix_on and slot.cache_len >= self._bs:
             # publish the retired sequence's FULL blocks into the
             # content index instead of just dropping them: the hash

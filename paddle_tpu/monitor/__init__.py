@@ -32,17 +32,22 @@ import os
 import sys
 
 from .registry import (Counter, Gauge, Histogram, Info, Registry,
-                       get_registry, metrics_dir, metrics_enabled)
+                       get_registry, metrics_dir, metrics_enabled,
+                       prometheus_path)
 from .accounting import (analytic_mfu, collective_census,
                          device_peak_flops, record_compiled_step,
                          sample_device_memory, step_report,
                          step_reports)
+from .digest import LatencyDigest, P2Quantile
+from .tracing import Tracer, tracing_enabled
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Info", "Registry",
     "get_registry", "metrics_dir", "metrics_enabled",
     "counter", "gauge", "histogram", "info",
     "export_jsonl", "report", "reset",
+    "prometheus_dump", "prometheus_path",
+    "LatencyDigest", "P2Quantile", "Tracer", "tracing_enabled",
     "record_compiled_step", "collective_census", "step_report",
     "step_reports", "sample_device_memory", "analytic_mfu",
     "device_peak_flops",
@@ -74,6 +79,15 @@ def export_jsonl(path=None):
     return get_registry().dump_jsonl(path)
 
 
+def prometheus_dump(path=None):
+    """Render the registry in the Prometheus text exposition format to
+    ``path`` (default ``$PADDLE_TPU_METRICS_PROM``; a directory gets
+    ``metrics-<pid>.prom``). Returns the file written or None. The
+    atexit hook writes this automatically when the env var is set —
+    the JSONL export's scrape-side twin."""
+    return get_registry().dump_prometheus(path)
+
+
 def report() -> str:
     """Human text table of every metric sample."""
     return get_registry().table()
@@ -88,6 +102,8 @@ def _atexit_dump():
     try:
         if metrics_dir():
             get_registry().dump_jsonl()
+        if prometheus_path():
+            get_registry().dump_prometheus()
         dump = os.environ.get("PADDLE_TPU_METRICS_DUMP")
         if dump:
             stream = sys.stdout if dump == "stdout" else sys.stderr

@@ -26,10 +26,12 @@ import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Info", "Registry",
-           "get_registry", "metrics_dir", "metrics_enabled"]
+           "get_registry", "metrics_dir", "metrics_enabled",
+           "prometheus_path"]
 
 _DIR_ENV = "PADDLE_TPU_METRICS_DIR"
 _DUMP_ENV = "PADDLE_TPU_METRICS_DUMP"
+_PROM_ENV = "PADDLE_TPU_METRICS_PROM"
 
 # histogram bucket upper bounds (ms-scale spans AND unit-scale ratios
 # both fit; +Inf is implicit)
@@ -47,6 +49,59 @@ def metrics_enabled() -> bool:
     """True when the operator opted into the heavier accounting paths
     (explicit export dir, or ``PADDLE_TPU_METRICS=1``)."""
     return bool(metrics_dir() or os.environ.get("PADDLE_TPU_METRICS"))
+
+
+def prometheus_path() -> Optional[str]:
+    """Prometheus text-exposition export path
+    (``PADDLE_TPU_METRICS_PROM``), or None when disabled."""
+    p = os.environ.get(_PROM_ENV)
+    return p or None
+
+
+# -- Prometheus text-format mangling ----------------------------------
+# (rules documented in docs/OPS.md "Prometheus exposition")
+
+def _prom_name(name: str) -> str:
+    """Metric/label name mangling: any char outside [a-zA-Z0-9_:] maps
+    to '_', and a leading digit gets a '_' prefix."""
+    out = "".join(c if (c.isascii() and (c.isalnum() or c in "_:"))
+                  else "_" for c in str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out or "_"
+
+
+def _prom_label_value(value, limit: int = 200) -> str:
+    """Escape a label value per the exposition format (backslash,
+    double-quote, newline), truncating pathological payloads."""
+    s = str(value)
+    if len(s) > limit:
+        s = s[:limit] + "..."
+    return s.replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _prom_labels(labels: dict, extra: Optional[dict] = None) -> str:
+    items = list(labels.items()) + list((extra or {}).items())
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(k)}="{_prom_label_value(v)}"'
+                    for k, v in items)
+    return "{" + body + "}"
+
+
+def _prom_number(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if f != f:                       # NaN: int(f) below would raise
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(f) if f != int(f) else str(int(f))
 
 
 class _Metric:
@@ -323,6 +378,93 @@ class Registry:
             for rec in self.collect():
                 rec["ts"] = ts
                 f.write(json.dumps(rec, default=str) + "\n")
+        return fname
+
+    def prometheus_text(self) -> str:
+        """Render every metric in the Prometheus text exposition format
+        (version 0.0.4): ``# HELP`` / ``# TYPE`` headers plus one sample
+        line per (metric, labelset). Mangling rules (docs/OPS.md):
+
+        - names/labels: chars outside ``[a-zA-Z0-9_:]`` become ``_``,
+          a leading digit gains a ``_`` prefix; registry names are
+          otherwise exported verbatim (no ``_total`` suffixing).
+        - histograms: the registry's per-bin counts are re-rendered as
+          the CUMULATIVE ``<name>_bucket{le="..."}`` series Prometheus
+          expects, plus ``<name>_sum`` / ``<name>_count``.
+        - Info metrics (non-numeric) export as ``<name>_info ... 1``
+          gauges carrying the JSON-ish payload in a ``value`` label
+          (truncated at 200 chars).
+        """
+        out: List[str] = []
+        with self._lock:
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: m.name)
+        for m in metrics:
+            pname = _prom_name(m.name)
+            with m._lock:
+                items = list(m._values.items())
+            if not items:
+                continue
+            # info families are exported as <name>_info samples — the
+            # HELP must name the family the samples belong to
+            fam = f"{pname}_info" if m.kind == "info" else pname
+            if m.help:
+                # HELP lines escape only backslash + newline
+                h = str(m.help).replace("\\", "\\\\") \
+                    .replace("\n", "\\n")
+                out.append(f"# HELP {fam} {h}")
+            if m.kind == "info":
+                out.append(f"# TYPE {pname}_info gauge")
+                for key, value in items:
+                    lbl = _prom_labels(m._label_dict(key),
+                                       {"value": json.dumps(
+                                           value, default=str)})
+                    out.append(f"{pname}_info{lbl} 1")
+                continue
+            if m.kind == "histogram":
+                out.append(f"# TYPE {pname} histogram")
+                for key, st in items:
+                    base = m._label_dict(key)
+                    cum = 0
+                    for ub, n in zip(list(m.buckets) + [None],
+                                     st["buckets"]):
+                        cum += n
+                        le = "+Inf" if ub is None else _prom_number(ub)
+                        lbl = _prom_labels(base, {"le": le})
+                        out.append(f"{pname}_bucket{lbl} {cum}")
+                    lbl = _prom_labels(base)
+                    out.append(f"{pname}_sum{lbl} "
+                               f"{_prom_number(st['sum'])}")
+                    out.append(f"{pname}_count{lbl} {st['count']}")
+                continue
+            # counter / gauge (untyped values export as gauge)
+            kind = m.kind if m.kind in ("counter", "gauge") else "gauge"
+            out.append(f"# TYPE {pname} {kind}")
+            for key, value in items:
+                lbl = _prom_labels(m._label_dict(key))
+                out.append(f"{pname}{lbl} {_prom_number(value)}")
+        return "\n".join(out) + ("\n" if out else "")
+
+    def dump_prometheus(self, path: Optional[str] = None
+                        ) -> Optional[str]:
+        """Write the text exposition to ``path`` (default
+        ``$PADDLE_TPU_METRICS_PROM``; a directory gets
+        ``metrics-<pid>.prom``). Returns the file written, or None when
+        export is disabled. The atexit hook in ``paddle_tpu.monitor``
+        calls this next to the JSONL dump — point a node_exporter
+        textfile collector (or a scrape-side cat) at the file."""
+        target = path or prometheus_path()
+        if target is None:
+            return None
+        if os.path.splitext(target)[1]:
+            fname = target
+            os.makedirs(os.path.dirname(fname) or ".", exist_ok=True)
+        else:
+            os.makedirs(target, exist_ok=True)
+            fname = os.path.join(target,
+                                 f"metrics-{os.getpid()}.prom")
+        with open(fname, "w") as f:
+            f.write(self.prometheus_text())
         return fname
 
     def table(self) -> str:

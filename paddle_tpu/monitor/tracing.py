@@ -1,0 +1,254 @@
+"""Request-lifecycle span tracer — per-engine timelines the serving
+engine (and anything else host-side) records around its hot loop.
+
+Design constraints, in order:
+
+1. **Lock-cheap on the hot path.** A span is ONE append to a bounded
+   ``collections.deque`` under one lock acquisition — begin() carries
+   no lock at all (it just captures a monotonic timestamp into a
+   tuple), and the record is written only at end(). An engine tick
+   emits a handful of spans, each costing one deque.append.
+2. **Bounded memory.** The buffer is a ring (``deque(maxlen=...)``,
+   default 65536 events, env ``PADDLE_TPU_TRACE_EVENTS``): a
+   long-lived engine overwrites its oldest spans instead of growing.
+3. **Opt-out kill switch.** ``PADDLE_TPU_TRACE=0`` disables tracing
+   entirely; callers are expected to hold ``None`` instead of a Tracer
+   and skip every call site (the serving engine does exactly this), so
+   the killed hot path executes zero tracer instructions. Tracing is
+   pure host code — span calls never trace into compiled executables,
+   so enabling/disabling it cannot change engine outputs or compile
+   counts.
+4. **Standard viewers.** Export is Chrome trace-event JSON — load the
+   file at https://ui.perfetto.dev or chrome://tracing — plus NDJSON
+   (one JSON object per event) for ad-hoc grepping. One Tracer is one
+   trace-viewer *process* (pid); rows inside it are *threads* (tid):
+   the serving engine maps tid 0 to its tick timeline, tid ``1+i`` to
+   slot ``i``'s request timeline, and the last tid to the admission
+   queue.
+
+Clocks are ``time.monotonic()`` (the same base the serving scheduler
+stamps ``submit_time`` with), exported in integer microseconds as the
+trace-event spec wants.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Tracer", "tracing_enabled", "trace_buffer_capacity",
+           "live_tracers", "dump_chrome_trace"]
+
+_TRACE_ENV = "PADDLE_TPU_TRACE"
+_CAP_ENV = "PADDLE_TPU_TRACE_EVENTS"
+
+_PIDS = itertools.count(1)
+# every live Tracer, so a process-wide dump can merge engines into one
+# Perfetto file (each keeps its own pid lane)
+_TRACERS: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
+
+
+def tracing_enabled() -> bool:
+    """True unless the operator opted out (``PADDLE_TPU_TRACE=0``)."""
+    return os.environ.get(_TRACE_ENV, "1") != "0"
+
+
+def trace_buffer_capacity() -> int:
+    """Ring-buffer capacity in events (``PADDLE_TPU_TRACE_EVENTS``)."""
+    try:
+        return max(16, int(os.environ.get(_CAP_ENV, 65536)))
+    except ValueError:
+        return 65536
+
+
+class Tracer:
+    """One trace-viewer process worth of timeline rows.
+
+    Usage::
+
+        tr = Tracer("ServingEngine[0]")
+        tr.set_thread(0, "engine")
+        with tr.span("tick", tid=0, active=3):
+            ...
+        tok = tr.begin("prefill chunk", tid=2)
+        ...
+        tr.end(tok, rows=16)
+        tr.dump_chrome_trace("/tmp/serve_trace.json")
+    """
+
+    def __init__(self, name: str, pid: Optional[int] = None,
+                 capacity: Optional[int] = None):
+        self.name = name
+        self.pid = next(_PIDS) if pid is None else int(pid)
+        self.capacity = int(capacity or trace_buffer_capacity())
+        self._buf: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._threads: Dict[int, str] = {}
+        self._n_dropped = 0          # events the ring overwrote
+        _TRACERS.add(self)
+
+    # -- recording ----------------------------------------------------
+
+    def set_thread(self, tid: int, name: str):
+        """Name one timeline row (Perfetto track label)."""
+        with self._lock:
+            self._threads[int(tid)] = str(name)
+
+    def _append(self, rec):
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self._n_dropped += 1
+            self._buf.append(rec)
+
+    def emit(self, name: str, tid: int = 0, t0: float = None,
+             t1: float = None, args: Optional[dict] = None):
+        """Record one complete span over the monotonic-seconds interval
+        ``[t0, t1]`` (defaults: a zero-length span at now). The
+        explicit-interval form lets a caller blanket several rows with
+        one measured interval (e.g. every slot that rode one engine
+        tick). ``t1`` defaults to *now*, so ``emit(name, t0=start)``
+        is "the span that began at ``start`` just ended"."""
+        now = time.monotonic()
+        t0 = now if t0 is None else t0
+        t1 = now if t1 is None else t1
+        self._append(("X", name, int(tid), t0, max(t1 - t0, 0.0),
+                      args))
+
+    def instant(self, name: str, tid: int = 0,
+                args: Optional[dict] = None):
+        """Record a point-in-time marker."""
+        self._append(("i", name, int(tid), time.monotonic(), 0.0,
+                      args))
+
+    def begin(self, name: str, tid: int = 0, **args):
+        """Start a span; returns an opaque token for :meth:`end`.
+        Lock-free — nothing is recorded until the span ends."""
+        return (name, int(tid), time.monotonic(), args or None)
+
+    def end(self, token, **more_args):
+        """Finish a span started by :meth:`begin` (ONE buffer append)."""
+        name, tid, t0, args = token
+        if more_args:
+            args = dict(args or {}, **more_args)
+        self._append(("X", name, tid, t0,
+                      max(time.monotonic() - t0, 0.0), args))
+
+    @contextlib.contextmanager
+    def span(self, name: str, tid: int = 0, **args):
+        """Context-manager form of begin/end."""
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self._append(("X", name, int(tid), t0,
+                          max(time.monotonic() - t0, 0.0),
+                          args or None))
+
+    # -- introspection ------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def dropped(self) -> int:
+        """Events the ring buffer overwrote (oldest-first)."""
+        with self._lock:
+            return self._n_dropped
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+            self._n_dropped = 0
+
+    def events(self) -> List[dict]:
+        """Snapshot of the buffered events as plain dicts (monotonic
+        seconds), oldest first."""
+        with self._lock:
+            items = list(self._buf)
+        return [{"ph": ph, "name": name, "tid": tid, "t0": t0,
+                 "dur": dur, "args": args}
+                for ph, name, tid, t0, dur, args in items]
+
+    # -- export -------------------------------------------------------
+
+    def chrome_events(self) -> List[dict]:
+        """This tracer's events in Chrome trace-event form: metadata
+        rows first (process/thread names), then one ``"X"`` (complete)
+        or ``"i"`` (instant) event per record, ``ts``/``dur`` in
+        integer microseconds."""
+        with self._lock:
+            items = list(self._buf)
+            threads = dict(self._threads)
+        out: List[dict] = [{
+            "ph": "M", "pid": self.pid, "tid": 0,
+            "name": "process_name", "args": {"name": self.name}}]
+        for tid in sorted(threads):
+            out.append({"ph": "M", "pid": self.pid, "tid": tid,
+                        "name": "thread_name",
+                        "args": {"name": threads[tid]}})
+            out.append({"ph": "M", "pid": self.pid, "tid": tid,
+                        "name": "thread_sort_index",
+                        "args": {"sort_index": tid}})
+        for ph, name, tid, t0, dur, args in items:
+            ev = {"ph": ph, "pid": self.pid, "tid": tid, "name": name,
+                  "cat": "paddle_tpu", "ts": int(t0 * 1e6)}
+            if ph == "X":
+                ev["dur"] = int(dur * 1e6)
+            else:                       # instant: thread-scoped
+                ev["s"] = "t"
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The full Perfetto/chrome://tracing-loadable document."""
+        return {"traceEvents": self.chrome_events(),
+                "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(self, path: str) -> str:
+        """Write the Chrome trace-event JSON to ``path``; returns it."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f, default=str)
+        return path
+
+    def dump_ndjson(self, path: str) -> str:
+        """Write one JSON object per event (grep/jq-friendly twin of
+        the Chrome export); returns ``path``."""
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(
+                    {"pid": self.pid, "tracer": self.name, **ev},
+                    default=str) + "\n")
+        return path
+
+
+def live_tracers() -> List[Tracer]:
+    """Every Tracer still referenced somewhere in the process."""
+    return sorted(_TRACERS, key=lambda t: t.pid)
+
+
+def dump_chrome_trace(path: str,
+                      tracers: Optional[List[Tracer]] = None) -> str:
+    """Merge ``tracers`` (default: every live tracer) into ONE Chrome
+    trace file — each tracer keeps its own pid lane, so a multi-engine
+    process shows one process row per engine in Perfetto."""
+    events: List[Any] = []
+    for tr in (live_tracers() if tracers is None else tracers):
+        events.extend(tr.chrome_events())
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f,
+                  default=str)
+    return path

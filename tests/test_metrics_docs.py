@@ -1,0 +1,53 @@
+"""Metric-docs lint guard (ISSUE 11 satellite): every metric name that
+registers in the monitor registry at ``import paddle_tpu`` plus the
+instantiation of a small serving engine must appear in the docs/OPS.md
+metrics table — a new metric can no longer ship undocumented.
+
+The probe runs in a FRESH interpreter so the registry holds exactly the
+framework's own registrations (the in-process test suite registers
+ad-hoc test metrics that must not trip the lint, and conversely a
+polluted registry must not hide a missing doc)."""
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# import + a small spec-enabled engine (gamma > 0 registers the spec
+# metrics too); construction is compile-free, so this stays cheap
+_PROBE = """
+import json
+import paddle_tpu
+from paddle_tpu import monitor
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2,
+                       kv_heads=1, ffn=64)
+m = LlamaForCausalLM(cfg)
+m.eval()
+from paddle_tpu.inference import ServingConfig, ServingEngine
+ServingEngine(m, ServingConfig(num_slots=2, block_size=8,
+                               max_model_len=32,
+                               num_speculative_tokens=2))
+print("METRICS=" + json.dumps(sorted(monitor.get_registry()._metrics)))
+"""
+
+
+def test_every_registered_metric_is_documented():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", _PROBE],
+                          capture_output=True, text=True, cwd=_ROOT,
+                          env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("METRICS=")][-1]
+    names = json.loads(line[len("METRICS="):])
+    # sanity: the probe actually saw the registry (serving + jit + moe)
+    assert len(names) >= 30, names
+    assert "serving_ttft_ms" in names
+    with open(os.path.join(_ROOT, "docs", "OPS.md")) as f:
+        ops = f.read()
+    missing = [n for n in names if n not in ops]
+    assert not missing, (
+        "metrics registered but undocumented — add them to the "
+        f"docs/OPS.md metrics table: {missing}")

@@ -1,0 +1,489 @@
+"""Request-lifecycle tracing, streaming SLO digests, and the goodput
+harness (ISSUE 11): P² digest accuracy vs numpy, tracer ring-buffer
+bounding + Chrome trace-event schema + slot/tid mapping over a mixed
+ragged wave, the ``PADDLE_TPU_TRACE=0`` kill switch (bit-for-bit inert,
+zero steady-state recompiles, span-free hot path), always-present
+``stats()`` latency keys across fp/int8/spec/TP engines, terminal
+queue-wait outcomes (no survivor bias), Prometheus exposition, and a
+tiny-scale goodput-bench smoke."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import monitor
+from paddle_tpu.monitor.digest import LatencyDigest, P2Quantile
+from paddle_tpu.monitor.registry import Registry
+from paddle_tpu.monitor.tracing import Tracer
+from paddle_tpu.inference import ServingConfig, ServingEngine
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture
+def llama_tiny():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=2, heads=4,
+                           kv_heads=2, ffn=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+# ------------------------------------------------------------- P² digest
+
+
+def test_p2_digest_accuracy_vs_numpy():
+    """P² p50/p95/p99 track numpy percentiles on known distributions
+    (the documented accuracy bound: a few % of the stream's range)."""
+    rng = np.random.RandomState(0)
+    for data in (rng.uniform(0.0, 100.0, 4000),
+                 rng.exponential(10.0, 4000),
+                 rng.normal(50.0, 10.0, 4000)):
+        d = LatencyDigest()
+        for x in data:
+            d.observe(x)
+        s = d.summary()
+        tol = 0.03 * (data.max() - data.min())
+        for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+            true = float(np.percentile(data, q))
+            assert abs(s[key] - true) <= tol, \
+                f"{key}: est {s[key]} vs true {true} (tol {tol})"
+        assert s["count"] == len(data)
+        assert abs(s["mean"] - data.mean()) < 1e-6 * max(
+            1.0, abs(data.mean())) + 1e-3
+        assert s["min"] == data.min() and s["max"] == data.max()
+
+
+def test_p2_digest_small_n_exact_and_empty():
+    """Below 5 observations the digest IS the sorted sample (linear
+    interpolation, numpy's default); empty summaries are fully keyed
+    zeros so stats() consumers never KeyError on an idle engine."""
+    d = LatencyDigest()
+    assert d.summary() == {"count": 0, "mean": 0.0, "min": 0.0,
+                           "max": 0.0, "p50": 0.0, "p95": 0.0,
+                           "p99": 0.0}
+    data = [7.0, 1.0, 5.0]
+    for x in data:
+        d.observe(x)
+    s = d.summary()
+    for q, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        np.testing.assert_allclose(s[key], np.percentile(data, q),
+                                   rtol=1e-12)
+    with pytest.raises(ValueError):
+        P2Quantile(1.5)
+    with pytest.raises(KeyError):
+        d.quantile(0.25)
+
+
+# ---------------------------------------------------------------- tracer
+
+
+def test_tracer_ring_buffer_bounds_memory():
+    tr = Tracer("ring", capacity=32)
+    for i in range(100):
+        tr.emit(f"e{i}", tid=0)
+    assert len(tr) == 32
+    assert tr.dropped == 68
+    names = [e["name"] for e in tr.events()]
+    assert names[0] == "e68" and names[-1] == "e99"  # oldest dropped
+    tr.clear()
+    assert len(tr) == 0 and tr.dropped == 0
+
+
+def test_tracer_env_capacity(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_EVENTS", "64")
+    assert Tracer("cap").capacity == 64
+    monkeypatch.setenv("PADDLE_TPU_TRACE_EVENTS", "bogus")
+    assert Tracer("cap2").capacity == 65536
+
+
+def test_tracer_chrome_schema_nesting_and_ndjson(tmp_path):
+    """Spans nest by time containment, the Chrome export carries the
+    required keys (ph/pid/tid/ts/dur in integer us), metadata rows name
+    the process and threads, and the NDJSON twin parses per-line."""
+    tr = Tracer("schema")
+    tr.set_thread(0, "engine")
+    with tr.span("outer", tid=0, depth=0):
+        with tr.span("inner", tid=0, depth=1):
+            tr.instant("mark", tid=0)
+    doc = tr.chrome_trace()
+    json.dumps(doc)                              # serializable
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert {"process_name", "thread_name"} <= {m["name"] for m in meta}
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert set(xs) == {"outer", "inner"}
+    for e in xs.values():
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["pid"] == tr.pid and e["tid"] == 0
+    # containment: inner ⊆ outer (the viewer nests by this)
+    o, i = xs["outer"], xs["inner"]
+    assert o["ts"] <= i["ts"]
+    assert i["ts"] + i["dur"] <= o["ts"] + o["dur"]
+    mark = [e for e in evs if e["ph"] == "i"][0]
+    assert i["ts"] <= mark["ts"] <= i["ts"] + i["dur"]
+    # begin/end explicit API folds extra args in at end()
+    tok = tr.begin("late", tid=0, a=1)
+    tr.end(tok, b=2)
+    assert tr.events()[-1]["args"] == {"a": 1, "b": 2}
+    path = tr.dump_ndjson(str(tmp_path / "t.ndjson"))
+    recs = [json.loads(line) for line in open(path)]
+    assert {r["name"] for r in recs} >= {"outer", "inner", "mark"}
+    cpath = tr.dump_chrome_trace(str(tmp_path / "t.json"))
+    assert json.load(open(cpath))["traceEvents"]
+
+
+# ------------------------------------------- engine lifecycle tracing
+
+
+def _mixed_wave(engine, prompts, max_new):
+    """Serve with CONCURRENT admission (requests keep arriving while
+    earlier ones decode — the regime where prefill rows interleave
+    decode rows in the ragged step)."""
+    queue = [np.asarray(p) for p in prompts]
+    while queue or engine.num_queued or engine.num_active:
+        while queue and engine.num_queued < 2:
+            engine.submit(queue.pop(0), max_new)
+        if engine.num_queued or engine.num_active:
+            engine.step()
+    done, engine._done = dict(engine._done), {}
+    return done
+
+
+def test_engine_trace_spans_mixed_ragged_wave(llama_tiny):
+    """A mixed wave produces the full span taxonomy — queued spans,
+    admit instants (prefix-hit annotated), prefill-chunk + decode-tick
+    spans on the owning slot's tid, request spans containing them, and
+    engine tick spans with occupancy/fallback args — and the Chrome
+    export is loadable with the documented slot/tid mapping."""
+    rng = np.random.RandomState(3)
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64, prefill_chunk=16))
+    prompts = [rng.randint(1, 128, (n,)) for n in (6, 20, 9, 14)]
+    _mixed_wave(eng, prompts, 5)
+    tr = eng.tracer
+    assert tr is not None
+    evs = tr.events()
+    by_name = {}
+    for e in evs:
+        by_name.setdefault(e["name"].split("[")[0], []).append(e)
+
+    ticks = by_name["tick"]
+    assert ticks and all(e["tid"] == 0 for e in ticks)
+    for e in ticks:
+        assert e["args"]["exec"] == "decode"
+        assert 0.0 <= e["args"]["occupancy"] <= 1.0
+        assert e["args"]["kernel_fallbacks"] == 0      # CPU fallback=0
+        assert e["dur"] > 0
+    decodes = by_name["decode tick"]
+    assert decodes and all(e["tid"] in (1, 2) for e in decodes)
+    assert all(e["args"]["rows"] == 1 for e in decodes)
+    chunks = by_name["prefill chunk"]
+    assert chunks and all(e["tid"] in (1, 2) for e in chunks)
+    admits = by_name["admit"]
+    assert len(admits) == len(prompts)
+    assert all("prefix_hit" in e["args"] for e in admits)
+    queued = [e for e in evs if e["name"].endswith(" queued")]
+    assert len(queued) == len(prompts)
+    assert all(e["tid"] == 3 for e in queued)          # queue tid
+    assert all(e["args"]["outcome"] == "admitted" for e in queued)
+    # request spans contain their slot's per-tick spans (same tid,
+    # time containment — what Perfetto renders as nesting)
+    reqs = {e["name"]: e for e in evs
+            if e["name"].startswith("req")
+            and not e["name"].endswith("queued")}
+    assert len(reqs) == len(prompts)
+    for e in decodes + chunks:
+        rid = e["args"]["rid"]
+        parent = reqs[f"req{rid}"]
+        assert parent["tid"] == e["tid"]
+        assert parent["t0"] <= e["t0"] + 1e-9
+        assert e["t0"] + e["dur"] <= parent["t0"] + parent["dur"] \
+            + 1e-9
+    # the merged Chrome doc loads and only uses the documented tids
+    doc = eng.tracer.chrome_trace()
+    json.dumps(doc)
+    tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] != "M"}
+    assert tids <= {0, 1, 2, 3}
+    eng.shutdown()
+
+
+def test_engine_trace_spec_accepted_len(llama_tiny):
+    """Speculative wave: verify-tick spans carry rows=gamma+1 and the
+    per-window accepted_len the commit actually emitted."""
+    rng = np.random.RandomState(5)
+    phrase = rng.randint(1, 128, (6,))
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64, prefill_chunk=16,
+        num_speculative_tokens=2))
+    eng.serve([np.tile(phrase, 4), np.tile(phrase, 3)],
+              max_new_tokens=6)
+    verifies = [e for e in eng.tracer.events()
+                if e["name"] == "verify tick"]
+    assert verifies
+    for e in verifies:
+        assert e["args"]["rows"] == 3
+        assert 1 <= e["args"]["accepted_len"] <= 3
+    ticks = [e for e in eng.tracer.events() if e["name"] == "tick"]
+    assert all(e["args"]["exec"] == "verify" for e in ticks)
+    eng.shutdown()
+
+
+def test_trace_kill_switch_bit_for_bit_inert(llama_tiny, monkeypatch):
+    """PADDLE_TPU_TRACE=0 leaves the hot path span-free (no tracer on
+    the engine at all) with IDENTICAL tokens, executable counts, and
+    zero steady-state recompiles — and the always-on digests still
+    run."""
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, 128, (n,)) for n in (6, 14, 9)]
+
+    def serve():
+        eng = ServingEngine(llama_tiny, ServingConfig(
+            num_slots=2, block_size=8, max_model_len=64,
+            prefill_chunk=16))
+        outs = eng.serve([p.copy() for p in prompts], max_new_tokens=5)
+        st1 = eng.stats()
+        eng.serve([p.copy() for p in prompts], max_new_tokens=5)
+        st2 = eng.stats()
+        eng.shutdown()
+        return [o.tolist() for o in outs], st1, st2
+
+    on, st_on, _ = serve()
+    monkeypatch.setenv("PADDLE_TPU_TRACE", "0")
+    off, st_off1, st_off2 = serve()
+    assert on == off, "trace kill switch changed served tokens"
+    assert st_off1["tracing"] is False
+    assert st_off1["trace_events"] == 0
+    assert st_on["tracing"] is True and st_on["trace_events"] > 0
+    assert st_off1["executables_compiled"] == \
+        st_on["executables_compiled"] == 1
+    # steady state: the second wave recompiled nothing
+    assert st_off2["executables_compiled"] == 1
+    assert st_off2["decode_compiles"] == st_off1["decode_compiles"]
+    # digests are independent of the trace switch
+    assert st_off2["ttft_ms"]["count"] == 2 * len(prompts)
+
+
+def test_stats_latency_keys_always_present_across_variants(llama_tiny):
+    """fp / int8 / speculative / TP engines all report the four P²
+    latency summaries with the full key set — before AND after
+    traffic."""
+    import jax
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, 128, (n,)) for n in (6, 11)]
+    keys = ("ttft_ms", "itl_ms", "queue_wait_ms", "e2e_ms")
+    subkeys = {"count", "mean", "min", "max", "p50", "p95", "p99"}
+    variants = [{}, {"kv_cache_dtype": "int8"},
+                {"num_speculative_tokens": 2}]
+    if len(jax.devices()) >= 2:
+        variants.append({"tp_degree": 2})
+    for kw in variants:
+        eng = ServingEngine(llama_tiny, ServingConfig(
+            num_slots=2, block_size=8, max_model_len=64,
+            prefill_chunk=16, **kw))
+        st0 = eng.stats()
+        for k in keys:
+            assert set(st0[k]) == subkeys, (kw, k)
+            assert st0[k]["count"] == 0
+        eng.serve([p.copy() for p in prompts], max_new_tokens=4)
+        st = eng.stats()
+        eng.shutdown()
+        assert st["ttft_ms"]["count"] == len(prompts), kw
+        assert st["e2e_ms"]["count"] == len(prompts), kw
+        assert st["itl_ms"]["count"] > 0, kw
+        assert st["queue_wait_ms"]["count"] == len(prompts), kw
+        for k in keys:
+            s = st[k]
+            assert s["min"] - 1e-9 <= s["p50"] <= s["max"] + 1e-9, \
+                (kw, k, s)
+            assert s["p99"] <= s["max"] + 1e-9, (kw, k, s)
+
+
+def test_ttft_digest_matches_client_side_view(llama_tiny):
+    """The engine's TTFT digest must agree with what a streaming
+    client measures (both clock the same _emit moment, so the gap is
+    digest error + callback overhead only)."""
+    import time
+    rng = np.random.RandomState(17)
+    submit_t, first_t = {}, {}
+
+    def cb(rid, tok):
+        first_t.setdefault(rid, time.monotonic())
+
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64,
+        prefill_chunk=16), stream_callback=cb)
+    # warm first so compile time doesn't dominate the distribution
+    eng.serve([rng.randint(1, 128, (8,))], max_new_tokens=2)
+    first_t.clear()
+    for n in (6, 9, 12, 7, 10, 8):
+        rid = eng.submit(rng.randint(1, 128, (n,)), 4)
+        submit_t[rid] = time.monotonic()
+    d0 = eng.stats()["ttft_ms"]["count"]
+    eng.run()
+    st = eng.stats()
+    eng.shutdown()
+    client = np.asarray(sorted(
+        1000.0 * (first_t[r] - submit_t[r]) for r in submit_t))
+    assert st["ttft_ms"]["count"] - d0 == len(client)
+    # engine p50 over the whole digest includes the warmup request;
+    # compare against the client median loosely (digest error bound)
+    eng_p50 = st["ttft_ms"]["p50"]
+    cli_p50 = float(np.median(client))
+    assert abs(eng_p50 - cli_p50) <= max(0.5 * cli_p50, 10.0), \
+        (eng_p50, cli_p50)
+
+
+def test_queue_wait_terminal_outcomes_no_survivor_bias(llama_tiny):
+    """Every queue exit path leaves a labeled observation: admitted,
+    cancelled (new cancel() API), rejected (submit validation), and
+    shutdown (still queued at teardown) — and the engine-local digest
+    counts them all."""
+    h = monitor.histogram("serving_queue_wait_ms", labels=("outcome",))
+
+    def count(outcome):
+        return h.labels(outcome=outcome).value()["count"]
+
+    before = {oc: count(oc) for oc in
+              ("admitted", "cancelled", "rejected", "shutdown")}
+    rng = np.random.RandomState(19)
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=1, block_size=8, max_model_len=64,
+        prefill_chunk=16))
+    r1 = eng.submit(rng.randint(1, 128, (6,)), 3)
+    r2 = eng.submit(rng.randint(1, 128, (7,)), 3)
+    r3 = eng.submit(rng.randint(1, 128, (8,)), 3)
+    assert eng.cancel(r3) is True          # still queued -> removed
+    assert eng.cancel(r3) is False         # already gone
+    with pytest.raises(ValueError, match="empty"):
+        eng.submit([])                     # rejected
+    eng.step()                             # admits r1 (1 slot)
+    assert eng.cancel(r1) is False         # admitted: not cancellable
+    eng.shutdown()                         # r2 still queued
+    assert count("admitted") - before["admitted"] == 1
+    assert count("cancelled") - before["cancelled"] == 1
+    assert count("rejected") - before["rejected"] == 1
+    assert count("shutdown") - before["shutdown"] == 1
+    st = eng.stats()
+    assert st["queue_wait_ms"]["count"] == 4
+    assert r2 not in eng._submit_t         # no leaked bookkeeping
+
+
+# ------------------------------------------------------------ goodput
+
+
+def test_goodput_loadgen_smoke(llama_tiny):
+    """Open- and closed-loop harness at tiny scale: every request
+    completes, the report carries the SLO/goodput keys, and an
+    impossible SLO yields goodput 0 (the metric actually gates)."""
+    from paddle_tpu.inference.loadgen import (SLO, poisson_arrivals,
+                                              run_load,
+                                              uniform_arrivals)
+    rng = np.random.RandomState(23)
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64,
+        prefill_chunk=16))
+    eng.serve([rng.randint(1, 128, (8,))], max_new_tokens=2)  # warm
+    prompts = [rng.randint(1, 128, (6 + (i % 3) * 4,))
+               for i in range(6)]
+    rep = run_load(eng, prompts, qps=200.0, mode="open",
+                   max_new_tokens=4, slo=SLO(ttft_ms=1e5, itl_ms=1e5))
+    assert rep["completed"] == rep["requests"] == len(prompts)
+    assert rep["goodput"] == 1.0
+    assert rep["offered_qps"] == 200.0
+    for k in ("ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
+              "itl_p99_ms", "tpot_p99_ms", "e2e_p99_ms",
+              "achieved_qps", "tokens_per_sec", "wall_s"):
+        assert k in rep and rep[k] >= 0
+    # an impossible SLO scores zero — goodput is a real gate
+    rep0 = run_load(eng, prompts, mode="closed", concurrency=2,
+                    max_new_tokens=4,
+                    slo=SLO(ttft_ms=1e-6, itl_ms=1e-6))
+    assert rep0["completed"] == len(prompts) and rep0["goodput"] == 0.0
+    eng.shutdown()
+    # arrival schedules: monotone, at the requested mean rate
+    arr = poisson_arrivals(500, qps=10.0, seed=0)
+    assert np.all(np.diff(arr) > 0)
+    assert abs(arr[-1] - 50.0) < 15.0      # ~n/qps
+    uni = uniform_arrivals(10, qps=5.0)
+    np.testing.assert_allclose(np.diff(uni), 0.2)
+    with pytest.raises(ValueError, match="qps"):
+        run_load(eng, prompts, mode="open")
+    with pytest.raises(ValueError, match="mode"):
+        run_load(eng, prompts, mode="sideways")
+
+
+# --------------------------------------------------------- prometheus
+
+
+def test_prometheus_text_format_and_mangling():
+    """Counter/gauge/histogram/info render in the exposition format:
+    cumulative le buckets, _sum/_count, label escaping, and the
+    documented name-mangling (bad chars -> _, leading digit
+    prefixed)."""
+    reg = Registry()
+    reg.counter("hits.total", "requests", labels=("fn",)) \
+        .labels(fn='a"b').inc(2)
+    reg.gauge("9depth", "queue depth").set(1.5)
+    h = reg.histogram("lat_ms", "latency", buckets=(1.0, 5.0))
+    h.observe(0.5)
+    h.observe(7.0)
+    reg.info("kern", "last kernel").set({"name": "megablox"})
+    text = reg.prometheus_text()
+    assert "# TYPE hits_total counter" in text
+    assert 'hits_total{fn="a\\"b"} 2' in text
+    assert "# TYPE _9depth gauge" in text and "_9depth 1.5" in text
+    assert "# TYPE lat_ms histogram" in text
+    assert 'lat_ms_bucket{le="1"} 1' in text
+    assert 'lat_ms_bucket{le="5"} 1' in text       # cumulative
+    assert 'lat_ms_bucket{le="+Inf"} 2' in text
+    assert "lat_ms_sum 7.5" in text and "lat_ms_count 2" in text
+    assert "# TYPE kern_info gauge" in text
+    assert "megablox" in text
+    # every line is a comment or `name{labels} value`
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or len(line.rsplit(" ", 1)) == 2
+
+
+def test_prometheus_atexit_twin(tmp_path):
+    """PADDLE_TPU_METRICS_PROM=<path> writes the text exposition at
+    interpreter exit, next to the JSONL export (both from one fresh
+    process)."""
+    prom = tmp_path / "m.prom"
+    env = dict(os.environ,
+               PADDLE_TPU_METRICS_PROM=str(prom),
+               PADDLE_TPU_METRICS_DIR=str(tmp_path),
+               JAX_PLATFORMS="cpu")
+    code = ("from paddle_tpu import monitor; "
+            "monitor.counter('prom_exit_probe', 'x', labels=('k',))"
+            ".labels(k='v').inc(3)")
+    subprocess.run([sys.executable, "-c", code], check=True, env=env,
+                   cwd=os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), timeout=240)
+    text = prom.read_text()
+    assert 'prom_exit_probe{k="v"} 3' in text
+    assert "# TYPE prom_exit_probe counter" in text
+    jsonls = [f for f in os.listdir(tmp_path) if f.endswith(".jsonl")]
+    assert jsonls, "JSONL twin missing"
+
+
+def test_prometheus_dump_of_live_registry(tmp_path, llama_tiny):
+    """monitor.prometheus_dump() renders the REAL process registry —
+    serving histograms come out as cumulative bucket series."""
+    rng = np.random.RandomState(29)
+    eng = ServingEngine(llama_tiny, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=64,
+        prefill_chunk=16))
+    eng.serve([rng.randint(1, 128, (6,))], max_new_tokens=3)
+    eng.shutdown()
+    path = monitor.prometheus_dump(str(tmp_path / "live.prom"))
+    text = open(path).read()
+    assert "# TYPE serving_queue_wait_ms histogram" in text
+    assert 'serving_queue_wait_ms_bucket{outcome="admitted",le="+Inf"}' \
+        in text
+    assert "serving_ttft_ms" in text
+    assert monitor.prometheus_dump(None) is None  # env unset -> no-op
