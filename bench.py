@@ -892,6 +892,169 @@ def _goodput_bench():
     return out
 
 
+def _cluster_bench():
+    """Engine replication + disaggregated prefill (the ISSUE-12 bar):
+    the goodput-bench model behind ``EngineCluster``. Three axes:
+
+    - **1 vs 2 decode replicas** on the mixed-length workload —
+      aggregate tok/s and ``cluster_speedup``. The >= 1.5x bar is the
+      real-hardware expectation (replicas own disjoint chips); on one
+      CPU both replicas time-share the same device so the measured
+      ratio is structure-only, flagged ``cpu_proxy`` (the TP-bench
+      precedent).
+    - **colocated vs disaggregated TTFT p99** under concurrent
+      LONG-PREFILL load (closed loop at full concurrency, long
+      prompts): the disaggregated decode replica's ticks carry no
+      prefill rows and the prefill engine's chunks never wait behind
+      decode batches — the isolation is measurable even on CPU.
+    - **router affinity** on the multi-session conversation workload
+      (``loadgen.conversation_workload``): ``affinity_hit_rate`` from
+      the cluster's own router counters.
+    """
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig
+    from paddle_tpu.inference.cluster import (ClusterConfig,
+                                              EngineCluster)
+    from paddle_tpu.inference.loadgen import (SLO, run_load,
+                                              conversation_workload)
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_CLUSTER_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_CLUSTER_HIDDEN", 2048)),
+        intermediate_size=int(os.environ.get("BENCH_CLUSTER_FFN",
+                                             5632)),
+        num_hidden_layers=int(os.environ.get("BENCH_CLUSTER_LAYERS",
+                                             8)),
+        num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=1024, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_CLUSTER_SLOTS", 4))
+    new = int(os.environ.get("BENCH_CLUSTER_NEW", 32))
+    n_req = int(os.environ.get("BENCH_CLUSTER_REQS", 16))
+    chunk = int(os.environ.get("BENCH_CLUSTER_CHUNK", 128))
+    plens = [32, 64, 96, 160, 128, 48]
+    long_plens = [256, 320, 384, 288]       # the TTFT-isolation regime
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (plens[i % len(plens)],))
+               for i in range(n_req)]
+    long_prompts = [rng.randint(1, cfg.vocab_size,
+                                (long_plens[i % len(long_plens)],))
+                    for i in range(n_req)]
+    scfg = dict(num_slots=slots, block_size=32, max_model_len=512,
+                max_new_tokens=new, prefill_chunk=chunk)
+
+    def mk(replicas, prefill=0):
+        cl = EngineCluster(
+            model, ClusterConfig(num_replicas=replicas,
+                                 prefill_replicas=prefill),
+            ServingConfig(**scfg))
+        # warm every replica: submitted upfront, the depth tiebreak
+        # spreads cold prompts across them, compiling each
+        cl.serve([rng.randint(1, cfg.vocab_size, (p,))
+                  for p in plens * max(replicas, prefill)],
+                 max_new_tokens=4)
+        return cl
+
+    def pump(cl, workload):
+        """Concurrent-admission throughput pump (the serving-bench
+        pattern, cluster-wide): tok/s from the cluster's own token
+        counter over the drain wall-clock."""
+        queue = [p.copy() for p in workload]
+        tokens0 = cl.stats()["tokens_total"]
+        execs0 = cl.stats()["executables_compiled"]
+        t0 = time.perf_counter()
+        while queue or cl.num_queued or cl.num_active:
+            while queue and cl.num_queued < 2 * len(cl.engines):
+                cl.submit(queue.pop(0), new)
+            cl.step()
+        wall = time.perf_counter() - t0
+        st = cl.stats()
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "recompiles_measured":
+                st["executables_compiled"] - execs0,
+            "requests": len(workload),
+        }
+
+    # -- axis 1: 1 vs 2 decode replicas ------------------------------
+    cl1 = mk(1)
+    one = pump(cl1, prompts)
+    cl1.shutdown()
+    cl2 = mk(2)
+    two = pump(cl2, prompts)
+    cl2.shutdown()
+
+    # -- axis 2: colocated vs disaggregated TTFT under long prefills -
+    # equal engine count (2 each) so the split is the only variable:
+    # two colocated replicas vs one decode + one dedicated prefill
+    slo = SLO(ttft_ms=1e9, itl_ms=1e9)      # measuring, not judging
+    ttft = {}
+    for name, (reps, pre) in (("colocated", (2, 0)),
+                              ("disaggregated", (1, 1))):
+        cl = mk(reps, pre)
+        rep = run_load(cl, [p.copy() for p in long_prompts],
+                       mode="closed", max_new_tokens=new, slo=slo)
+        st = cl.stats()
+        cl.shutdown()
+        ttft[name] = {
+            "ttft_p50_ms": rep["ttft_p50_ms"],
+            "ttft_p99_ms": rep["ttft_p99_ms"],
+            "itl_p99_ms": rep["itl_p99_ms"],
+            "tokens_per_sec": rep["tokens_per_sec"],
+            "kv_blocks_transferred": st["kv_blocks_transferred"],
+        }
+
+    # -- axis 3: conversation workload -> router affinity ------------
+    conv, _sids = conversation_workload(
+        4, 3, vocab=cfg.vocab_size, prefix_len=64, turn_len=32,
+        seed=1)
+    cla = mk(2)
+    run_load(cla, conv, mode="closed", max_new_tokens=8, slo=slo)
+    sta = cla.stats()
+    cla.shutdown()
+
+    out = {
+        "one_replica": one,
+        "two_replicas": two,
+        "speedup_tokens_per_sec": round(
+            two["aggregate_tokens_per_sec"]
+            / max(one["aggregate_tokens_per_sec"], 1e-9), 3),
+        "colocated": ttft["colocated"],
+        "disaggregated": ttft["disaggregated"],
+        "disagg_ttft_p99_reduction": round(
+            ttft["colocated"]["ttft_p99_ms"]
+            / max(ttft["disaggregated"]["ttft_p99_ms"], 1e-9), 3),
+        "conversation_affinity_hit_rate":
+            sta["router_affinity_hit_rate"],
+        "conversation_affinity_hits": sta["router_affinity_hits"],
+        "conversation_prefix_tokens_reused":
+            sta["prefix_tokens_reused"],
+        "num_slots": slots, "max_new_tokens": new,
+        "requests": n_req, "workload_prompt_lens": plens,
+        "long_prefill_lens": long_plens,
+        "model_shape": {
+            "hidden": cfg.hidden_size, "layers": cfg.num_hidden_layers,
+            "ffn": cfg.intermediate_size, "vocab": cfg.vocab_size},
+        # one CPU device time-shares all replicas: the speedup arm is
+        # structure-only off-TPU (the >= 1.5x bar is the real-chips
+        # expectation); the TTFT-isolation and affinity axes are
+        # backend-independent
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def _spec_serving_bench():
     """Speculative serving throughput (the ISSUE-4 bar): a mixed-length
     REPETITIVE-text workload (tiled phrases — the prompt-lookup regime:
@@ -1645,6 +1808,10 @@ def main():
     except Exception as exc:
         goodput = {"error": repr(exc)}
     try:
+        cluster = _cluster_bench()
+    except Exception as exc:
+        cluster = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -1664,6 +1831,7 @@ def main():
               "serving_ragged": serving_ragged,
               "kv_quant": kv_quant,
               "goodput": goodput,
+              "cluster": cluster,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -1682,7 +1850,7 @@ def main():
             if k not in ("decode", "serving", "speculative",
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
-                         "flashmask",
+                         "cluster", "flashmask",
                          "moe_profile", "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
@@ -1772,12 +1940,28 @@ def main():
              if isinstance(goodput, dict) else None,
              "itl_p99_ms":
              goodput.get("itl_p99_ms")
-             if isinstance(goodput, dict) else None},
+             if isinstance(goodput, dict) else None,
+             "cluster_tokens_per_sec":
+             cluster.get("two_replicas", {}).get(
+                 "aggregate_tokens_per_sec")
+             if isinstance(cluster, dict) else None,
+             "cluster_speedup":
+             cluster.get("speedup_tokens_per_sec")
+             if isinstance(cluster, dict) else None,
+             "cluster_ttft_p99_ms":
+             cluster.get("disaggregated", {}).get("ttft_p99_ms")
+             if isinstance(cluster, dict) else None,
+             "cluster_affinity_hit_rate":
+             cluster.get("conversation_affinity_hit_rate")
+             if isinstance(cluster, dict) else None},
     }
-    # trajectory contract (ISSUE 11 CI satellite): the goodput SLO
-    # keys must be present in every round's summary — fail loudly if
-    # a refactor drops them instead of silently losing the trend line
-    for k in ("goodput_at_qps", "ttft_p99_ms", "itl_p99_ms"):
+    # trajectory contract (ISSUE 11/12 CI satellites): the goodput SLO
+    # and cluster keys must be present in every round's summary — fail
+    # loudly if a refactor drops them instead of silently losing the
+    # trend line
+    for k in ("goodput_at_qps", "ttft_p99_ms", "itl_p99_ms",
+              "cluster_tokens_per_sec", "cluster_speedup",
+              "cluster_ttft_p99_ms", "cluster_affinity_hit_rate"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

@@ -25,7 +25,12 @@ serve it three ways —
 9. REQUEST TRACING + SLO GOODPUT: serve a concurrent-admission wave,
    dump a Perfetto-loadable Chrome trace of the request lifecycles,
    print the engine's always-on TTFT/ITL p99 digests, and measure
-   goodput under SLO with the closed-loop load generator.
+   goodput under SLO with the closed-loop load generator,
+10. ENGINE REPLICATION + DISAGGREGATED PREFILL: two replicas behind
+    the session-affine router (token-exact vs one engine, affinity
+    hits on a second turn), then a dedicated prefill engine streaming
+    finished KV blocks into the decode replica's pool — still
+    token-exact.
 
     python examples/llm_serving.py --tiny
 """
@@ -306,6 +311,51 @@ def main(argv=None):
           f"{st9['ttft_ms']['p99']:.1f} ms, ITL p99 "
           f"{st9['itl_ms']['p99']:.1f} ms over "
           f"{st9['trace_events']} trace events -> {trace_path}")
+
+    # ---- 10. engine replication + disaggregated prefill -> decode
+    # Two routed replicas: a session's second turn lands on the
+    # replica that published its first turn's blocks (the router and
+    # admission share ONE prompt->hash walk), token-exact vs a single
+    # engine. Then a disaggregated cluster: a dedicated prefill engine
+    # streams each finished prompt's KV blocks into the decode
+    # replica's pool — still token-exact. Kill switch:
+    # PADDLE_TPU_CLUSTER=0 (one plain engine behind the cluster API).
+    from paddle_tpu.inference import ClusterConfig, EngineCluster
+    ref_eng = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96,
+        prefill_chunk=16))
+    ref10 = ref_eng.serve(list(prompts), max_new_tokens=6)
+    ref_eng.shutdown()
+    cluster = EngineCluster(
+        model, ClusterConfig(num_replicas=2),
+        ServingConfig(num_slots=2, block_size=8, max_model_len=96,
+                      prefill_chunk=16))
+    got10 = cluster.serve(list(prompts), max_new_tokens=6)
+    # turn 2 of "session 0": same prompt + a tail -> affine route
+    turn2 = np.concatenate([prompts[0], got10[0][:2]])
+    cluster.serve([turn2], max_new_tokens=4)
+    stc = cluster.stats()
+    for a, b in zip(got10, ref10):
+        assert a.tolist() == b.tolist(), \
+            "cluster diverged from the single engine"
+    assert stc["router_affinity_hits"] >= 1
+    cluster.shutdown()
+    disagg = EngineCluster(
+        model, ClusterConfig(num_replicas=1, prefill_replicas=1),
+        ServingConfig(num_slots=2, block_size=8, max_model_len=96,
+                      prefill_chunk=16))
+    got10d = disagg.serve(list(prompts), max_new_tokens=6)
+    std = disagg.stats()
+    for a, b in zip(got10d, ref10):
+        assert a.tolist() == b.tolist(), \
+            "disaggregated prefill->decode diverged from colocated"
+    assert std["kv_blocks_transferred"] > 0
+    disagg.shutdown()
+    print(f"cluster: N=2 token-exact, affinity hits "
+          f"{stc['router_affinity_hits']} (hit rate "
+          f"{stc['router_affinity_hit_rate']:.2f}); disaggregated "
+          f"token-exact with {std['kv_blocks_transferred']} KV "
+          f"blocks streamed prefill->decode")
     return n_ok / 12.0, losses
 
 

@@ -21,6 +21,7 @@ import numpy as np
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "GenerationPredictor", "create_generation_predictor",
            "ServingConfig", "ServingEngine", "ServingRequest",
+           "ClusterConfig", "EngineCluster", "Router",
            "SLO", "run_load",
            "PrecisionType", "PlaceType", "get_version"]
 
@@ -28,11 +29,16 @@ __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
 def __getattr__(name):
     # lazy: the serving engine pulls in jax/model machinery that plain
     # Predictor users never need
-    if name in ("ServingConfig", "ServingEngine", "ServingRequest"):
+    if name in ("ServingConfig", "ServingEngine", "ServingRequest",
+                "PrefilledRequest"):
         from . import serving
         return getattr(serving, name)
+    if name in ("ClusterConfig", "EngineCluster", "Router"):
+        from . import cluster
+        return getattr(cluster, name)
     if name in ("SLO", "RequestRecord", "run_load", "summarize",
-                "poisson_arrivals", "uniform_arrivals"):
+                "poisson_arrivals", "uniform_arrivals",
+                "conversation_workload"):
         from . import loadgen
         return getattr(loadgen, name)
     raise AttributeError(name)

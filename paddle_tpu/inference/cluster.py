@@ -1,0 +1,640 @@
+"""Data-parallel engine replication: N ``ServingEngine`` replicas
+behind a session-affine router, with optional disaggregated
+prefill -> decode KV streaming.
+
+TP (``ServingConfig(tp_degree=N)``) scales ONE engine across an ``mp``
+mesh group; this is the layer above it — the ROADMAP's "millions of
+users" unlock: aggregate capacity past a single replica, TTFT isolated
+from decode ticks, and a fault domain smaller than the fleet. Per
+GSPMD (arxiv 2105.04663) the mesh side is solved; the router/affinity/
+role-split layer here is host-side scheduling over engines that never
+talk to each other's devices except through the block-transfer ops.
+
+- **Session-affine routing.** ``submit()`` hashes the prompt's FULL
+  blocks with the same chain-hash walk engine admission uses
+  (``ops/paged_cache.prompt_block_hashes`` — factored out so router
+  and engine can NEVER hash differently) and scores every live
+  candidate replica by published-prefix overlap: the longest cached
+  run wins, because that replica already holds the session's KV blocks
+  and will prefill only the suffix. Ties (cold prompts included) break
+  on queue depth (queued + active, the PR 2/11 telemetry), then on
+  replica index — so multi-turn conversations stick to "their" replica
+  while cold traffic load-balances. An overlap > 0 route counts as a
+  ``serving_router_affinity_hits`` event; per-candidate depths land in
+  the ``serving_router_queue_depth{replica=}`` gauge each route.
+- **Disaggregated prefill -> decode** (``ClusterConfig(
+  prefill_replicas=K)``): K role="prefill" engines run admission +
+  chunked prefill ONLY (reserving only the prompt's blocks, so the
+  prefill tier admits aggressively), then stream each finished
+  prompt's KV blocks into a decode replica's pool —
+  ``pop_prefilled()`` exports the blocks (one fixed-width gather
+  executable; int8 pools travel as data + per-row scales, so a
+  block's bytes are self-contained) and ``admit_prefilled()`` imports
+  them (one fixed-width scatter, null-block padding, zero steady-state
+  recompiles on either side). The decode replica seats the request at
+  exactly the state a colocated engine holds after its own prefill,
+  so greedy output is token-exact vs colocated by construction. The
+  win is ISOLATION: decode ticks never share a launch with prefill
+  rows (long prompts stop inflating every running request's ITL), and
+  prefill chunks never wait behind decode batches (TTFT under
+  concurrent long-prefill load). Routing in this mode targets the
+  prefill tier (that is where the prefix caches fill — a handoff
+  publishes the prompt's blocks before freeing them, so the session's
+  next turn hits the same prefill engine's index).
+- **Failure domain.** A replica whose ``step()`` raises (or an
+  administrative ``fail_replica(i)``) drains its admission queue back
+  through the router onto the surviving replicas — global request ids
+  are preserved, the re-routed requests just prefill again elsewhere.
+  In-flight slots on the failed replica terminate with the tokens
+  already streamed (partial results, surfaced through ``run()``
+  normally). A fully-failed prefill tier falls back to the decode
+  replicas serving end-to-end (they are full engines); a fully-failed
+  DECODE tier is fatal for new work (prefill engines cannot decode:
+  new submits raise, in-flight requests terminate with what
+  streamed). The cluster raises on submit only when no replica that
+  could serve the request survives.
+- **Kill switch** ``PADDLE_TPU_CLUSTER=0``: the cluster collapses to
+  ONE colocated replica (``num_replicas=1, prefill_replicas=0``)
+  regardless of config — the single engine underneath is bit-for-bit
+  a plain ``ServingEngine`` (same executables, same outputs), the
+  router degenerates to the identity, and no transfer executable is
+  ever built. Rollback is one env var, like every switch in this
+  repo.
+
+Every replica is a full ``ServingEngine`` — prefix cache, COW,
+speculative n-gram decoding, ragged batching, int8 pools and TP all
+compose per replica unchanged (host state stays per-engine: one
+allocator, one scheduler, one prefix index each). Greedy cluster
+output is token-exact vs a single engine for every request (replicas
+never interact mid-request), which is what makes N replicas a pure
+capacity knob.
+
+Telemetry: ``serving_router_affinity_hits`` /
+``serving_router_queue_depth{replica=}`` here,
+``serving_kv_blocks_transferred`` at the engine import site;
+``stats()`` returns per-replica dicts plus rolled-up client-side
+``ttft_ms`` / ``itl_ms`` / ``e2e_ms`` digests (P², observed at the
+cluster's own stream callback — the view a client of the WHOLE
+cluster sees, handoff gaps included) and the goodput-harness keys
+(``tokens_total``, ``requests_completed``, queue/active depths).
+See docs/OPS.md "Engine replication & disaggregated prefill".
+"""
+from __future__ import annotations
+
+import os
+import time
+import warnings
+from dataclasses import dataclass, replace as _dc_replace
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import monitor
+from ..monitor.digest import LatencyDigest
+from ..ops import paged_cache as _pc
+from .serving import PrefilledRequest, ServingConfig, ServingEngine
+
+__all__ = ["ClusterConfig", "Router", "EngineCluster"]
+
+
+def cluster_enabled() -> bool:
+    """False under the ``PADDLE_TPU_CLUSTER=0`` kill switch — the
+    cluster then runs ONE colocated replica (a plain engine behind the
+    cluster API), never N, never disaggregated."""
+    return os.environ.get("PADDLE_TPU_CLUSTER", "1") != "0"
+
+
+@dataclass
+class ClusterConfig:
+    # decode-capable replicas (role="both" colocated, role="decode"
+    # when a prefill tier exists). Aggregate slot capacity is
+    # num_replicas * ServingConfig.num_slots.
+    num_replicas: int = 2
+    # > 0: disaggregated mode — this many role="prefill" engines run
+    # admission + chunked prefill only and stream finished KV blocks
+    # into the decode replicas' pools (export_blocks/import_blocks).
+    prefill_replicas: int = 0
+
+    def __post_init__(self):
+        n = self.num_replicas
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            raise ValueError(
+                f"num_replicas must be a positive int, got {n!r}")
+        k = self.prefill_replicas
+        if not isinstance(k, int) or isinstance(k, bool) or k < 0:
+            raise ValueError(
+                f"prefill_replicas must be an int >= 0, got {k!r}")
+
+
+class _MemoHashes:
+    """Re-iterable memoizing view over the lazy ``prompt_block_hashes``
+    walk: each replica probe re-iterates from the start, but a hash is
+    computed at most once — so N cold replicas cost ONE block hash
+    total (every probe stops at hash[0]), and the whole route costs
+    ``max(overlap) + 1`` hashes, never O(prompt)."""
+
+    __slots__ = ("_it", "_memo", "_done")
+
+    def __init__(self, it):
+        self._it = it
+        self._memo = []
+        self._done = False
+
+    def __iter__(self):
+        i = 0
+        while True:
+            if i == len(self._memo) and not self._done:
+                try:
+                    self._memo.append(next(self._it))
+                except StopIteration:
+                    self._done = True
+            if i >= len(self._memo):
+                return
+            yield self._memo[i]
+            i += 1
+
+
+class Router:
+    """Session-affine replica scoring. ``route()`` hashes the prompt
+    with ``prompt_block_hashes`` — the exact walk engine admission
+    runs, so a router hit IS an admission hit — lazily and memoized
+    across the per-replica probes (a cache-cold fleet hashes ONE
+    block, not the prompt), and asks every candidate engine for its
+    published-prefix overlap; the longest cached run wins, ties break
+    on queue depth then index. Pure scoring — metrics/bookkeeping
+    live on the cluster."""
+
+    def __init__(self, fingerprint: bytes, block_size: int):
+        self._fp = bytes(fingerprint)
+        self._bs = int(block_size)
+
+    def route(self, prompt,
+              engines: Dict[int, ServingEngine]
+              ) -> Tuple[int, int, Dict[int, int]]:
+        """Pick a replica for ``prompt`` among ``engines`` (index ->
+        engine). Returns ``(index, overlap_blocks, depths)`` where
+        ``depths`` is every candidate's queued + active count at
+        scoring time."""
+        if not engines:
+            raise ValueError("route() needs at least one candidate")
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        hashes = _MemoHashes(
+            _pc.prompt_block_hashes(self._fp, ids, self._bs))
+        best = None
+        depths = {}
+        for idx, eng in engines.items():
+            ov = eng.published_overlap(hashes)
+            depth = eng.num_queued + eng.num_active
+            depths[idx] = depth
+            key = (ov, -depth, -idx)    # longest run, then least
+            if best is None or key > best[0]:   # loaded, then lowest i
+                best = (key, idx, ov)
+        return best[1], best[2], depths
+
+
+class EngineCluster:
+    """N serving-engine replicas behind a session-affine router (+
+    optional disaggregated prefill tier). The public surface mirrors
+    ``ServingEngine`` — ``submit`` / ``step`` / ``run`` / ``serve`` /
+    ``cancel`` / ``stats`` / ``shutdown`` / ``num_active`` /
+    ``num_queued`` — so the loadgen harness, benches and applications
+    drive either interchangeably. Request ids are CLUSTER-global;
+    tokens stream through ``stream_callback(rid, tok)`` exactly like
+    the engine's.
+
+    Usage::
+
+        cluster = EngineCluster(model, ClusterConfig(num_replicas=2),
+                                ServingConfig(num_slots=8))
+        rid = cluster.submit(prompt)
+        results = cluster.run()         # {rid: np.ndarray of tokens}
+    """
+
+    def __init__(self, model, config: Optional[ClusterConfig] = None,
+                 serving_config: Optional[ServingConfig] = None,
+                 stream_callback: Optional[Callable] = None,
+                 draft_model=None):
+        ccfg = config or ClusterConfig()
+        scfg = serving_config or ServingConfig()
+        if not cluster_enabled():       # PADDLE_TPU_CLUSTER=0
+            ccfg = ClusterConfig(num_replicas=1, prefill_replicas=0)
+        self.config = ccfg
+        self.serving_config = scfg
+        self._disagg = ccfg.prefill_replicas > 0
+        if self._disagg and draft_model is not None:
+            raise NotImplementedError(
+                "disaggregated mode cannot serve a draft model yet: "
+                "the draft pool's prompt K/V is not part of the "
+                "prefill->decode transfer payload (the target pool "
+                "is) — use n-gram speculation or colocated replicas")
+        self._stream = stream_callback
+        self._engines: List[ServingEngine] = []
+        self._decode_idx: List[int] = []
+        self._prefill_idx: List[int] = []
+        decode_role = "decode" if self._disagg else "both"
+        dkw = {"role": decode_role, "retain_results": True}
+        # retain_results forced on: a replica's _done dict is the
+        # cluster's completion signal (popped every tick, so a
+        # long-lived cluster still never accumulates results)
+        if self._disagg and scfg.ragged_prefill_rows is None:
+            # a disaggregated decode replica never chunk-prefills (all
+            # its admissions arrive via admit_prefilled), so the
+            # default one-chunk prefill row budget would ride every
+            # ragged launch as DEAD static width — shrink it to the
+            # minimum unless the caller pinned a value
+            dkw["ragged_prefill_rows"] = 1
+        for _ in range(ccfg.num_replicas):
+            idx = len(self._engines)
+            self._engines.append(ServingEngine(
+                model, _dc_replace(scfg, **dkw),
+                stream_callback=self._make_cb(idx),
+                draft_model=draft_model))
+            self._decode_idx.append(idx)
+        for _ in range(ccfg.prefill_replicas):
+            idx = len(self._engines)
+            # speculation is a decode feature: the prefill tier runs
+            # gamma=0 (n-gram spec composes on the decode replicas —
+            # its history is the prompt + first token, both in the
+            # handoff), and the transfer width is gamma-independent
+            # (_mb_xfer) so the payloads still shape-match
+            self._engines.append(ServingEngine(
+                model, _dc_replace(scfg, role="prefill",
+                                   retain_results=True,
+                                   num_speculative_tokens=0),
+                stream_callback=self._make_cb(idx)))
+            self._prefill_idx.append(idx)
+        self._router = Router(_pc.model_fingerprint(model),
+                              int(scfg.block_size))
+        self._next_rid = 0              # cluster-global request ids
+        self._l2g: Dict[tuple, int] = {}    # (engine, local) -> global
+        self._owner: Dict[int, tuple] = {}  # global -> (engine, local)
+        self._tokens: Dict[int, list] = {}
+        self._done: Dict[int, np.ndarray] = {}
+        # handoffs exported from a prefill engine, waiting for decode
+        # capacity: (src_engine_idx, PrefilledRequest)
+        self._pending: List[Tuple[int, PrefilledRequest]] = []
+        self._failed = set()
+        self._tick_buf: List[tuple] = []
+        self._n_routed = 0
+        self._n_affinity = 0
+        self._n_completed = 0
+        # client-side rolled-up latency digests: observed at THE
+        # cluster's own stream boundary, so a disaggregated handoff's
+        # gap lands in the ITL digest like a client would see it
+        self._submit_t: Dict[int, float] = {}
+        self._last_emit: Dict[int, float] = {}
+        self._d_ttft = LatencyDigest()
+        self._d_itl = LatencyDigest()
+        self._d_e2e = LatencyDigest()
+        self._m_affinity = monitor.counter(
+            "serving_router_affinity_hits",
+            "requests the cluster router placed on a replica already "
+            "holding >= 1 of the prompt's prefix blocks (session "
+            "affinity working)")
+        self._m_depth = monitor.gauge(
+            "serving_router_queue_depth",
+            "per-replica queued + active depth at the router's last "
+            "scoring pass", labels=("replica",))
+
+    # -- public API ---------------------------------------------------
+
+    @property
+    def engines(self) -> List[ServingEngine]:
+        """All replicas, decode tier first (read-only introspection —
+        tests, benches, dashboards)."""
+        return list(self._engines)
+
+    @property
+    def num_active(self) -> int:
+        return sum(self._engines[i].num_active
+                   for i in self._live()) + len(self._pending)
+
+    @property
+    def num_queued(self) -> int:
+        return sum(self._engines[i].num_queued for i in self._live())
+
+    @property
+    def num_slots(self) -> int:
+        """Aggregate DECODE slot capacity (the loadgen closed-loop
+        concurrency default)."""
+        return sum(self._engines[i].config.num_slots
+                   for i in self._decode_idx if i not in self._failed)
+
+    def submit(self, prompt, max_new_tokens=None) -> int:
+        """Route one request to a replica (prefill tier when
+        disaggregated) and queue it there; returns the CLUSTER-global
+        request id tokens stream under."""
+        ids = np.asarray(prompt, np.int32).reshape(-1)
+        if self._disagg:
+            # mirror engine.submit()'s pool-fit rejection for the
+            # DECODE side: the prefill tier reserves only prompt
+            # blocks, so without this check a request whose decode
+            # reservation can never fit any decode pool would prefill,
+            # export, and then sit as a forever-pending handoff
+            # (run() would never drain)
+            live = [i for i in self._decode_idx
+                    if i not in self._failed]
+            if not live:
+                raise RuntimeError(
+                    "all decode replicas failed: a disaggregated "
+                    "cluster's prefill tier cannot decode, so new "
+                    "requests cannot be served (in-flight ones "
+                    "terminate with the tokens already streamed)")
+            de = self._engines[live[0]]
+            max_new = int(de.config.max_new_tokens
+                          if max_new_tokens is None
+                          else max_new_tokens)
+            worst = de._worst_for(ids.size, max_new)
+            cap = max(self._engines[i]._alloc.num_blocks - 1
+                      for i in live)
+            if worst > cap:
+                raise ValueError(
+                    f"request needs {worst} blocks on a decode "
+                    f"replica; the largest live decode pool has "
+                    f"only {cap}")
+        rid = self._next_rid
+        self._route_submit(rid, ids, max_new_tokens)
+        self._next_rid += 1
+        self._tokens[rid] = []
+        self._submit_t[rid] = time.monotonic()
+        return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a request still waiting in its replica's admission
+        queue (same semantics as ``ServingEngine.cancel``)."""
+        owner = self._owner.get(request_id)
+        if owner is None:
+            return False
+        idx, lrid = owner
+        if not self._engines[idx].cancel(lrid):
+            return False
+        self._l2g.pop((idx, lrid), None)
+        self._owner.pop(request_id, None)
+        self._tokens.pop(request_id, None)
+        self._submit_t.pop(request_id, None)
+        self._last_emit.pop(request_id, None)
+        return True
+
+    def step(self) -> List[tuple]:
+        """One cluster tick: advance every prefill engine and stream
+        its finished prompts' KV blocks into decode replicas, then
+        advance every decode replica. Returns this tick's
+        ``[(request_id, token), ...]`` across the whole cluster."""
+        self._tick_buf = []
+        for i in list(self._prefill_idx):
+            if i in self._failed:
+                continue
+            eng = self._engines[i]
+            if eng.num_queued or eng.num_active:
+                self._safe_step(i)
+            if i not in self._failed:
+                for rec in eng.pop_prefilled():
+                    self._pending.append((i, rec))
+        self._place_handoffs()
+        for i in list(self._decode_idx):
+            if i in self._failed:
+                continue
+            eng = self._engines[i]
+            if eng.num_queued or eng.num_active:
+                self._safe_step(i)
+        self._collect_done()
+        return self._tick_buf
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive ``step()`` until every replica drains; returns (and
+        clears) the tokens of every request completed since the last
+        ``run()``, keyed by cluster-global request id."""
+        while self.num_queued or self.num_active:
+            self.step()
+        done, self._done = self._done, {}
+        return done
+
+    def serve(self, prompts, max_new_tokens=None) -> List[np.ndarray]:
+        """Batch convenience: submit all, run to completion, return
+        token arrays in submission order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        done = self.run()
+        return [done[r] for r in rids]
+
+    def fail_replica(self, index: int):
+        """Administratively fail one replica (also invoked when its
+        ``step()`` raises): its admission queue drains back through
+        the router onto the surviving replicas — global request ids
+        are preserved, the requests simply prefill again elsewhere —
+        and its in-flight requests terminate with the tokens already
+        streamed (partial results, returned by ``run()`` normally).
+        Raises RuntimeError when no replica survives to take the
+        drained queue."""
+        if index in self._failed:
+            return
+        self._failed.add(index)
+        eng = self._engines[index]
+        requeue = []
+        for req in list(eng._queue):
+            g = self._l2g.pop((index, req.request_id), None)
+            eng.cancel(req.request_id)      # terminal queue-wait obs
+            if g is not None:
+                requeue.append((g, req.prompt, req.max_new_tokens))
+        for slot in eng._slots:
+            if slot is None:
+                continue
+            # pop the mapping: the failed engine never emits again
+            # (already-exported handoffs are NOT in _slots — their
+            # payloads survive and still place into decode replicas)
+            g = self._l2g.pop((index, slot.rid), None)
+            if g is not None:
+                self._finish(g)             # partial result
+        for g, prompt, max_new in requeue:
+            self._route_submit(g, prompt, max_new)
+
+    def stats(self) -> dict:
+        """Cluster-aggregate snapshot: per-replica ``stats()`` dicts
+        under ``replicas`` plus rolled-up routing / transfer /
+        throughput / latency keys (the client-side view across the
+        whole cluster — the goodput harness's denominators)."""
+        reps = [e.stats() for e in self._engines]
+        return {
+            "num_replicas": len(self._decode_idx),
+            "prefill_replicas": len(self._prefill_idx),
+            "disaggregated": self._disagg,
+            "cluster_enabled": cluster_enabled(),
+            "failed_replicas": sorted(self._failed),
+            "active": self.num_active,
+            "queued": self.num_queued,
+            "pending_handoffs": len(self._pending),
+            "router_requests": self._n_routed,
+            "router_affinity_hits": self._n_affinity,
+            "router_affinity_hit_rate":
+                self._n_affinity / self._n_routed
+                if self._n_routed else 0.0,
+            "kv_blocks_transferred":
+                sum(r["kv_blocks_imported"] for r in reps),
+            "prefix_tokens_reused":
+                sum(r["prefix_tokens_reused"] for r in reps),
+            "tokens_total": sum(r["tokens_total"] for r in reps),
+            "requests_completed": self._n_completed,
+            "decode_steps": sum(r["decode_steps"] for r in reps),
+            "executables_compiled":
+                sum(r["executables_compiled"] for r in reps),
+            "ttft_ms": self._d_ttft.summary(),
+            "itl_ms": self._d_itl.summary(),
+            "e2e_ms": self._d_e2e.summary(),
+            "replicas": reps,
+        }
+
+    def shutdown(self, check_leaks: bool = True) -> bool:
+        """Drain every replica's queue (terminal queue-wait
+        observations) and sweep every allocator's free/cached/
+        referenced partition — the per-replica leak check, fleet-wide.
+        Failed replicas are swept too (their blocks were never freed
+        by the drain, so live-slot blocks are passed as expected)."""
+        for eng in self._engines:
+            eng.shutdown(check_leaks=check_leaks)
+        return True
+
+    # -- internals ----------------------------------------------------
+
+    def _live(self):
+        return [i for i in range(len(self._engines))
+                if i not in self._failed]
+
+    def _make_cb(self, idx):
+        def cb(lrid, tok):
+            g = self._l2g.get((idx, lrid))
+            if g is not None:
+                self._on_token(g, tok)
+        return cb
+
+    def _on_token(self, g, tok):
+        now = time.monotonic()
+        prev = self._last_emit.get(g)
+        if prev is None:
+            t0 = self._submit_t.get(g)
+            if t0 is not None:
+                self._d_ttft.observe(1000.0 * (now - t0))
+        else:
+            self._d_itl.observe(1000.0 * (now - prev))
+        self._last_emit[g] = now
+        rec = self._tokens.get(g)
+        if rec is not None:
+            rec.append(int(tok))
+        self._tick_buf.append((g, int(tok)))
+        if self._stream is not None:
+            self._stream(g, int(tok))
+
+    def _route_submit(self, g, prompt, max_new_tokens):
+        """Score candidates, submit to the winner, and map its local
+        rid to the global one — shared by ``submit()`` and the
+        failure-drain requeue (which must preserve ``g``)."""
+        tier = self._prefill_idx if self._disagg else self._decode_idx
+        cands = {i: self._engines[i] for i in tier
+                 if i not in self._failed}
+        if not cands and self._disagg:
+            # the whole prefill tier failed: decode replicas are full
+            # engines (they prefill their own admissions), so a
+            # healthy decode tier keeps serving end-to-end — the
+            # cluster only dies when NO replica survives
+            cands = {i: self._engines[i] for i in self._decode_idx
+                     if i not in self._failed}
+        if not cands:
+            raise RuntimeError(
+                "no live replicas to route to "
+                f"({len(self._failed)} of {len(self._engines)} "
+                "failed)")
+        if len(cands) == 1:
+            # identity route (kill switch / N=1 / last survivor):
+            # skip the per-block prompt hashing — there is nothing to
+            # choose between, so affinity is meaningless here
+            idx, overlap, depths = next(iter(cands)), 0, {}
+        else:
+            idx, overlap, depths = self._router.route(prompt, cands)
+        # submit FIRST: a validation rejection must not skew the
+        # router counters (the hit rate is an acceptance metric)
+        lrid = self._engines[idx].submit(prompt, max_new_tokens)
+        for i, d in depths.items():
+            self._m_depth.labels(replica=str(i)).set(d)
+        self._n_routed += 1
+        if overlap > 0:
+            self._n_affinity += 1
+            self._m_affinity.inc()
+        self._l2g[(idx, lrid)] = g
+        self._owner[g] = (idx, lrid)
+
+    def _place_handoffs(self):
+        """Import pending prefilled requests into decode replicas,
+        least-loaded first; a handoff that finds no capacity stays
+        pending for the next tick (its blocks are already freed on the
+        prefill engine — the payload carries the bytes)."""
+        still = []
+        for src, rec in self._pending:
+            live = [i for i in self._decode_idx
+                    if i not in self._failed]
+            if not live:
+                # the whole decode tier failed: a prefill engine
+                # cannot decode, so nothing can continue this request
+                # — terminate it with the tokens already streamed
+                # (the first token) instead of stranding run() or
+                # raising past a healthy prefill tier; submit()
+                # rejects new disaggregated requests in this state
+                warnings.warn(
+                    "all decode replicas failed; terminating "
+                    f"prefilled request {rec.request_id} with the "
+                    "tokens already streamed")
+                g = self._l2g.pop((src, rec.request_id), None)
+                if g is not None:
+                    self._finish(g)
+                continue
+            g = self._l2g.get((src, rec.request_id))
+            if g is None:       # cancelled/failed upstream: drop
+                continue
+            placed = False
+            for i in sorted(live, key=lambda j:
+                            self._engines[j].num_active
+                            + self._engines[j].num_queued):
+                drid = self._engines[i].admit_prefilled(rec)
+                if drid is not None:
+                    self._l2g.pop((src, rec.request_id), None)
+                    self._l2g[(i, drid)] = g
+                    self._owner[g] = (i, drid)
+                    placed = True
+                    break
+            if not placed:
+                still.append((src, rec))
+        self._pending = still
+
+    def _safe_step(self, idx):
+        try:
+            self._engines[idx].step()
+        except Exception as exc:        # noqa: BLE001 — fault domain
+            warnings.warn(
+                f"cluster replica {idx} failed mid-step ({exc!r}); "
+                "draining its queue back to the router")
+            self.fail_replica(idx)
+            if not self._live():
+                raise
+
+    def _collect_done(self):
+        """Completion signal: a request is done when the replica that
+        owns its tail retires it (``_done`` populated under
+        ``retain_results``). Token content comes from the CLUSTER's
+        own stream records, so a disaggregated request's first token
+        (prefill engine) and continuation (decode replica) splice into
+        one result."""
+        for idx, eng in enumerate(self._engines):
+            if not eng._done:
+                continue
+            for lrid in list(eng._done):
+                eng._done.pop(lrid)
+                g = self._l2g.pop((idx, lrid), None)
+                if g is not None:
+                    self._finish(g)
+
+    def _finish(self, g):
+        now = time.monotonic()
+        t0 = self._submit_t.pop(g, None)
+        if t0 is not None:
+            self._d_e2e.observe(1000.0 * (now - t0))
+        self._last_emit.pop(g, None)
+        self._owner.pop(g, None)
+        self._done[g] = np.asarray(self._tokens.pop(g, []), np.int64)
+        self._n_completed += 1

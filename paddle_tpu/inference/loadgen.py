@@ -1,4 +1,9 @@
-"""Closed-loop SLO / goodput load generator for ``ServingEngine``.
+"""Closed-loop SLO / goodput load generator for ``ServingEngine`` —
+or ANY engine-shaped target: the harness only needs ``submit(prompt,
+max_new_tokens)`` / ``step()`` / ``num_queued`` / ``num_active`` / a
+chainable ``_stream`` callback slot, which ``EngineCluster``
+(``inference/cluster.py``) implements too, so the same workload
+measures one replica or a whole routed fleet unchanged.
 
 The harness every serving feature proves itself against (ROADMAP "an
 async serving front door ... closed-loop load-generator measuring
@@ -34,7 +39,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["SLO", "RequestRecord", "poisson_arrivals",
-           "uniform_arrivals", "run_load", "summarize"]
+           "uniform_arrivals", "run_load", "summarize",
+           "conversation_workload"]
 
 
 @dataclass
@@ -95,23 +101,57 @@ def uniform_arrivals(n: int, qps: float) -> np.ndarray:
     return (1.0 + np.arange(n)) / float(qps)
 
 
+def conversation_workload(n_sessions: int, turns: int, *,
+                          vocab: int = 1000, prefix_len: int = 32,
+                          turn_len: int = 8, seed: int = 0):
+    """Multi-session CONVERSATION workload: each session's turn ``t``
+    prompt is its turn ``t-1`` prompt plus a fresh user chunk (same
+    session id -> same growing prefix), interleaved round-robin across
+    sessions (session 0 turn 0, session 1 turn 0, ..., session 0
+    turn 1, ...) so later turns arrive after earlier ones had a chance
+    to retire and publish their blocks.
+
+    This is the workload that actually EXERCISES prefix caching and
+    cluster session affinity under load: a later turn's leading blocks
+    hash-hit the engine (or the routed replica) that served the
+    previous turn, while round-robin interleaving keeps every replica
+    busy. Returns ``(prompts, session_ids)`` — a flat prompt list in
+    arrival order plus each prompt's session id (tests assert
+    per-session replica stickiness with it)."""
+    rng = np.random.RandomState(seed)
+    convo = [rng.randint(1, vocab, (prefix_len,)).astype(np.int32)
+             for _ in range(n_sessions)]
+    prompts, session_ids = [], []
+    for _t in range(turns):
+        for s in range(n_sessions):
+            convo[s] = np.concatenate(
+                [convo[s],
+                 rng.randint(1, vocab, (turn_len,)).astype(np.int32)])
+            prompts.append(convo[s].copy())
+            session_ids.append(s)
+    return prompts, session_ids
+
+
 def run_load(engine, prompts: Sequence[np.ndarray], *,
              qps: Optional[float] = None, mode: str = "open",
              concurrency: Optional[int] = None,
              max_new_tokens: Optional[int] = None,
              slo: Optional[SLO] = None, arrival: str = "poisson",
              seed: int = 0) -> dict:
-    """Serve ``prompts`` through ``engine`` under a timed arrival
+    """Serve ``prompts`` through ``engine`` — a ``ServingEngine`` OR
+    any object with the same ``submit/step/num_queued/num_active/
+    _stream`` surface (``EngineCluster``) — under a timed arrival
     process and return the goodput report (:func:`summarize`).
 
     ``mode="open"`` (requires ``qps``): requests are submitted when
     their scheduled arrival time passes, independent of engine
-    progress. ``mode="closed"`` (``concurrency``, default
-    ``num_slots``): a fixed number in flight, each completion admits
-    the next — reported ``achieved_qps`` is then the engine's capacity
-    at that concurrency.
+    progress. ``mode="closed"`` (``concurrency``, default the
+    target's slot capacity — a cluster's aggregate decode slots): a
+    fixed number in flight, each completion admits the next —
+    reported ``achieved_qps`` is then the target's capacity at that
+    concurrency.
 
-    The engine's ``stream_callback`` is chained, not replaced: an
+    The target's ``stream_callback`` is chained, not replaced: an
     application callback installed at construction still fires.
     """
     if mode not in ("open", "closed"):
@@ -136,8 +176,18 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
             if arrival == "poisson" else uniform_arrivals(n, qps)
     else:
         offsets = np.zeros(n)
-        concurrency = int(concurrency
-                          or engine.config.num_slots)
+        # slot capacity of the target: a cluster exposes its aggregate
+        # decode slots as a num_slots property; a plain engine carries
+        # the count on its config (a ClusterConfig has neither — e.g.
+        # a cluster whose decode tier fully failed reports 0 — so
+        # fail with the actual problem, not an AttributeError)
+        cap = (concurrency or getattr(engine, "num_slots", 0)
+               or getattr(engine.config, "num_slots", 0))
+        if not cap:
+            raise ValueError(
+                "closed-loop mode needs a concurrency: the target "
+                "reports no slot capacity")
+        concurrency = int(cap)
 
     engine._stream = _record
     t_start = time.monotonic()

@@ -129,10 +129,27 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   ``PADDLE_TPU_SERVE_TP=0`` restores the single-device path
   bit-for-bit. See docs/OPS.md "Tensor-parallel serving".
 
+- **Disaggregated prefill -> decode** (``ServingConfig(role=
+  "prefill" | "decode" | "both")``): a role="prefill" engine runs
+  admission + chunked prefill only — each completed prompt streams its
+  first token, then parks for ``pop_prefilled()``, which exports the
+  slot's KV blocks as a self-contained payload (ONE fixed-width
+  ``ops/paged_cache.export_blocks`` executable; int8 blocks carry
+  data + per-row scales) and publishes the prompt's blocks into the
+  prefix index before freeing them. ``admit_prefilled()`` on any
+  engine of the same model/layout imports the payload (ONE fixed-width
+  scatter) and seats a decoding slot at exactly the colocated
+  post-prefill state, so greedy continuation is token-exact. The
+  ``EngineCluster`` (``inference/cluster.py``) orchestrates N replicas
+  behind a session-affine router on top of this. See docs/OPS.md
+  "Engine replication & disaggregated prefill".
+
 Admission is worst-case reserved: a request is admitted only when the
 pool can cover ``prompt + max_new`` blocks for it PLUS the outstanding
 reservations of every active slot, so mid-decode pool exhaustion is
-impossible by construction (no preemption path needed).
+impossible by construction (no preemption path needed; a
+role="prefill" engine reserves only the prompt's blocks — its decode
+horizon lives on the importing replica).
 
 Telemetry (monitor registry, exported in the JSONL dump):
 ``serving_slot_occupancy`` gauge, ``serving_batch_utilization`` /
@@ -164,7 +181,6 @@ gauges.
 from __future__ import annotations
 
 import contextlib
-import hashlib
 import itertools
 import os
 import time
@@ -185,7 +201,8 @@ from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
-__all__ = ["ServingConfig", "ServingRequest", "ServingEngine"]
+__all__ = ["ServingConfig", "ServingRequest", "ServingEngine",
+           "PrefilledRequest"]
 
 # trace-viewer pid per engine (and the stats() engine_id)
 _ENGINE_IDS = itertools.count()
@@ -286,6 +303,16 @@ class ServingConfig:
     # PADDLE_TPU_MOE_TELEMETRY=0) traces the executables without the
     # tap — zero callback cost, stats() moe_routing_entropy stays 0.0.
     moe_telemetry: bool = True
+    # disaggregated-cluster role: "both" (default) serves requests end
+    # to end; "prefill" runs admission + chunked prefill ONLY — a slot
+    # whose prompt completes parks for ``pop_prefilled()`` handoff
+    # (its first token is still streamed; its KV blocks export via
+    # ``ops/paged_cache.export_blocks``) and the engine reserves only
+    # the PROMPT's blocks per request (the decode horizon lives on the
+    # importing replica); "decode" marks a replica that additionally
+    # receives ``admit_prefilled()`` imports (any role accepts them —
+    # the flag documents cluster intent and shows up in stats()).
+    role: str = "both"
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -294,6 +321,9 @@ class ServingConfig:
         if not isinstance(tp, int) or isinstance(tp, bool) or tp < 1:
             raise ValueError(
                 f"tp_degree must be a positive int, got {tp!r}")
+        if self.role not in ("both", "prefill", "decode"):
+            raise ValueError(
+                f"role must be both|prefill|decode, got {self.role!r}")
 
 
 def _num_experts(cfg) -> int:
@@ -313,14 +343,35 @@ class ServingRequest:
     submit_time: float = field(default_factory=time.monotonic)
 
 
+@dataclass
+class PrefilledRequest:
+    """One finished prefill, packaged for a decode replica: the prompt
+    whose KV the payload holds, the first token the prefill engine
+    sampled (already streamed to the client), and the exported block
+    bytes (``ops/paged_cache.export_blocks`` output — a fixed-width
+    ``[mb]`` gather per layer, padded entries carrying null-block
+    garbage the importer routes back to its own null block). Produced
+    by ``ServingEngine.pop_prefilled()`` on a role="prefill" engine,
+    consumed by ``admit_prefilled()`` on any engine of the SAME model
+    and serving layout (block_size / max_model_len / kv_cache_dtype)."""
+    request_id: int                     # PREFILL-engine-local rid
+    prompt: np.ndarray                  # [L] int32
+    first_token: int
+    max_new_tokens: int
+    n_blocks: int                       # real (non-pad) blocks
+    payload: list                       # per-layer (k_rows, v_rows)
+
+
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
                  "last_token", "n_emitted", "max_new", "history",
-                 "prompt", "pend_pos", "pend_row", "admit_t")
+                 "prompt", "pend_pos", "pend_row", "admit_t",
+                 "handoff")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
                  max_new, history=None, prompt=None, pend_pos=None):
         self.admit_t = time.monotonic()   # request-span start (trace)
+        self.handoff = False    # prefill-role slot parked for export
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -385,6 +436,12 @@ class ServingEngine:
         if not _spec.speculative_enabled():  # PADDLE_TPU_SPECULATIVE=0
             gamma = 0
             draft_model = None
+        self._role = str(getattr(cfg, "role", "both") or "both")
+        if self._role == "prefill" and gamma:
+            raise NotImplementedError(
+                "a prefill-role engine never decodes, so speculative "
+                "decoding (num_speculative_tokens > 0) has nothing to "
+                "verify there — put the draft on the decode replicas")
         if gamma:
             if cfg.drafter not in ("ngram", "model"):
                 raise ValueError(f"drafter {cfg.drafter!r}; "
@@ -556,6 +613,22 @@ class ServingEngine:
         self._draft_chunk_exec = None
         self._cow_exec = None           # copy-on-write block duplicate
         self._draft_cow_exec = None
+        # disaggregated prefill -> decode handoff (role="prefill"
+        # parks completed prompts here; export/import are each ONE
+        # fixed-width [mb] executable, so steady state stays
+        # recompile-free on both sides of the transfer)
+        self._handoff_ready: List[int] = []     # slot indices parked
+        # transfer width: the payload only ever carries PROMPT blocks,
+        # so it is sized by max_model_len alone — NOT _mb, whose +gamma
+        # headroom differs between a (spec-free) prefill engine and a
+        # speculating decode replica and would shape-mismatch the
+        # import executable
+        self._mb_xfer = _pc.blocks_for(cfg.max_model_len, self._bs)
+        self._export_exec = None
+        self._import_exec = None
+        self._n_handoffs = 0            # prefills exported (this engine)
+        self._n_blocks_exported = 0
+        self._n_blocks_imported = 0
         # per-engine counts (the monitor counters below are process-
         # global telemetry shared by every engine; stats() must report
         # THIS engine)
@@ -626,6 +699,11 @@ class ServingEngine:
         self._m_hit_rate = monitor.gauge(
             "serving_prefix_hit_rate",
             "cumulative reused / admitted prompt tokens")
+        self._m_kv_transfer = monitor.counter(
+            "serving_kv_blocks_transferred",
+            "KV blocks streamed between engine pools (disaggregated "
+            "prefill -> decode handoffs; counted at import, data + "
+            "scales travel together on int8 pools)")
         monitor.info(
             "serving_tp_degree",
             "tensor-parallel degree of the most recent engine").set(
@@ -778,8 +856,7 @@ class ServingEngine:
                     f"prompt ({ids.size}) + max_new_tokens "
                     f"({max_new}) exceeds max_model_len "
                     f"({self.config.max_model_len})")
-            worst = _pc.blocks_for(ids.size + max_new + self._gamma,
-                                   self._bs)
+            worst = self._worst_for(ids.size, max_new)
             if worst > self._alloc.num_blocks - 1:
                 raise ValueError(
                     f"request needs {worst} blocks; pool has only "
@@ -871,7 +948,8 @@ class ServingEngine:
         emitted = self._admit()
         self._advance_prefills(emitted)
         active = [i for i, s in enumerate(self._slots)
-                  if s is not None and s.pend_pos is None]
+                  if s is not None and s.pend_pos is None
+                  and not s.handoff]
         if not active:
             if self._kv_read_pend:      # prefill-only tick: the chunk
                 self._note_kv_read(0)   # reads ARE the tick's traffic
@@ -941,7 +1019,8 @@ class ServingEngine:
         emitted = self._admit()
         self._advance_prefills(emitted)
         active = [i for i, s in enumerate(self._slots)
-                  if s is not None and s.pend_pos is None]
+                  if s is not None and s.pend_pos is None
+                  and not s.handoff]
         if not active:
             if self._kv_read_pend:      # prefill-only tick
                 self._note_kv_read(0)
@@ -1083,7 +1162,8 @@ class ServingEngine:
         g = self._gamma
         n_slots = cfg.num_slots
         active = [i for i, s in enumerate(self._slots)
-                  if s is not None and s.pend_pos is None]
+                  if s is not None and s.pend_pos is None
+                  and not s.handoff]
         pending = [i for i, s in enumerate(self._slots)
                    if s is not None and s.pend_pos is not None]
         if not active and not pending:
@@ -1293,6 +1373,13 @@ class ServingEngine:
         drains) the tokens of every request completed since the last
         ``run()``, keyed by request id — a long-lived engine therefore
         never accumulates finished results."""
+        if self._role == "prefill":
+            # parked handoff slots only free via pop_prefilled() —
+            # run() would spin forever waiting on them
+            raise RuntimeError(
+                "a role='prefill' engine cannot run() to completion: "
+                "drive step() and collect pop_prefilled() handoffs "
+                "(EngineCluster does this)")
         while self._queue or self.num_active:
             self.step()
         done, self._done = self._done, {}
@@ -1353,6 +1440,13 @@ class ServingEngine:
             "kv_cache_dtype": self._kv_dtype_name,
             "kv_pool_bytes": self._kv_pool_bytes,
             "kv_bytes_per_step": self._kv_step_bytes_last,
+            # disaggregated-cluster keys: ALWAYS present (0 /
+            # role="both" on a standalone engine) so fleet dashboards
+            # never KeyError on a mixed colocated/disaggregated fleet
+            "role": self._role,
+            "prefills_exported": self._n_handoffs,
+            "kv_blocks_exported": self._n_blocks_exported,
+            "kv_blocks_imported": self._n_blocks_imported,
             "tp_degree": self._tp,
             # always present (0 / full pool when single-device), so a
             # tp_degree>1 request downgraded by the PADDLE_TPU_SERVE_TP=0
@@ -1415,6 +1509,179 @@ class ServingEngine:
             self._alloc.check_leaks(live)
         return True
 
+    # -- disaggregated prefill -> decode ------------------------------
+
+    def published_overlap(self, hashes) -> int:
+        """Leading run of ``hashes`` (``ops/paged_cache.
+        prompt_block_hashes`` output, materialized once by the caller)
+        present in this engine's content index — the cluster router's
+        affinity probe: the replica with the longest run already holds
+        that many of the prompt's KV blocks and will prefill only the
+        suffix. 0 when the prefix cache is off (nothing to hit)."""
+        if not self._prefix_on:
+            return 0
+        n = 0
+        for h in hashes:
+            if self._alloc.lookup(h) is None:
+                break
+            n += 1
+        return n
+
+    def pop_prefilled(self) -> List[PrefilledRequest]:
+        """Collect every prefill this role="prefill" engine finished
+        since the last call: each parked slot's blocks are exported
+        through the ONE fixed-width export executable into a
+        self-contained :class:`PrefilledRequest` payload, the prompt's
+        full blocks are published into the prefix index (the next turn
+        of the same session prefills only its suffix HERE — what the
+        router's affinity probe keys on), and the slot is freed for
+        the next admission. The caller (``EngineCluster``) imports the
+        payload into a decode replica via ``admit_prefilled()``."""
+        out = []
+        for i in self._handoff_ready:
+            slot = self._slots[i]
+            ids = np.zeros(self._mb_xfer, np.int32)
+            ids[:len(slot.blocks)] = slot.blocks
+            ids_dev = self._dev(ids)
+            if self._export_exec is None:
+                # pools are NOT donated: the blocks stay live until
+                # _release_handoff publishes + frees them
+                self._export_exec = self._aot_compile(
+                    "export", jax.jit(_pc.export_blocks),
+                    (self._pools, ids_dev))
+            payload = self._export_exec(self._pools, ids_dev)
+            self._n_handoffs += 1
+            self._n_blocks_exported += len(slot.blocks)
+            out.append(PrefilledRequest(
+                request_id=slot.rid, prompt=slot.prompt,
+                first_token=int(slot.last_token),
+                max_new_tokens=slot.max_new,
+                n_blocks=len(slot.blocks), payload=payload))
+            self._release_handoff(i)
+        self._handoff_ready = []
+        return out
+
+    def admit_prefilled(self, prefilled: PrefilledRequest):
+        """Admit a prefill ANOTHER engine completed (the disaggregated
+        decode side): allocate this pool's blocks, import the payload
+        bytes at those ids through the ONE fixed-width import
+        executable, and seat a decoding slot at ``cache_len ==
+        len(prompt)`` with the prefill's first token as its last token
+        — exactly the state a colocated engine holds after its own
+        prefill, so greedy continuation is token-exact by construction
+        (int8 payloads carry data + scales, so imported blocks
+        dequantize bitwise). Returns the engine-local request id, or
+        None when no slot / block capacity is available right now (the
+        cluster keeps the handoff pending and retries next tick). No
+        TTFT is observed here — the first token already streamed from
+        the prefill engine; this request's later emits feed the ITL
+        digest only."""
+        prompt = np.asarray(prefilled.prompt, np.int32).reshape(-1)
+        n_real = int(prompt.size)
+        max_new = int(prefilled.max_new_tokens)
+        if n_real + max_new > self.config.max_model_len:
+            raise ValueError(
+                f"prefilled prompt ({n_real}) + max_new_tokens "
+                f"({max_new}) exceeds max_model_len "
+                f"({self.config.max_model_len})")
+        init = _pc.blocks_for(n_real, self._bs)
+        if prefilled.n_blocks != init:
+            raise ValueError(
+                f"prefilled payload holds {prefilled.n_blocks} blocks "
+                f"but a {n_real}-token prompt needs {init} at "
+                f"block_size={self._bs} — exporter and importer must "
+                "share the serving layout")
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return None
+        worst = self._worst_for(n_real, max_new)
+        if self._alloc.free_blocks - self._reserved < worst:
+            return None
+        i = free[0]
+        blocks = self._alloc.alloc(init)
+        self._reserved += worst - len(blocks)
+        ids = np.zeros(self._mb_xfer, np.int32)
+        ids[:init] = blocks
+        ids_dev = self._dev(ids)
+        if self._import_exec is None:
+            self._import_exec = self._aot_compile(
+                "import",
+                jax.jit(_pc.import_blocks, donate_argnums=(0,)),
+                (self._pools, ids_dev, prefilled.payload))
+        with _quiet_donation():
+            self._pools = self._import_exec(self._pools, ids_dev,
+                                            prefilled.payload)
+        self._n_blocks_imported += init
+        self._m_kv_transfer.inc(init)
+        rid = self._next_rid
+        self._next_rid += 1
+        self._results[rid] = []
+        self._tables[i, :] = 0
+        self._tables[i, :init] = blocks
+        self._tables_dev = None
+        tok = int(prefilled.first_token)
+        self._slots[i] = _Slot(
+            rid, blocks, worst, n_real, tok, max_new,
+            history=list(map(int, prompt)) + [tok],
+            prompt=prompt, pend_pos=None)
+        self._m_occupancy.set(self.num_active)
+        if self._trace is not None:
+            self._trace.instant(
+                "admit_prefilled", tid=1 + i,
+                args={"rid": rid, "blocks": init,
+                      "prompt_tokens": n_real})
+        return rid
+
+    def _release_handoff(self, i):
+        """Free a handed-off slot WITHOUT completion accounting — the
+        request is still live, on another engine. The prompt's full
+        blocks are published first (multi-turn affinity: the session's
+        next turn hits this engine's prefix cache), mirroring
+        ``_retire``'s publish; e2e latency belongs to the cluster's
+        client-side rollup, not this engine's digest."""
+        slot = self._slots[i]
+        now = time.monotonic()
+        self._submit_t.pop(slot.rid, None)
+        self._last_emit.pop(slot.rid, None)
+        if self._trace is not None:
+            self._trace.emit(
+                f"req{slot.rid}", tid=1 + i, t0=slot.admit_t, t1=now,
+                args={"tokens": slot.n_emitted,
+                      "cache_len": slot.cache_len, "handoff": True})
+            self._trace.instant("handoff", tid=1 + i,
+                                args={"rid": slot.rid,
+                                      "blocks": len(slot.blocks)})
+        if self._prefix_on and slot.cache_len >= self._bs:
+            # cache position p holds history[p] for p < cache_len (the
+            # sampled first token is NOT in the cache), so the publish
+            # walk is identical to _retire's
+            n_full = min(len(slot.blocks), slot.cache_len // self._bs)
+            for b, h in zip(slot.blocks[:n_full],
+                            _pc.chain_hashes(
+                                self._fp,
+                                slot.history[:n_full * self._bs],
+                                self._bs)):
+                self._alloc.publish(b, h)
+        self._alloc.free(slot.blocks)
+        self._reserved -= slot.worst_blocks - len(slot.blocks)
+        self._tables[i, :] = 0
+        self._tables_dev = None
+        self._slots[i] = None
+        self._results.pop(slot.rid, None)
+        self._m_occupancy.set(self.num_active)
+
+    def _worst_for(self, n_real, max_new) -> int:
+        """Worst-case block reservation for one request. A
+        role="prefill" engine reserves only the PROMPT's blocks — the
+        first token's K/V is never written there (chunked prefill
+        writes prompt positions only; decode happens on the importing
+        replica), so the decode horizon (max_new + gamma) would only
+        inflate admission pressure on the prefill tier."""
+        if self._role == "prefill":
+            return _pc.blocks_for(int(n_real), self._bs)
+        return _pc.blocks_for(int(n_real) + int(max_new) + self._gamma,
+                              self._bs)
+
     # -- tracing ------------------------------------------------------
 
     @property
@@ -1432,24 +1699,11 @@ class ServingEngine:
             return None
         return self._trace.dump_chrome_trace(path)
 
-    @staticmethod
-    def _model_fingerprint(model) -> bytes:
-        """Seed for the content-hash chains: two caches may share
-        blocks only when the model architecture + config (and thus the
-        K/V a token sequence produces) agree. Per-engine pools make
-        cross-model collisions impossible today; the fingerprint keeps
-        the hash space partitioned if the index is ever externalized."""
-        import dataclasses
-        desc = [type(model).__name__]
-        cfg = getattr(model, "config", None)
-        if cfg is not None:
-            try:
-                fields = dataclasses.asdict(cfg)
-            except TypeError:
-                fields = dict(vars(cfg))
-            desc.append(repr(sorted(fields.items())))
-        return hashlib.blake2b("\x1f".join(desc).encode(),
-                               digest_size=16).digest()
+    # thin alias: the fingerprint (and the prompt -> block-hash walk
+    # seeded by it) lives in ops/paged_cache so the cluster router and
+    # engine admission hash IDENTICALLY — see model_fingerprint /
+    # prompt_block_hashes there
+    _model_fingerprint = staticmethod(_pc.model_fingerprint)
 
     # -- tensor parallelism -------------------------------------------
 
@@ -1726,8 +1980,7 @@ class ServingEngine:
                 break
             req = self._queue[0]
             n_real = int(req.prompt.size)
-            worst = _pc.blocks_for(
-                n_real + req.max_new_tokens + self._gamma, self._bs)
+            worst = self._worst_for(n_real, req.max_new_tokens)
             # worst-case reservation: admit only what can NEVER run the
             # pool dry mid-decode (FIFO — no head-of-line bypass, which
             # keeps "every request completes exactly once" trivial).
@@ -1803,8 +2056,12 @@ class ServingEngine:
         init = _pc.blocks_for(n_real, self._bs)
         matched = []
         if self._prefix_on:
-            # lazy hashing: a cache-cold prompt stops at block 0
-            for h in _pc.iter_chain_hashes(self._fp, prompt, self._bs):
+            # lazy hashing: a cache-cold prompt stops at block 0. THE
+            # shared prompt->hash walk (ops/paged_cache) — the cluster
+            # router probes replicas with exactly these keys, so a
+            # router hit here IS an admission hit
+            for h in _pc.prompt_block_hashes(self._fp, prompt,
+                                             self._bs):
                 b = self._alloc.lookup(h)
                 if b is None:
                     break
@@ -1941,7 +2198,10 @@ class ServingEngine:
     def _finish_prefill(self, i, tok, emitted):
         """Shared admission epilogue (synchronous and interleaved
         prefill): record and emit the first token, retire immediately
-        on EOS / max_new_tokens == 1."""
+        on EOS / max_new_tokens == 1. On a role="prefill" engine a
+        surviving slot parks for ``pop_prefilled()`` instead of
+        entering decode — the request's remaining tokens belong to the
+        decode replica the blocks stream to."""
         slot = self._slots[i]
         slot.cache_len = int(slot.prompt.size)
         slot.pend_pos = None
@@ -1954,6 +2214,9 @@ class ServingEngine:
         emitted.append((slot.rid, tok))
         if tok == self._eos or slot.max_new <= 1:
             self._retire(i)
+        elif self._role == "prefill":
+            slot.handoff = True
+            self._handoff_ready.append(i)
 
     def _note_kv_read(self, positions):
         """Analytic KV HBM traffic of one tick: ``positions`` cache

@@ -67,7 +67,8 @@ __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "iter_chain_hashes", "copy_blocks", "pool_sharding",
            "pool_head_slice", "ragged_row_meta", "QuantKV",
            "kv_quantize", "kv_dequantize", "resolve_kv_cache_dtype",
-           "pool_bytes", "scale_sharding"]
+           "pool_bytes", "scale_sharding", "model_fingerprint",
+           "prompt_block_hashes", "export_blocks", "import_blocks"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -361,6 +362,40 @@ def chain_hashes(seed: bytes, tokens, block_size: int):
     return list(iter_chain_hashes(seed, tokens, block_size))
 
 
+def model_fingerprint(model) -> bytes:
+    """Seed for the content-hash chains: two caches may share blocks
+    only when the model architecture + config (and thus the K/V a
+    token sequence produces) agree. Per-engine pools make cross-model
+    collisions impossible today; the fingerprint keeps the hash space
+    partitioned if the index is ever externalized — and it is what
+    lets a CLUSTER router hash a prompt once and probe every replica's
+    index with the same keys (every replica of one model computes the
+    identical fingerprint)."""
+    import dataclasses
+    desc = [type(model).__name__]
+    cfg = getattr(model, "config", None)
+    if cfg is not None:
+        try:
+            fields = dataclasses.asdict(cfg)
+        except TypeError:
+            fields = dict(vars(cfg))
+        desc.append(repr(sorted(fields.items())))
+    return hashlib.blake2b("\x1f".join(desc).encode(),
+                           digest_size=16).digest()
+
+
+def prompt_block_hashes(fingerprint: bytes, prompt, block_size: int):
+    """THE prompt -> full-block hash walk that serving admission AND
+    the cluster router share (lazy — a consumer stopping at its first
+    index miss never hashes the whole prompt). Factored here so the
+    two can NEVER drift: if the router hashed even one byte
+    differently from ``ServingEngine._map_prefix``, every affinity
+    probe would silently miss and session-affine routing would
+    degrade to load balancing without any error. Yields the chain
+    hash of each FULL block of ``prompt`` in order."""
+    return iter_chain_hashes(fingerprint, prompt, block_size)
+
+
 def init_pool(num_blocks: int, block_size: int, num_kv_heads: int,
               head_dim: int, dtype, sharding=None) -> tuple:
     """Zeroed (k_pool, v_pool), each [num_blocks, block_size, H_kv, D].
@@ -607,6 +642,64 @@ def copy_blocks(pools, src, dst):
         return pool.at[dst].set(pool[src])
 
     return [(cp(kp), cp(vp)) for kp, vp in pools]
+
+
+def export_blocks(pools, block_ids):
+    """Disaggregated prefill->decode transfer, read side: gather the
+    SELF-CONTAINED bytes of ``block_ids`` ([M] int32, padded with the
+    null block) out of every layer's (k, v) pool — fp pools as
+    ``[M, BS, H_kv, D]`` rows in the pool dtype, int8 pools as a
+    :class:`QuantKV` of data ``[M, BS, H_kv, D]`` + scales
+    ``[M, BS, H_kv]`` (a quantized block's bytes are self-contained
+    thanks to the per-row scales, so data + scales IS the block). A
+    fixed ``M`` (the engine's max blocks per request) makes this ONE
+    compiled executable per engine: pad entries gather the null
+    block's garbage, which the importer routes right back to ITS null
+    block. The caller copies the result between engines (pools are
+    NOT donated — the source pool stays live)."""
+    ids = block_ids.astype(jnp.int32)
+
+    def gx(pool):
+        if isinstance(pool, QuantKV):
+            return QuantKV(pool.data[ids], pool.scale[ids])
+        return pool[ids]
+
+    return [(gx(kp), gx(vp)) for kp, vp in pools]
+
+
+def import_blocks(pools, block_ids, payload):
+    """Disaggregated prefill->decode transfer, write side: scatter an
+    :func:`export_blocks` payload into THIS pool at ``block_ids``
+    ([M] int32, padded with the null block — pad rows land in the
+    null block, harmless by construction, so one fixed-width
+    executable serves every request size). Layer count / dtypes must
+    match the exporter's (same model, same ``kv_cache_dtype``); int8
+    payloads scatter data AND scales, so an imported block
+    dequantizes to bitwise the values the prefill engine computed.
+    Donate ``pools`` — the decode pool is updated in place."""
+    ids = block_ids.astype(jnp.int32)
+
+    def sx(pool, rows):
+        if isinstance(pool, QuantKV):
+            if not isinstance(rows, QuantKV):
+                raise TypeError(
+                    "import_blocks: int8 pool fed a non-quantized "
+                    "payload (exporter and importer must share "
+                    "kv_cache_dtype)")
+            return QuantKV(pool.data.at[ids].set(rows.data),
+                           pool.scale.at[ids].set(rows.scale))
+        if isinstance(rows, QuantKV):
+            raise TypeError(
+                "import_blocks: fp pool fed a quantized payload "
+                "(exporter and importer must share kv_cache_dtype)")
+        return pool.at[ids].set(rows.astype(pool.dtype))
+
+    if len(payload) != len(pools):
+        raise ValueError(
+            f"import_blocks: payload has {len(payload)} layers, pool "
+            f"has {len(pools)}")
+    return [(sx(kp, kr), sx(vp, vr))
+            for (kp, vp), (kr, vr) in zip(pools, payload)]
 
 
 def gather_dense(pool, block_tables):

@@ -53,6 +53,12 @@ import pytest
 # metric-docs registry walk — ~5-7s each), both under the ~9s line,
 # so no new entries and tier-1 keeps its headroom under the 870s
 # budget.
+# r12 re-sweep (engine replication + disaggregated prefill): the 19
+# new test_cluster.py tests measured ~36s total in a solo run
+# (slowest 8.5s — the int8 disaggregated parity pairing, AT the line
+# but each test builds 2-3 tiny engines so the cost is compile-bound
+# and stable); no new entries, tier-1 measured 617s solo with the
+# file aboard (618 passed) — ~250s of headroom under the 870s budget.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s
