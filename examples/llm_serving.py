@@ -356,6 +356,46 @@ def main(argv=None):
           f"{stc['router_affinity_hit_rate']:.2f}); disaggregated "
           f"token-exact with {std['kv_blocks_transferred']} KV "
           f"blocks streamed prefill->decode")
+
+    # ---- 11. mega-kernelized decode tick + per-request sampling
+    # Fused norm->QKV / attention->O-proj / MLP boundaries inside the
+    # one ragged executable (kill switch PADDLE_TPU_FUSED_DECODE=0,
+    # token-exact vs unfused — off TPU the fallback IS the unfused
+    # graph bit-for-bit), kernel census measured per engine, and the
+    # per-slot sampling head: two requests with DIFFERENT sampling
+    # knobs ride one batch and one executable — a top_k=1 row
+    # reproduces the greedy chain while its neighbor samples hot.
+    os.environ["PADDLE_TPU_FUSED_DECODE"] = "0"
+    eng_uf = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96, prefill_chunk=16))
+    ref11 = eng_uf.serve(list(prompts), max_new_tokens=6)
+    eng_uf.shutdown()
+    del os.environ["PADDLE_TPU_FUSED_DECODE"]
+    eng_f = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96, prefill_chunk=16))
+    got11 = eng_f.serve(list(prompts), max_new_tokens=6)
+    st11 = eng_f.stats()
+    for a, b in zip(got11, ref11):
+        assert a.tolist() == b.tolist(), "fused tick diverged"
+    assert st11["fused_decode"] and st11["kernels_per_tick"] > 0
+    eng_f.shutdown()
+    eng_s = ServingEngine(model, ServingConfig(
+        num_slots=2, block_size=8, max_model_len=96, prefill_chunk=16,
+        decode_strategy="sampling", temperature=1.5, seed=9))
+    rid_cold = eng_s.submit(prompts[0], 6, temperature=1e-6, top_k=1)
+    rid_hot = eng_s.submit(prompts[1], 6, temperature=1.3, top_p=0.9)
+    done11 = eng_s.run()
+    st11s = eng_s.stats()
+    assert done11[rid_cold].tolist() == ref11[0].tolist(), \
+        "per-request top_k=1 row must reproduce the greedy chain"
+    assert st11s["executables_compiled"] == 1, \
+        "distinct sampling configs must share ONE executable"
+    eng_s.shutdown()
+    print(f"fused decode tick: token-exact vs unfused, "
+          f"kernels_per_tick {st11['kernels_per_tick']} (launch proxy "
+          f"{st11['kernel_launch_proxy_per_tick']}); per-request "
+          f"sampling: greedy row exact next to a hot row, "
+          f"{st11s['executables_compiled']} executable")
     return n_ok / 12.0, losses
 
 

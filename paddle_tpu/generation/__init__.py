@@ -82,24 +82,91 @@ def _filter_logits(logits, *, do_sample, temperature, top_k, top_p):
     """The temperature/top-k/top-p logits pipeline, factored out so the
     speculative verify step can apply the SAME modification to draft
     and target logits (the rejection-sampling soundness requirement).
-    Works on any [..., V] shape; returns f32 filtered logits."""
+    Works on any [..., V] shape; returns f32 filtered logits.
+
+    The knobs may be python numbers (the original static path — baked
+    into the trace, short-circuited when inert, bit-for-bit the
+    historical graphs) OR traced jax values (scalars, or per-row
+    arrays broadcastable over ``logits``' leading dims after trailing
+    axes are appended): the serving engine's per-slot sampling tensors
+    and ``generate()``'s traced sampling operand ride the traced path,
+    so a new sampling config reuses the SAME executable — no recompile
+    class. Inert traced values (t=1, k=0, p=1) produce bitwise the
+    static path's logits (divide by 1.0 is IEEE-identity; a disabled
+    filter masks nothing), which is what pins per-slot == engine-global
+    token-exactness when the knobs are uniform."""
     logits = logits.astype(jnp.float32)
-    if temperature != 1.0 and do_sample:
-        logits = logits / max(temperature, 1e-6)
-    if do_sample and top_k:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if do_sample and top_p < 1.0:
-        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+    if not do_sample:
+        return logits
+    if all(isinstance(v, (int, float, bool))
+           for v in (temperature, top_k, top_p)):
+        if temperature != 1.0:
+            logits = logits / max(temperature, 1e-6)
+        if top_k:
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            sorted_logits = jnp.flip(jnp.sort(logits, axis=-1),
+                                     axis=-1)
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep tokens until the cumulative prob of *previous* kept
+            # ones exceeds top_p (always keeps the first)
+            drop = cum - probs > top_p
+            kept = jnp.where(drop, jnp.inf, sorted_logits)
+            thresh = jnp.min(kept, axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        return logits
+
+    # -- traced-knob path (per-slot device tensors) -------------------
+    def _bc(v):
+        """Align a traced knob against logits' leading dims: trailing
+        axes appended so [S] broadcasts over [S, G+1, V] windows."""
+        v = jnp.asarray(v, jnp.float32)
+        if v.ndim:
+            v = v.reshape(v.shape + (1,) * (logits.ndim - 1 - v.ndim))
+        return v
+
+    t = _bc(temperature)
+    logits = logits / jnp.maximum(t, 1e-6)[..., None]
+    k = _bc(top_k).astype(jnp.int32)
+    p = _bc(top_p)
+    v_dim = logits.shape[-1]
+
+    # each vocab-wide filter (a full sort + reductions) sits behind a
+    # runtime lax.cond: an inert knob (k=0 / p=1 — the common default
+    # config) SKIPS the sort at execution time, so moving the knobs
+    # out of the trace costs the cheap config nothing — same
+    # executable either way, and when a filter IS live its branch is
+    # op-for-op the unconditional code (bitwise the static path)
+    def _topk(lg):
+        sorted_desc = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+        kth = jnp.take_along_axis(
+            sorted_desc,
+            jnp.broadcast_to(jnp.clip(k - 1, 0, v_dim - 1)[..., None],
+                             lg.shape[:-1] + (1,)), axis=-1)
+        return jnp.where((k[..., None] > 0) & (lg < kth),
+                         -jnp.inf, lg)
+
+    def _topp(lg):
+        # sorts AFTER the top-k mask — the static path's op order, so
+        # uniform traced knobs reproduce its values exactly
+        sorted2 = jnp.flip(jnp.sort(lg, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted2, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens until the cumulative prob of *previous* kept ones
-        # exceeds top_p (always keeps the first)
-        drop = cum - probs > top_p
-        kept = jnp.where(drop, jnp.inf, sorted_logits)
+        # the (p < 1) row gate mirrors _topk's (k > 0): an inert row
+        # sharing the batch with an active one must mask NOTHING —
+        # without it, f32 cumsum overshoot past 1.0 can drop a p=1.0
+        # row's tail tokens (cross-request interference)
+        drop = (cum - probs > p[..., None]) & (p[..., None] < 1.0)
+        kept = jnp.where(drop, jnp.inf, sorted2)
         thresh = jnp.min(kept, axis=-1, keepdims=True)
-        logits = jnp.where(logits < thresh, -jnp.inf, logits)
-    return logits
+        return jnp.where(lg < thresh, -jnp.inf, lg)
+
+    logits = jax.lax.cond(jnp.any(k > 0), _topk, lambda lg: lg,
+                          logits)
+    return jax.lax.cond(jnp.any(p < 1.0), _topp, lambda lg: lg,
+                        logits)
 
 
 def _select_token(logits, key, *, do_sample, temperature, top_k, top_p):
@@ -192,8 +259,11 @@ class GenerationMixin:
 
     def _build_run(self, binder, buffers, b, prompt_len, max_new,
                    select, eos, pad, with_scores, with_mask=False):
-        """run(params, ids[, mask], key) -> out ids [, scores]: prefill
-        + one lax.while_loop with in-loop EOS early exit. With
+        """run(params, ids[, mask], key, samp) -> out ids [, scores]:
+        prefill + one lax.while_loop with in-loop EOS early exit.
+        ``samp`` is the traced [3] f32 sampling operand (temperature,
+        top_k, top_p) — DATA, not part of the trace, so changing the
+        sampling knobs reuses the same compiled loop. With
         ``with_mask`` (LEFT-padded batches): the [B, prompt] pad mask
         masks pad cache slots and re-bases each row's rope positions at
         its first real token (reference: PaddleNLP padded generation)."""
@@ -202,7 +272,7 @@ class GenerationMixin:
 
         def run(params_a, ids_a, *rest):
             if with_mask:
-                pad_mask, key = rest
+                pad_mask, key, samp = rest
                 pad_mask = pad_mask.astype(jnp.int32)
                 full_mask = jnp.concatenate(
                     [pad_mask, jnp.ones((b, max_new), jnp.int32)], 1)
@@ -210,14 +280,14 @@ class GenerationMixin:
                 pos0 = jnp.maximum(
                     jnp.cumsum(pad_mask, axis=1) - 1, 0)    # [B, prompt]
             else:
-                (key,) = rest
+                (key, samp) = rest
                 full_mask, pos0, n_real = None, None, None
             caches = self.init_caches(b, prompt_len + max_new)
             logits, caches = model_step(params_a, ids_a, caches,
                                         jnp.zeros((), jnp.int32),
                                         mask=full_mask, pos=pos0)
             key, sub = jax.random.split(key)
-            tok, logp = select(logits[:, -1, :], sub)
+            tok, logp = select(logits[:, -1, :], sub, samp)
             done = tok == eos
             out = jnp.full((b, max_new), pad, jnp.int32)
             out = out.at[:, 0].set(jnp.where(done, eos, tok))
@@ -235,7 +305,7 @@ class GenerationMixin:
                                             caches, off,
                                             mask=full_mask, pos=pos_i)
                 key, sub = jax.random.split(key)
-                ntok, logp = select(logits[:, -1, :], sub)
+                ntok, logp = select(logits[:, -1, :], sub, samp)
                 ntok = jnp.where(done, jnp.int32(pad), ntok)
                 score = score + jnp.where(done, 0.0, logp)
                 out = jax.lax.dynamic_update_slice(
@@ -268,7 +338,7 @@ class GenerationMixin:
             .reshape(b, mb)                    # block 0 stays null
         num_blocks = 1 + b * mb
 
-        def run(params_a, ids_a, key):
+        def run(params_a, ids_a, key, samp):
             tables = jnp.asarray(tables_np)
             # kwarg passed only when set, so pre-quantization
             # duck-typed models keep working on the default path
@@ -282,7 +352,7 @@ class GenerationMixin:
             pools = [_pc.write_prefill(kp, vp, tables, dk, dv)
                      for (kp, vp), (dk, dv) in zip(pools, dense)]
             key, sub = jax.random.split(key)
-            tok, logp = select(logits[:, -1, :], sub)
+            tok, logp = select(logits[:, -1, :], sub, samp)
             done = tok == eos
             out = jnp.full((b, max_new), pad, jnp.int32)
             out = out.at[:, 0].set(jnp.where(done, eos, tok))
@@ -299,7 +369,7 @@ class GenerationMixin:
                                            None, block_tables=tables,
                                            cache_lens=lens)
                 key, sub = jax.random.split(key)
-                ntok, logp = select(logits[:, -1, :], sub)
+                ntok, logp = select(logits[:, -1, :], sub, samp)
                 ntok = jnp.where(done, jnp.int32(pad), ntok)
                 score = score + jnp.where(done, 0.0, logp)
                 out = jax.lax.dynamic_update_slice(
@@ -584,9 +654,14 @@ class GenerationMixin:
                        groups, diversity_rate, length_penalty,
                        early_stopping, eos, pad)
         else:
-            select = lambda lg, k: _select_token(
-                lg, k, do_sample=do_sample, temperature=temperature,
-                top_k=top_k, top_p=top_p)
+            # sampling knobs ride as a traced [3] operand (DATA, not
+            # trace constants), so temperature/top_k/top_p changes
+            # reuse ONE compiled decode loop — they are deliberately
+            # NOT in the jit_key below (the ISSUE 13 recompile fix;
+            # pinned by the generate_jit_cache counter test)
+            select = lambda lg, k, samp: _select_token(
+                lg, k, do_sample=do_sample, temperature=samp[0],
+                top_k=samp[1], top_p=samp[2])
             if cache_impl == "paged":
                 run = self._build_run_paged(
                     binder, buffers, b, prompt_len, max_new, select,
@@ -599,8 +674,7 @@ class GenerationMixin:
                                       with_scores=True,
                                       with_mask=attention_mask
                                       is not None)
-            jit_key = (b, prompt_len, max_new, do_sample, temperature,
-                       top_k, top_p, eos, pad,
+            jit_key = (b, prompt_len, max_new, do_sample, eos, pad,
                        attention_mask is not None, cache_impl,
                        kv_dtype)
 
@@ -614,12 +688,15 @@ class GenerationMixin:
             self._generate_jit_cache[jit_key] = jitted
         else:
             _gen_cache_events.labels(model=_label, event="hit").inc()
+        extra = () if is_beam else (jnp.asarray(
+            [temperature, float(top_k), top_p], jnp.float32),)
         if attention_mask is not None:
             mask_arr = as_jax(attention_mask).astype(jnp.int32)
             out, score = jitted(params, ids, mask_arr,
-                                jax.random.PRNGKey(seed))
+                                jax.random.PRNGKey(seed), *extra)
         else:
-            out, score = jitted(params, ids, jax.random.PRNGKey(seed))
+            out, score = jitted(params, ids, jax.random.PRNGKey(seed),
+                                *extra)
         return (_wrap_out(out.astype(jnp.int64)),
                 _wrap_out(score))
 
@@ -660,14 +737,21 @@ class GenerationMixin:
                 early_stopping=cfg.early_stopping, eos=eos, pad=pad,
                 with_scores=False)
         else:
-            select = lambda lg, k: _select_token(
+            # the exported artifact BAKES its sampling config (it is a
+            # fixed deployable); the traced samp operand is fed a dummy
+            # the graph never reads
+            select = lambda lg, k, _samp: _select_token(
                 lg, k, do_sample=do_sample, temperature=cfg.temperature,
                 top_k=cfg.top_k, top_p=cfg.top_p)
             run = self._build_run(binder, buffers, b, prompt, max_new,
                                   select, eos, pad, with_scores=False)
 
         def run_seeded(params_a, ids_a, seed):
-            return run(params_a, ids_a, jax.random.PRNGKey(seed))
+            if cfg.decode_strategy in ("beam_search",
+                                       "group_beam_search"):
+                return run(params_a, ids_a, jax.random.PRNGKey(seed))
+            return run(params_a, ids_a, jax.random.PRNGKey(seed),
+                       jnp.zeros((3,), jnp.float32))
 
         seed_dtype = "int64" if jax.config.jax_enable_x64 else "int32"
         from jax import export as jexport

@@ -113,25 +113,38 @@ def ngram_propose(history, gamma: int, max_ngram: int = 3) -> List[int]:
     return [history[-1]] * g
 
 
-def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
-                     top_k, top_p, want_probs, gather_logits=None):
+def build_draft_loop(draft_step, *, gamma, do_sample, temperature=1.0,
+                     top_k=0, top_p=1.0, want_probs,
+                     gather_logits=None, slot_params=False):
     """Compiled draft proposal loop: ``gamma + 1`` single-token decode
     steps of the draft model inside one ``lax.scan`` (the extra step
     emits nothing — it writes the last draft token's K/V so a fully
     accepted window leaves the draft cache gap-free and the next
     proposal starts exactly at the target's new length).
 
-    Returns ``loop(dparams, dpools, tables, lens, cur, key) ->
+    Returns ``loop(dparams, dpools, tables, lens, cur[, samp], key) ->
     (proposals [S, gamma], q_probs [S, gamma, V] | None, dpools)``.
     ``q_probs`` are the draft distributions AFTER the shared
     temperature/top-k/top-p pipeline (``want_probs`` — sampling mode
     needs them for rejection sampling; greedy verifies by token id
     only). ``gather_logits`` (tensor-parallel serving): applied to the
     per-step logits BEFORE filtering/sampling, so selection always
-    sees the full replicated vocab row."""
+    sees the full replicated vocab row. ``slot_params`` (the serving
+    engine's per-slot sampling tensors): the loop takes a ``samp``
+    [S, 3] operand — (temperature, top_k, top_p) per slot, DATA
+    instead of trace constants — and the baked keyword knobs are
+    ignored; rejection sampling stays sound because the verify step
+    filters the target logits with the SAME per-slot values."""
     from . import _filter_logits
 
-    def loop(dparams, dpools, tables, lens, cur, key):
+    def loop(dparams, dpools, tables, lens, cur, *rest):
+        if slot_params:
+            samp, key = rest
+            t_, k_, p_ = samp[:, 0], samp[:, 1], samp[:, 2]
+        else:
+            (key,) = rest
+            t_, k_, p_ = temperature, top_k, top_p
+
         def body(carry, _):
             tok, pools, l, k = carry
             logits, pools = draft_step(dparams, tok[:, None], pools,
@@ -141,8 +154,7 @@ def build_draft_loop(draft_step, *, gamma, do_sample, temperature,
             if gather_logits is not None:
                 row = gather_logits(row)
             f = _filter_logits(row, do_sample=do_sample,
-                               temperature=temperature, top_k=top_k,
-                               top_p=top_p)
+                               temperature=t_, top_k=k_, top_p=p_)
             k, sub = jax.random.split(k)
             if do_sample:
                 nt = jax.random.categorical(sub, f).astype(jnp.int32)
@@ -221,9 +233,9 @@ def accept_from_filtered(f, toks, dq, key, *, gamma, do_sample):
     return out, accept, picked
 
 
-def build_verify_step(model_step, *, gamma, do_sample, temperature,
-                      top_k, top_p, onehot_draft=True,
-                      gather_logits=None):
+def build_verify_step(model_step, *, gamma, do_sample, temperature=1.0,
+                      top_k=0, top_p=1.0, onehot_draft=True,
+                      gather_logits=None, slot_params=False):
     """Build the fixed-gamma multi-token verify step.
 
     The returned function runs ONE target forward over the window
@@ -247,39 +259,69 @@ def build_verify_step(model_step, *, gamma, do_sample, temperature,
     ``verify(params, pools, tables, lens, toks[, dq], key)``.
     ``gather_logits`` (tensor-parallel serving): applied to the window
     logits before filtering, so acceptance/sampling always see the
-    full replicated vocab — the step's ONE cross-shard collective."""
+    full replicated vocab — the step's ONE cross-shard collective.
+    ``slot_params`` (the serving engine's per-slot sampling tensors):
+    every verify signature gains a ``samp`` [S, 3] operand right after
+    ``toks`` — (temperature, top_k, top_p) per slot as DATA, so
+    distinct sampling configs share one executable; the baked keyword
+    knobs are then ignored (greedy verifies never consume them either
+    way)."""
     from . import _filter_logits
 
-    def _target(params, pools, tables, lens, toks):
+    def _target(params, pools, tables, lens, toks, samp):
         logits, pools = model_step(params, toks, pools, None,
                                    block_tables=tables,
                                    cache_lens=lens)
         if gather_logits is not None:
             logits = gather_logits(logits)
+        if slot_params:
+            t_, k_, p_ = samp[:, 0], samp[:, 1], samp[:, 2]
+        else:
+            t_, k_, p_ = temperature, top_k, top_p
         f = _filter_logits(logits, do_sample=do_sample,
-                           temperature=temperature, top_k=top_k,
-                           top_p=top_p)                 # [S, G+1, V]
+                           temperature=t_, top_k=k_,
+                           top_p=p_)                    # [S, G+1, V]
         return f, pools
 
     if not do_sample:
-        def verify(params, pools, tables, lens, toks):
-            f, pools = _target(params, pools, tables, lens, toks)
-            out, accept, picked = accept_from_filtered(
-                f, toks, None, None, gamma=gamma, do_sample=False)
-            return out, accept, picked, pools
+        if slot_params:
+            def verify(params, pools, tables, lens, toks, samp):
+                f, pools = _target(params, pools, tables, lens, toks,
+                                   samp)
+                out, accept, picked = accept_from_filtered(
+                    f, toks, None, None, gamma=gamma, do_sample=False)
+                return out, accept, picked, pools
+        else:
+            def verify(params, pools, tables, lens, toks):
+                f, pools = _target(params, pools, tables, lens, toks,
+                                   None)
+                out, accept, picked = accept_from_filtered(
+                    f, toks, None, None, gamma=gamma, do_sample=False)
+                return out, accept, picked, pools
         return verify
 
-    if onehot_draft:
+    if slot_params:
+        if onehot_draft:
+            def verify(params, pools, tables, lens, toks, samp, key):
+                return _sample_accept(params, pools, tables, lens,
+                                      toks, samp, None, key)
+        else:
+            def verify(params, pools, tables, lens, toks, samp, dq,
+                       key):
+                return _sample_accept(params, pools, tables, lens,
+                                      toks, samp, dq, key)
+    elif onehot_draft:
         def verify(params, pools, tables, lens, toks, key):
             return _sample_accept(params, pools, tables, lens, toks,
-                                  None, key)
+                                  None, None, key)
     else:
         def verify(params, pools, tables, lens, toks, dq, key):
             return _sample_accept(params, pools, tables, lens, toks,
-                                  dq, key)
+                                  None, dq, key)
 
-    def _sample_accept(params, pools, tables, lens, toks, dq, key):
-        f, pools = _target(params, pools, tables, lens, toks)
+    def _sample_accept(params, pools, tables, lens, toks, samp, dq,
+                       key):
+        f, pools = _target(params, pools, tables, lens, toks, samp)
         out, accept, picked = accept_from_filtered(
             f, toks, dq, key, gamma=gamma, do_sample=True)
         return out, accept, picked, pools

@@ -269,6 +269,9 @@ class EngineCluster:
         self._l2g: Dict[tuple, int] = {}    # (engine, local) -> global
         self._owner: Dict[int, tuple] = {}  # global -> (engine, local)
         self._tokens: Dict[int, list] = {}
+        # per-request sampling overrides, kept so a failure-drain
+        # requeue re-submits with the SAME knobs
+        self._req_samp: Dict[int, dict] = {}
         self._done: Dict[int, np.ndarray] = {}
         # handoffs exported from a prefill engine, waiting for decode
         # capacity: (src_engine_idx, PrefilledRequest)
@@ -320,10 +323,16 @@ class EngineCluster:
         return sum(self._engines[i].config.num_slots
                    for i in self._decode_idx if i not in self._failed)
 
-    def submit(self, prompt, max_new_tokens=None) -> int:
+    def submit(self, prompt, max_new_tokens=None, temperature=None,
+               top_k=None, top_p=None) -> int:
         """Route one request to a replica (prefill tier when
         disaggregated) and queue it there; returns the CLUSTER-global
-        request id tokens stream under."""
+        request id tokens stream under.
+        ``temperature``/``top_k``/``top_p`` are this request's
+        sampling overrides, forwarded to the owning replica's per-slot
+        sampling tensors (and preserved across a failure-drain
+        requeue; in disaggregated mode they travel with the KV handoff
+        payload to the decode replica)."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         if self._disagg:
             # mirror engine.submit()'s pool-fit rejection for the
@@ -353,8 +362,13 @@ class EngineCluster:
                     f"replica; the largest live decode pool has "
                     f"only {cap}")
         rid = self._next_rid
-        self._route_submit(rid, ids, max_new_tokens)
+        samp = {k: v for k, v in (("temperature", temperature),
+                                  ("top_k", top_k), ("top_p", top_p))
+                if v is not None}
+        self._route_submit(rid, ids, max_new_tokens, samp)
         self._next_rid += 1
+        if samp:
+            self._req_samp[rid] = samp
         self._tokens[rid] = []
         self._submit_t[rid] = time.monotonic()
         return rid
@@ -373,6 +387,7 @@ class EngineCluster:
         self._tokens.pop(request_id, None)
         self._submit_t.pop(request_id, None)
         self._last_emit.pop(request_id, None)
+        self._req_samp.pop(request_id, None)
         return True
 
     def step(self) -> List[tuple]:
@@ -522,10 +537,13 @@ class EngineCluster:
         if self._stream is not None:
             self._stream(g, int(tok))
 
-    def _route_submit(self, g, prompt, max_new_tokens):
+    def _route_submit(self, g, prompt, max_new_tokens, samp=None):
         """Score candidates, submit to the winner, and map its local
         rid to the global one — shared by ``submit()`` and the
-        failure-drain requeue (which must preserve ``g``)."""
+        failure-drain requeue (which must preserve ``g`` AND the
+        request's per-slot sampling overrides)."""
+        if samp is None:
+            samp = self._req_samp.get(g, {})
         tier = self._prefill_idx if self._disagg else self._decode_idx
         cands = {i: self._engines[i] for i in tier
                  if i not in self._failed}
@@ -550,7 +568,8 @@ class EngineCluster:
             idx, overlap, depths = self._router.route(prompt, cands)
         # submit FIRST: a validation rejection must not skew the
         # router counters (the hit rate is an acceptance metric)
-        lrid = self._engines[idx].submit(prompt, max_new_tokens)
+        lrid = self._engines[idx].submit(prompt, max_new_tokens,
+                                         **samp)
         for i, d in depths.items():
             self._m_depth.labels(replica=str(i)).set(d)
         self._n_routed += 1
@@ -636,5 +655,6 @@ class EngineCluster:
             self._d_e2e.observe(1000.0 * (now - t0))
         self._last_emit.pop(g, None)
         self._owner.pop(g, None)
+        self._req_samp.pop(g, None)
         self._done[g] = np.asarray(self._tokens.pop(g, []), np.int64)
         self._n_completed += 1

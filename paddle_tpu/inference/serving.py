@@ -91,6 +91,25 @@ paged KV layout of *Ragged Paged Attention* (arxiv 2604.15464):
   ragged_batch=False)``) restores the per-width executables
   bit-for-bit. See docs/OPS.md "Ragged mixed-batch serving".
 
+- **Mega-kernelized decode tick** (``ServingConfig(fused_decode=
+  True)``, the default): inside every serving executable the decoder
+  layers' norm -> QKV, attention-epilogue -> O-projection (+
+  residual), norm -> gate/up and swiglu -> down (+ residual)
+  boundaries run as fused Pallas kernels
+  (``ops/pallas/decode_fused.py``) — per-layer activations stay in
+  VMEM across the old kernel boundaries on TPU. Off TPU the fallback
+  is bitwise the unfused graph, so fused ON==OFF is token-exact by
+  construction; GSPMD TP traces keep the unfused projections. The
+  sampling head's temperature/top-k/top-p ride as a per-SLOT device
+  tensor (``submit()`` accepts per-request overrides), so a new
+  sampling config never recompiles anything. The per-executable
+  kernel census (``monitor.kernel_census`` —
+  ``stats()["kernels_per_tick"]``, ``serving_kernels_per_tick``
+  gauge) measures the collapse. Kill switch
+  ``PADDLE_TPU_FUSED_DECODE=0``; ``=interpret`` runs the kernels
+  under the Pallas interpreter on any backend. See docs/OPS.md
+  "Decode-tick fusion & the in-executable sampling head".
+
 - **Quantized KV cache** (``ServingConfig(kv_cache_dtype="int8")`` /
   env twin ``PADDLE_TPU_KV_INT8``): the block pool stores int8 K/V
   plus per-(block, position, head) absmax scales
@@ -313,6 +332,19 @@ class ServingConfig:
     # receives ``admit_prefilled()`` imports (any role accepts them —
     # the flag documents cluster intent and shows up in stats()).
     role: str = "both"
+    # mega-kernelized decode tick (ops/pallas/decode_fused.py): fuse
+    # RMSNorm/LayerNorm into the QKV projection prologue, the
+    # attention epilogue into the O-projection + residual add, and the
+    # MLP's norm/swiglu boundaries, inside every serving executable —
+    # per-layer activations stay in VMEM across the kernel boundaries
+    # on TPU. Off-TPU the fallback is bitwise the unfused graph, so
+    # this flag is numerics-free on CPU. Kill switch
+    # PADDLE_TPU_FUSED_DECODE=0 (beats an explicit True);
+    # PADDLE_TPU_FUSED_DECODE=interpret runs the fused kernels under
+    # the Pallas interpreter on any backend (tests/bench). GSPMD TP
+    # engines keep the unfused projections (an opaque pallas_call
+    # cannot be partitioned).
+    fused_decode: bool = True
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -341,6 +373,13 @@ class ServingRequest:
     prompt: np.ndarray                  # [L] int32
     max_new_tokens: int
     submit_time: float = field(default_factory=time.monotonic)
+    # per-request sampling overrides (None = the engine's
+    # ServingConfig values); land in the engine's per-SLOT sampling
+    # tensors at admission — device DATA, so a request with its own
+    # knobs never recompiles anything
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
 
 
 @dataclass
@@ -360,6 +399,12 @@ class PrefilledRequest:
     max_new_tokens: int
     n_blocks: int                       # real (non-pad) blocks
     payload: list                       # per-layer (k_rows, v_rows)
+    # the request's per-slot sampling knobs travel with the handoff
+    # (the decode replica seats the slot with the SAME values the
+    # prefill engine sampled the first token under)
+    temperature: Optional[float] = None
+    top_k: Optional[float] = None
+    top_p: Optional[float] = None
 
 
 class _Slot:
@@ -520,9 +565,39 @@ class ServingEngine:
             binder, binder.buffer_arrays())
         do_sample = cfg.decode_strategy == "sampling"
         self._do_sample = do_sample
-        self._select = lambda lg, k: _select_token(
-            lg, k, do_sample=do_sample, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p)
+        self._select_token = _select_token
+        # -- in-executable sampling head with per-SLOT knobs ----------
+        # (temperature, top_k, top_p) ride as a [num_slots, 3] device
+        # tensor every tick instead of Python floats baked into the
+        # trace: a new sampling config (engine-wide OR per-request via
+        # submit()) is DATA — same executable, zero recompiles. Greedy
+        # engines carry the operand untouched (argmax never reads it).
+        self._samp_default = np.asarray(
+            [float(cfg.temperature), float(cfg.top_k),
+             float(cfg.top_p)], np.float32)
+        self._slot_samp = np.tile(self._samp_default,
+                                  (cfg.num_slots, 1))
+        self._samp_dev = None           # device mirror of _slot_samp
+        self._samp_row_dev = {}         # slot -> device [3] row (the
+        #                                 chunk/bucketed-prefill execs
+        #                                 take one slot's row)
+        # -- mega-kernelized decode tick ------------------------------
+        # resolved ONCE at construction (config flag + the
+        # PADDLE_TPU_FUSED_DECODE env twin); GSPMD TP traces keep the
+        # unfused projections — an opaque pallas_call cannot be
+        # partitioned, the moe_gmm gate applied here
+        from ..ops.pallas import decode_fused as _df
+        self._df = _df
+        self._fused_mode = _df.resolve_fused_mode(
+            getattr(cfg, "fused_decode", True))
+        if self._mesh is not None:
+            # GSPMD TP traces keep the unfused projections (an opaque
+            # pallas_call cannot be partitioned — fused_decode_mode
+            # would report "off" inside serving_tp_scope anyway);
+            # resolving to None HERE keeps stats()['fused_decode']
+            # honest on TP engines
+            self._fused_mode = None
+        self._kcensus = {}          # exec name -> kernel census rows
 
         self._bs = int(cfg.block_size)
         # +gamma: the speculative verify window may overhang the last
@@ -752,6 +827,16 @@ class ServingEngine:
             "analytic target-pool KV bytes the last engine tick's "
             "attention streamed from HBM (attended positions x bytes "
             "per cached position; int8 pools count data + scales)")
+        # -- decode-tick fusion observability -------------------------
+        # the headline "kernel count per decode layer down" metric is
+        # MEASURED, not asserted: every _aot_compile runs
+        # monitor.kernel_census over the compiled HLO + traced jaxpr,
+        # and this gauge tracks the tick executable's kernel count
+        self._m_kernels = monitor.gauge(
+            "serving_kernels_per_tick",
+            "kernel count of the engine's compiled tick executable "
+            "(optimized-HLO entry instructions — fusions, dots, "
+            "custom calls; the decode-tick fusion headline metric)")
         # MoE routing telemetry: per-expert load fractions + routing
         # entropy of every dispatch the engine's executables run,
         # observed at DECODE time through the trace-armed tap in
@@ -833,13 +918,18 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------
 
-    def submit(self, prompt, max_new_tokens=None) -> int:
+    def submit(self, prompt, max_new_tokens=None, temperature=None,
+               top_k=None, top_p=None) -> int:
         """Queue one request; returns its request id. Tokens stream to
-        ``stream_callback`` as ``step()``/``run()`` produce them. A
-        validation rejection still leaves a terminal queue-wait
-        observation (outcome="rejected") so the latency digest sees
-        every request that touched the front door, not only the
-        admitted survivors."""
+        ``stream_callback`` as ``step()``/``run()`` produce them.
+        ``temperature``/``top_k``/``top_p`` override the engine's
+        ``ServingConfig`` values FOR THIS REQUEST ONLY (sampling
+        engines; they land in the per-slot sampling tensors at
+        admission — device data, never a recompile). A validation
+        rejection still leaves a terminal queue-wait observation
+        (outcome="rejected") so the latency digest sees every request
+        that touched the front door, not only the admitted
+        survivors."""
         t0 = time.monotonic()
         try:
             ids = np.asarray(prompt, np.int32).reshape(-1)
@@ -861,6 +951,24 @@ class ServingEngine:
                 raise ValueError(
                     f"request needs {worst} blocks; pool has only "
                     f"{self._alloc.num_blocks - 1}")
+            has_samp = any(v is not None
+                           for v in (temperature, top_k, top_p))
+            if has_samp and not self._do_sample:
+                # greedy argmax never reads the knobs — honoring the
+                # unknown-option policy, fail instead of silently
+                # producing tokens that ignore the request
+                raise ValueError(
+                    "per-request temperature/top_k/top_p require "
+                    "decode_strategy='sampling' (this engine decodes "
+                    f"{self.config.decode_strategy!r})")
+            if temperature is not None and float(temperature) < 0.0:
+                raise ValueError(
+                    f"temperature must be >= 0, got {temperature}")
+            if top_k is not None and int(top_k) < 0:
+                raise ValueError(f"top_k must be >= 0, got {top_k}")
+            if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+                raise ValueError(
+                    f"top_p must be in (0, 1], got {top_p}")
         except ValueError:
             wait = 1000.0 * (time.monotonic() - t0)
             self._m_queue_wait.labels(outcome="rejected").observe(wait)
@@ -870,7 +978,12 @@ class ServingEngine:
             raise
         rid = self._next_rid
         self._next_rid += 1
-        req = ServingRequest(rid, ids, max_new)
+        req = ServingRequest(
+            rid, ids, max_new,
+            temperature=None if temperature is None
+            else float(temperature),
+            top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p))
         self._queue.append(req)
         self._submit_t[rid] = req.submit_time
         if self._trace is not None:
@@ -965,13 +1078,15 @@ class ServingEngine:
         sub = self._next_key()
         if self._tables_dev is None:    # only re-upload after changes
             self._tables_dev = self._dev(self._tables)
+        samp = self._samp_operand()
         if self._decode_exec is None:
-            self._decode_exec = self._compile_decode(lens, toks, sub)
+            self._decode_exec = self._compile_decode(lens, toks, samp,
+                                                     sub)
         t_l0 = time.monotonic()
         with _quiet_donation():
             out, self._pools = self._decode_exec(
                 self._params, self._pools, self._tables_dev,
-                self._dev(lens), self._dev(toks), sub)
+                self._dev(lens), self._dev(toks), samp, sub)
         out = np.asarray(out)
         t_sync = time.monotonic()
 
@@ -1040,15 +1155,17 @@ class ServingEngine:
         lens_dev = self._dev(lens)
         t_l0 = time.monotonic()         # draft + verify launch window
 
+        samp = self._samp_operand()
         dq = None
         if self._draft_model is not None:
             sub = self._next_key()
             if self._draft_exec is None:
-                self._draft_exec = self._compile_draft(lens, toks, sub)
+                self._draft_exec = self._compile_draft(lens, toks,
+                                                       samp, sub)
             with _quiet_donation():
                 props, dq, self._dpools = self._draft_exec(
                     self._dparams, self._dpools, self._tables_dev,
-                    lens_dev, self._dev(toks[:, 0]), sub)
+                    lens_dev, self._dev(toks[:, 0]), samp, sub)
             toks[:, 1:] = np.asarray(props)
         else:
             for i in active:
@@ -1057,10 +1174,10 @@ class ServingEngine:
 
         sub = self._next_key()
         if self._verify_exec is None:
-            self._verify_exec = self._compile_verify(lens, toks, dq,
-                                                     sub)
+            self._verify_exec = self._compile_verify(lens, toks, samp,
+                                                     dq, sub)
         args = [self._params, self._pools, self._tables_dev, lens_dev,
-                self._dev(toks)]
+                self._dev(toks), samp]
         if self._do_sample:
             if dq is not None:
                 args.append(dq)
@@ -1242,7 +1359,8 @@ class ServingEngine:
             dslots = np.stack([base, prime_q, row_starts, scan_lens,
                                toks[:, 0]]).astype(np.int32)
             dargs = (self._dparams, self._dpools, self._tables_dev,
-                     self._dev(drows), self._dev(dslots), sub)
+                     self._dev(drows), self._dev(dslots),
+                     self._samp_operand(), sub)
             if self._ragged_draft_exec is None:
                 self._ragged_draft_exec = self._compile_ragged_draft(
                     dargs)
@@ -1284,6 +1402,7 @@ class ServingEngine:
             args.append(self._dev(toks))
             if self._do_sample and dq is not None:
                 args.append(dq)
+        args.append(self._samp_operand())
         args.append(sub)
         if self._ragged_exec is None:
             self._ragged_exec = self._compile_ragged_step(tuple(args))
@@ -1424,6 +1543,22 @@ class ServingEngine:
             "kernel_fallbacks": sum(
                 _pa.kernel_fallback_counts().values())
             - self._fallbacks0,
+            # decode-tick fusion: mode (False | "kernel" |
+            # "interpret") + the MEASURED kernel census of the tick
+            # executable (0 before first compile). kernels_per_tick is
+            # the optimized-HLO entry instruction count (≈ kernel
+            # launches on this backend); the launch proxy counts
+            # jaxpr-level launch-rooted ops (dot/pallas/gather/...) —
+            # backend-independent, what the fused collapse shows on a
+            # CPU census with interpret-routed kernels
+            "fused_decode": self._fused_mode is not None,
+            "fused_decode_mode": self._fused_mode or "off",
+            "kernels_per_tick": self._kcensus.get(
+                "verify" if self._gamma else "decode", {}).get(
+                "hlo_kernels", 0),
+            "kernel_launch_proxy_per_tick": self._kcensus.get(
+                "verify" if self._gamma else "decode", {}).get(
+                "launch_proxy", 0),
             "chunked_prefill": self._chunked,
             "prefix_cache_enabled": self._prefix_on,
             "prefix_blocks_reused": self._n_prefix_blocks,
@@ -1552,11 +1687,14 @@ class ServingEngine:
             payload = self._export_exec(self._pools, ids_dev)
             self._n_handoffs += 1
             self._n_blocks_exported += len(slot.blocks)
+            samp = self._slot_samp[i]
             out.append(PrefilledRequest(
                 request_id=slot.rid, prompt=slot.prompt,
                 first_token=int(slot.last_token),
                 max_new_tokens=slot.max_new,
-                n_blocks=len(slot.blocks), payload=payload))
+                n_blocks=len(slot.blocks), payload=payload,
+                temperature=float(samp[0]), top_k=float(samp[1]),
+                top_p=float(samp[2])))
             self._release_handoff(i)
         self._handoff_ready = []
         return out
@@ -1624,6 +1762,7 @@ class ServingEngine:
             rid, blocks, worst, n_real, tok, max_new,
             history=list(map(int, prompt)) + [tok],
             prompt=prompt, pend_pos=None)
+        self._set_slot_samp(i, prefilled)
         self._m_occupancy.set(self.num_active)
         if self._trace is not None:
             self._trace.instant(
@@ -1667,6 +1806,7 @@ class ServingEngine:
         self._tables[i, :] = 0
         self._tables_dev = None
         self._slots[i] = None
+        self._set_slot_samp(i)
         self._results.pop(slot.rid, None)
         self._m_occupancy.set(self.num_active)
 
@@ -1842,15 +1982,19 @@ class ServingEngine:
 
     @contextlib.contextmanager
     def _trace_ctx(self):
-        """Tracing context for every ``_compile_*``: activate the
-        engine's mesh (the TP layers' sharding constraints and the
-        shard_map attention wrapper read the global mesh at trace time)
-        and un-gather the lm_head so logits leave the model
-        vocab-sharded — ``_gather_logits`` is then the step's ONE
-        explicit logits collective instead of a gather/re-shard pair.
-        Both are restored on exit, so nothing leaks into other code."""
+        """Tracing context for every ``_compile_*``: arm the fused
+        decode-tick scope (``ops/pallas/decode_fused`` — thread-local
+        like ``serving_tp_scope``, so only THIS engine's traces route
+        through the fused kernels), and under TP activate the engine's
+        mesh (the TP layers' sharding constraints and the shard_map
+        attention wrapper read the global mesh at trace time) and
+        un-gather the lm_head so logits leave the model vocab-sharded
+        — ``_gather_logits`` is then the step's ONE explicit logits
+        collective instead of a gather/re-shard pair. Everything is
+        restored on exit, so nothing leaks into other code."""
         if self._mesh is None:
-            yield
+            with self._df.fused_decode_scope(self._fused_mode):
+                yield
             return
         from ..distributed import env as _denv
         prev = _denv.get_mesh()
@@ -1865,7 +2009,11 @@ class ServingEngine:
         from ..ops.pallas.paged_attention import serving_tp_scope
         _denv.set_mesh(self._mesh)
         try:
-            with serving_tp_scope():
+            # the fused scope is armed even under TP: serving_tp_active
+            # folds into fused_decode_mode(), which reports "off" there
+            # (an opaque pallas_call cannot be GSPMD-partitioned)
+            with serving_tp_scope(), \
+                    self._df.fused_decode_scope(self._fused_mode):
                 yield
         finally:
             _denv.set_mesh(prev)
@@ -1887,18 +2035,26 @@ class ServingEngine:
             if self._moe_tap_on else contextlib.nullcontext()
         try:
             with self._trace_ctx(), _quiet_donation(), tap:
-                trace = getattr(jitted, "trace", None) \
-                    if self._mesh is not None else None
+                trace = getattr(jitted, "trace", None)
                 if trace is not None:
                     traced = trace(*args)
                     exec_ = traced.lower().compile()
-                    self._census[name] = monitor.collective_census(
-                        traced.jaxpr)
-                    return exec_
-                # older jax: no jit().trace — the executable still
-                # compiles once, the census (and the byte counters it
-                # feeds) stays empty for this engine
-                return jitted.lower(*args).compile()
+                    if self._mesh is not None:
+                        self._census[name] = monitor.collective_census(
+                            traced.jaxpr)
+                    kc = monitor.kernel_census(compiled=exec_,
+                                               jaxpr=traced.jaxpr)
+                else:
+                    # older jax: no jit().trace — the executable still
+                    # compiles once; the collective census (and the
+                    # byte counters it feeds) stays empty
+                    exec_ = jitted.lower(*args).compile()
+                    kc = monitor.kernel_census(compiled=exec_)
+                self._kcensus[name] = kc
+                if name in ("decode", "verify"):
+                    # THE tick executable: the headline fusion metric
+                    self._m_kernels.set(kc.get("hlo_kernels", 0))
+                return exec_
         finally:
             # which grouped kernel the trace just stamped: the honest
             # source for stats()['moe_fused_gmm'] (env/config/backend/
@@ -1929,6 +2085,16 @@ class ServingEngine:
         per step" assertion."""
         return dict(self._census)
 
+    def kernel_census(self) -> dict:
+        """Per-executable kernel census
+        (``monitor.kernel_census`` — optimized-HLO entry instruction
+        counts + the jaxpr-level launch proxy): ``{exec_name:
+        {hlo_kernels, hlo_fusions, hlo_custom_calls, launch_proxy,
+        ...}}``. The decode-tick fusion headline ("kernel count per
+        decode layer down") is read off the ``decode``/``verify``
+        row — measured on every engine, every compile."""
+        return dict(self._kcensus)
+
     def _tp_census_bytes(self, name) -> int:
         """Explicit per-shard ``mp`` collective payload of one
         execution of ``name`` (the census-derived per-step cost)."""
@@ -1958,6 +2124,54 @@ class ServingEngine:
         self._n_tokens += 1
         if self._stream is not None:
             self._stream(rid, tok)
+
+    def _set_slot_samp(self, i, req=None):
+        """Seat slot ``i``'s row of the per-slot sampling tensor:
+        the engine defaults overlaid with the request's overrides
+        (``req`` may be a ServingRequest or a PrefilledRequest — both
+        carry the three optional fields). The device mirror is
+        invalidated only when the row actually changes, so steady
+        uniform traffic re-uploads nothing."""
+        row = self._samp_default.copy()
+        if req is not None:
+            if getattr(req, "temperature", None) is not None:
+                row[0] = float(req.temperature)
+            if getattr(req, "top_k", None) is not None:
+                row[1] = float(req.top_k)
+            if getattr(req, "top_p", None) is not None:
+                row[2] = float(req.top_p)
+        if not np.array_equal(self._slot_samp[i], row):
+            self._slot_samp[i] = row
+            self._samp_dev = None
+            self._samp_row_dev.pop(i, None)
+
+    def _samp_operand(self):
+        """The [num_slots, 3] per-slot sampling tensor, uploaded only
+        after a change (the ``_tables_dev`` pattern)."""
+        if self._samp_dev is None:
+            self._samp_dev = self._dev(self._slot_samp)
+        return self._samp_dev
+
+    def _samp_row(self, i):
+        """One slot's [3] sampling row for the single-slot executables
+        (chunk / bucketed prefill) — cached per admission so a long
+        prompt's chunk loop pays ONE upload, not one per chunk."""
+        row = self._samp_row_dev.get(i)
+        if row is None:
+            row = self._samp_row_dev[i] = self._dev(self._slot_samp[i])
+        return row
+
+    def _select_rows(self, lg, key, samp):
+        """Per-slot token selection: ``samp``'s trailing axis is
+        (temperature, top_k, top_p) — traced DATA through the shared
+        ``_filter_logits`` pipeline, so every sampling config rides
+        one executable. ``lg``: [S, V] (or any leading shape samp
+        broadcasts over); greedy engines argmax and never read
+        ``samp``."""
+        return self._select_token(
+            lg, key, do_sample=self._do_sample,
+            temperature=samp[..., 0], top_k=samp[..., 1],
+            top_p=samp[..., 2])
 
     def _next_key(self):
         """Greedy decode never consumes randomness — skip the per-step
@@ -2016,6 +2230,7 @@ class ServingEngine:
                 history=list(map(int, req.prompt)),
                 prompt=np.asarray(req.prompt, np.int32),
                 pend_pos=cached)
+            self._set_slot_samp(i, req)
             self._m_occupancy.set(self.num_active)
             if self._trace is not None:
                 self._trace.instant(
@@ -2151,7 +2366,7 @@ class ServingEngine:
                 tok, self._pools = self._chunk_exec(
                     self._params, ids_dev, self._pools, table_dev,
                     pos, self._dev(np.int32(int(part.size) - 1)),
-                    self._next_key())
+                    self._samp_row(i), self._next_key())
             if self._draft_model is not None:
                 # prime the draft cache over the same positions (its
                 # pools ride the same block table)
@@ -2265,7 +2480,7 @@ class ServingEngine:
             tok, self._pools = exec_(
                 self._params, self._dev(ids),
                 self._dev(np.int32(n_real)), self._pools,
-                self._dev(self._tables[i]), sub)
+                self._dev(self._tables[i]), self._samp_row(i), sub)
         if self._draft_model is not None:
             # prime the draft model's cache with the same prompt K/V
             # (its pools share the slot's block table)
@@ -2358,6 +2573,7 @@ class ServingEngine:
         self._tables[i, :] = 0
         self._tables_dev = None
         self._slots[i] = None
+        self._set_slot_samp(i)
         toks = self._results.pop(slot.rid)
         if self.config.retain_results:
             self._done[slot.rid] = np.asarray(toks, np.int64)
@@ -2371,11 +2587,12 @@ class ServingEngine:
 
     # -- compiled steps -----------------------------------------------
 
-    def _compile_decode(self, lens, toks, key):
+    def _compile_decode(self, lens, toks, samp, key):
         """AOT-compile the fixed-shape batched decode step ONCE; every
         later tick reuses the executable (shape change is impossible —
-        slots, tables and lengths are static width)."""
-        def decode(params, pools, tables, lens, toks, key):
+        slots, tables and lengths are static width; the per-slot
+        sampling knobs ride in ``samp`` as data)."""
+        def decode(params, pools, tables, lens, toks, samp, key):
             # inactive slots (lens == 0) are pad rows — keep them out
             # of the MoE routing telemetry
             with _moe.serving_rows_mask(lens > 0):
@@ -2384,14 +2601,14 @@ class ServingEngine:
                     block_tables=tables, cache_lens=lens)
             row = self._gather_logits(logits[:, -1, :])
             _, sub = jax.random.split(key)
-            tok, _ = self._select(row, sub)
+            tok, _ = self._select_rows(row, sub, samp)
             return tok, pools
 
         jitted = jax.jit(decode, donate_argnums=(1,))
         exec_ = self._aot_compile(
             "decode", jitted,
             (self._params, self._pools, self._dev(self._tables),
-             self._dev(lens), self._dev(toks), key))
+             self._dev(lens), self._dev(toks), samp, key))
         if self._mesh is not None:
             self._tp_step_bytes = self._tp_census_bytes("decode")
         self._m_decode_compiles.inc()
@@ -2413,7 +2630,7 @@ class ServingEngine:
         length with zero padding-bucket waste."""
         c = self._chunk
 
-        def chunk(params, ids, pools, table_row, pos, last, key):
+        def chunk(params, ids, pools, table_row, pos, last, samp, key):
             lens = jnp.reshape(pos.astype(jnp.int32), (1,))
             live = jnp.arange(c, dtype=jnp.int32) <= last
             with _moe.serving_rows_mask(live):
@@ -2424,7 +2641,7 @@ class ServingEngine:
                 logits, last, 1, axis=1)[:, 0, :]
             row = self._gather_logits(row)
             _, sub = jax.random.split(key)
-            tok, _ = self._select(row, sub)
+            tok, _ = self._select_rows(row, sub, samp)
             return tok[0], pools
 
         jitted = jax.jit(chunk, donate_argnums=(2,))
@@ -2432,7 +2649,8 @@ class ServingEngine:
             "chunk", jitted,
             (self._params, self._dev(np.zeros((1, c), np.int32)),
              self._pools, self._dev(np.zeros((self._mb,), np.int32)),
-             self._dev(np.int32(0)), self._dev(np.int32(0)), key))
+             self._dev(np.int32(0)), self._dev(np.int32(0)),
+             self._dev(self._samp_default), key))
         self._m_prefill_compiles.labels(bucket=f"chunk{c}").inc()
         self._n_prefill_compiles += 1
         return exec_
@@ -2470,7 +2688,7 @@ class ServingEngine:
                             self._dev(np.int32(0))))
 
     def _compile_prefill(self, bucket, key):
-        def prefill(params, ids, n_real, pools, table_row, key):
+        def prefill(params, ids, n_real, pools, table_row, samp, key):
             dense = self.model.init_caches(1, bucket)
             live = jnp.arange(bucket, dtype=jnp.int32) < n_real
             with _moe.serving_rows_mask(live):
@@ -2484,7 +2702,7 @@ class ServingEngine:
                 logits, n_real - 1, 1, axis=1)[:, 0, :]
             last = self._gather_logits(last)
             _, sub = jax.random.split(key)
-            tok, _ = self._select(last, sub)
+            tok, _ = self._select_rows(last, sub, samp)
             return tok[0], pools
 
         jitted = jax.jit(prefill, donate_argnums=(3,))
@@ -2492,25 +2710,26 @@ class ServingEngine:
             f"prefill{bucket}", jitted,
             (self._params, self._dev(np.zeros((1, bucket), np.int32)),
              self._dev(np.int32(0)), self._pools,
-             self._dev(np.zeros((self._mb,), np.int32)), key))
+             self._dev(np.zeros((self._mb,), np.int32)),
+             self._dev(self._samp_default), key))
         self._m_prefill_compiles.labels(bucket=bucket).inc()
         self._n_prefill_compiles += 1
         return exec_
 
-    def _compile_verify(self, lens, toks, dq, key):
+    def _compile_verify(self, lens, toks, samp, dq, key):
         """AOT-compile the fixed-gamma multi-token verify step ONCE
         (the speculative decode executable — counted in
         ``decode_compiles`` so the zero-steady-state-recompile
-        assertion covers speculative mode too)."""
+        assertion covers speculative mode too). The per-slot sampling
+        knobs ride as the ``samp`` operand (``slot_params`` mode of
+        ``build_verify_step``) — distinct configs, one executable."""
         from ..generation import speculative as _spec
-        cfg = self.config
         verify = _spec.build_verify_step(
             self._model_step, gamma=self._gamma,
-            do_sample=self._do_sample, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p,
+            do_sample=self._do_sample,
             onehot_draft=self._draft_model is None,
             gather_logits=self._gather_logits
-            if self._mesh is not None else None)
+            if self._mesh is not None else None, slot_params=True)
         g = self._gamma
 
         def verify_masked(params, pools, tables, lens, *rest):
@@ -2521,7 +2740,7 @@ class ServingEngine:
 
         jitted = jax.jit(verify_masked, donate_argnums=(1,))
         args = [self._params, self._pools, self._dev(self._tables),
-                self._dev(lens), self._dev(toks)]
+                self._dev(lens), self._dev(toks), samp]
         if self._do_sample:
             if dq is not None:
                 args.append(dq)
@@ -2554,7 +2773,6 @@ class ServingEngine:
         collective contract of the per-width path."""
         from ..generation import _filter_logits
         from ..generation import speculative as _spec
-        cfg = self.config
         g = self._gamma
         r = self._rows
         do_sample = self._do_sample
@@ -2578,15 +2796,16 @@ class ServingEngine:
                     ragged_meta=meta)
             lg = logits[0]                          # [R, V(/tp)]
             if not g:
-                (key,) = rest
+                samp, key = rest
                 rows = jnp.take(lg, last_rows.astype(jnp.int32),
                                 axis=0)
                 rows = self._gather_logits(rows)    # the ONE collective
                 _, sel = jax.random.split(key)
-                tok, _ = self._select(rows, sel)
+                tok, _ = self._select_rows(rows, sel, samp)
                 return tok, pools
             toks = rest[0]
-            dq = rest[1] if len(rest) == 3 else None
+            dq = rest[1] if len(rest) == 4 else None
+            samp = rest[-2]
             key = rest[-1]
             # one take + ONE gather covers the per-slot continuation
             # rows AND the verify windows
@@ -2599,10 +2818,13 @@ class ServingEngine:
             rows = self._gather_logits(rows)
             rows = rows.reshape(toks.shape[0], g + 2, -1)
             sel_key, acc_key = jax.random.split(key)
-            first_tok, _ = self._select(rows[:, 0, :], sel_key)
+            first_tok, _ = self._select_rows(rows[:, 0, :], sel_key,
+                                             samp)
+            # per-slot knobs over the verify windows: [S] broadcasts
+            # across each slot's gamma+1 rows inside _filter_logits
             f = _filter_logits(rows[:, 1:, :], do_sample=do_sample,
-                               temperature=cfg.temperature,
-                               top_k=cfg.top_k, top_p=cfg.top_p)
+                               temperature=samp[:, 0],
+                               top_k=samp[:, 1], top_p=samp[:, 2])
             out, accept, _logp = _spec.accept_from_filtered(
                 f, toks, dq, acc_key, gamma=g, do_sample=do_sample)
             return first_tok, out, accept, pools
@@ -2630,17 +2852,15 @@ class ServingEngine:
         (2) run the gamma+1-step proposal scan. With a draft model the
         engine's steady state is therefore exactly TWO executables."""
         from ..generation import speculative as _spec
-        cfg = self.config
         g = self._gamma
         prime = self._chunked and self._prefill_rows > 0
         loop = _spec.build_draft_loop(
             self._draft_step, gamma=g, do_sample=self._do_sample,
-            temperature=cfg.temperature, top_k=cfg.top_k,
-            top_p=cfg.top_p, want_probs=self._do_sample,
+            want_probs=self._do_sample,
             gather_logits=self._gather_logits
-            if self._mesh is not None else None)
+            if self._mesh is not None else None, slot_params=True)
 
-        def dstep(dparams, dpools, tables, drows, dslots, key):
+        def dstep(dparams, dpools, tables, drows, dslots, samp, key):
             ids, row_slot, prime_pos = drows[0], drows[1], drows[2]
             base, prime_q, row_starts, scan_lens, cur = (
                 dslots[0], dslots[1], dslots[2], dslots[3], dslots[4])
@@ -2669,7 +2889,7 @@ class ServingEngine:
             # rows, excluded from the draft's routing telemetry
             with _moe.serving_rows_mask(scan_lens < self._overflow):
                 props, qp, dpools = loop(dparams, dpools, tables,
-                                         scan_lens, cur, key)
+                                         scan_lens, cur, samp, key)
             if qp is None:
                 return props, dpools
             return props, qp, dpools
@@ -2677,28 +2897,31 @@ class ServingEngine:
         jitted = jax.jit(dstep, donate_argnums=(1,))
         return self._aot_compile("draft", jitted, args)
 
-    def _compile_draft(self, lens, toks, key):
+    def _compile_draft(self, lens, toks, samp, key):
         """AOT-compile the draft model's gamma+1-step proposal scan
-        ONCE (drafter='model')."""
+        ONCE (drafter='model'). ``samp`` carries the per-slot sampling
+        knobs — the draft filters its proposal logits with the SAME
+        values the verify step filters the target's (the
+        rejection-sampling soundness requirement, per slot)."""
         from ..generation import speculative as _spec
-        cfg = self.config
         loop = _spec.build_draft_loop(
             self._draft_step, gamma=self._gamma,
-            do_sample=self._do_sample, temperature=cfg.temperature,
-            top_k=cfg.top_k, top_p=cfg.top_p,
+            do_sample=self._do_sample,
             want_probs=self._do_sample,
             gather_logits=self._gather_logits
-            if self._mesh is not None else None)
+            if self._mesh is not None else None, slot_params=True)
 
-        def draft_masked(dparams, dpools, tables, lens, cur, key):
+        def draft_masked(dparams, dpools, tables, lens, cur, samp,
+                         key):
             with _moe.serving_rows_mask(lens > 0):
-                return loop(dparams, dpools, tables, lens, cur, key)
+                return loop(dparams, dpools, tables, lens, cur, samp,
+                            key)
 
         jitted = jax.jit(draft_masked, donate_argnums=(1,))
         return self._aot_compile(
             "draft", jitted,
             (self._dparams, self._dpools, self._dev(self._tables),
-             self._dev(lens), self._dev(toks[:, 0]), key))
+             self._dev(lens), self._dev(toks[:, 0]), samp, key))
 
     def _compile_draft_prefill(self, bucket):
         """Draft-cache twin of ``_compile_prefill``: scatter the draft

@@ -62,51 +62,12 @@ class GPTAttention(Layer):
         b, l, d = x.shape
         qkv = self.qkv_proj(x)
 
-        if kv_cache is not None and block_tables is not None \
-                and ragged_meta is not None:
-            # ragged mixed batch: [1, R] packed rows over the pool
-            (q_lens, row_starts, row_slot, row_pos, narrow_iota,
-             win_iota) = ragged_meta
-
-            def attn_r(a, kp, vp, tables, lens, ql, rs, sl, pos_r,
-                       nwin, win):
-                from .llama import ragged_paged_attention_decode
-                q, k, v = jnp.split(a, 3, axis=-1)
-                r = b * l                        # packed rows (b == 1)
-                qh = q.reshape(r, self.num_heads, self.head_dim)
-                kh = k.reshape(r, self.num_heads, self.head_dim)
-                vh = v.reshape(r, self.num_heads, self.head_dim)
-                out, kp2, vp2 = ragged_paged_attention_decode(
-                    qh, kh, vh, kp, vp, tables, lens, ql, rs, sl,
-                    pos_r, nwin, win, self.head_dim)
-                return out.reshape(b, l, d), kp2, vp2
-
-            ctx, kp2, vp2 = apply_jax(
-                "gpt_attention_ragged", attn_r, qkv, kv_cache[0],
-                kv_cache[1], block_tables, cache_lens, q_lens,
-                row_starts, row_slot, row_pos, narrow_iota, win_iota,
-                n_outputs=3)
-            ctx = constraint(ctx, None, None, "mp")
-            return self.out_proj(ctx), (kp2, vp2)
-
         if kv_cache is not None and block_tables is not None:
-            # paged decode: kv_cache is the shared (k_pool, v_pool)
-            def attn_p(a, kp, vp, tables, lens):
-                from .llama import paged_attention_decode
-                q, k, v = jnp.split(a, 3, axis=-1)
-                qh = q.reshape(b, l, self.num_heads, self.head_dim)
-                kh = k.reshape(b, l, self.num_heads, self.head_dim)
-                vh = v.reshape(b, l, self.num_heads, self.head_dim)
-                out, kp2, vp2 = paged_attention_decode(
-                    qh, kh, vh, kp, vp, tables, lens, self.head_dim)
-                return out.reshape(b, l, d), kp2, vp2
-
-            ctx, kp2, vp2 = apply_jax("gpt_attention_paged", attn_p,
-                                      qkv, kv_cache[0], kv_cache[1],
-                                      block_tables, cache_lens,
-                                      n_outputs=3)
+            ctx, kv2 = self._attend_serving(qkv, kv_cache,
+                                            block_tables, cache_lens,
+                                            ragged_meta, b, l, d)
             ctx = constraint(ctx, None, None, "mp")
-            return self.out_proj(ctx), (kp2, vp2)
+            return self.out_proj(ctx), kv2
 
         if kv_cache is not None:
             def attn_c(a, kc, vc, off):
@@ -137,6 +98,55 @@ class GPTAttention(Layer):
         ctx = constraint(ctx, None, None, "mp")
         return self.out_proj(ctx)
 
+    def _attend_serving(self, qkv, kv_cache, block_tables, cache_lens,
+                        ragged_meta, b, l, d):
+        """Paged/ragged split + write + attend WITHOUT the output
+        projection — the shared core of the serving branches of
+        ``forward`` and the fused decode path (which runs the output
+        projection inside the fused residual-add epilogue). Returns
+        ``(ctx [B, L, D], (k_pool, v_pool))``."""
+        if ragged_meta is not None:
+            # ragged mixed batch: [1, R] packed rows over the pool
+            (q_lens, row_starts, row_slot, row_pos, narrow_iota,
+             win_iota) = ragged_meta
+
+            def attn_r(a, kp, vp, tables, lens, ql, rs, sl, pos_r,
+                       nwin, win):
+                from .llama import ragged_paged_attention_decode
+                q, k, v = jnp.split(a, 3, axis=-1)
+                r = b * l                        # packed rows (b == 1)
+                qh = q.reshape(r, self.num_heads, self.head_dim)
+                kh = k.reshape(r, self.num_heads, self.head_dim)
+                vh = v.reshape(r, self.num_heads, self.head_dim)
+                out, kp2, vp2 = ragged_paged_attention_decode(
+                    qh, kh, vh, kp, vp, tables, lens, ql, rs, sl,
+                    pos_r, nwin, win, self.head_dim)
+                return out.reshape(b, l, d), kp2, vp2
+
+            ctx, kp2, vp2 = apply_jax(
+                "gpt_attention_ragged", attn_r, qkv, kv_cache[0],
+                kv_cache[1], block_tables, cache_lens, q_lens,
+                row_starts, row_slot, row_pos, narrow_iota, win_iota,
+                n_outputs=3)
+            return ctx, (kp2, vp2)
+
+        # paged decode: kv_cache is the shared (k_pool, v_pool)
+        def attn_p(a, kp, vp, tables, lens):
+            from .llama import paged_attention_decode
+            q, k, v = jnp.split(a, 3, axis=-1)
+            qh = q.reshape(b, l, self.num_heads, self.head_dim)
+            kh = k.reshape(b, l, self.num_heads, self.head_dim)
+            vh = v.reshape(b, l, self.num_heads, self.head_dim)
+            out, kp2, vp2 = paged_attention_decode(
+                qh, kh, vh, kp, vp, tables, lens, self.head_dim)
+            return out.reshape(b, l, d), kp2, vp2
+
+        ctx, kp2, vp2 = apply_jax("gpt_attention_paged", attn_p,
+                                  qkv, kv_cache[0], kv_cache[1],
+                                  block_tables, cache_lens,
+                                  n_outputs=3)
+        return ctx, (kp2, vp2)
+
 
 class GPTDecoderLayer(Layer):
     def __init__(self, config: GPTConfig):
@@ -154,8 +164,56 @@ class GPTDecoderLayer(Layer):
             input_is_parallel=True)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
+    def _fused_decode_eligible(self):
+        """Fused decode-tick gate (the Llama twin, LayerNorm flavor):
+        a serving trace armed the fused scope, the layer is in eval
+        mode (the fused epilogues skip the — inert — eval dropout),
+        and every weight is a plain float tensor."""
+        from ..ops.pallas import decode_fused as _df
+        if self.training or _df.fused_decode_mode() is None:
+            return False
+        return _df.fused_params_ok(
+            self.ln_1.weight, self.ln_2.weight,
+            getattr(self.attn.qkv_proj, "weight", None),
+            getattr(self.attn.out_proj, "weight", None),
+            getattr(self.linear1, "weight", None),
+            getattr(self.linear2, "weight", None))
+
+    def _forward_decode_fused(self, x, kv_cache, block_tables,
+                              cache_lens, ragged_meta):
+        """Mega-kernelized GPT decode tick (ISSUE 13): LayerNorm fused
+        into the (already single) QKV projection, attention epilogue
+        into the output projection + residual add, the second
+        LayerNorm into the first MLP linear, and tanh-gelu into the
+        second MLP linear + residual add. The XLA fallback is bitwise
+        this layer's unfused eval-mode ops."""
+        from ..ops.pallas import decode_fused as _df
+        b, l, d = x.shape
+        (qkv,) = _df.norm_matmul(
+            x, self.ln_1.weight, self.ln_1.bias,
+            [self.attn.qkv_proj.weight], [self.attn.qkv_proj.bias],
+            eps=self.ln_1._epsilon, kind="ln")
+        ctx, new_cache = self.attn._attend_serving(
+            qkv, kv_cache, block_tables, cache_lens, ragged_meta,
+            b, l, d)
+        x2 = _df.matmul_residual([ctx], self.attn.out_proj.weight,
+                                 self.attn.out_proj.bias, x)
+        (g,) = _df.norm_matmul(
+            x2, self.ln_2.weight, self.ln_2.bias,
+            [self.linear1.weight], [self.linear1.bias],
+            eps=self.ln_2._epsilon, kind="ln")
+        out = _df.matmul_residual([g], self.linear2.weight,
+                                  self.linear2.bias, x2,
+                                  act="gelu_tanh")
+        return out, new_cache
+
     def forward(self, x, kv_cache=None, offset=None, block_tables=None,
                 cache_lens=None, ragged_meta=None):
+        if kv_cache is not None and block_tables is not None \
+                and self._fused_decode_eligible():
+            return self._forward_decode_fused(x, kv_cache,
+                                              block_tables, cache_lens,
+                                              ragged_meta)
         new_cache = None
         if kv_cache is not None:
             a, new_cache = self.attn(self.ln_1(x), kv_cache, offset,

@@ -324,6 +324,19 @@ class LlamaAttention(Layer):
         speculative verify chunk at ``cache_lens + t``), the K/V write
         and the ragged attention run through
         ``paged_attention_decode``."""
+        ctx, kp2, vp2 = self._attend_paged(q, k, v, rope_cos, rope_sin,
+                                           kv_cache, block_tables,
+                                           cache_lens, b, l)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx), (kp2, vp2)
+
+    def _attend_paged(self, q, k, v, rope_cos, rope_sin, kv_cache,
+                      block_tables, cache_lens, b, l):
+        """Rope + pool write + ragged paged attention WITHOUT the
+        O-projection — the shared core of ``_forward_paged`` and the
+        fused decode path (which runs the O-projection inside the
+        fused residual-add epilogue). Returns ``(ctx [B, L, H*D],
+        k_pool, v_pool)``."""
 
         def attn_p(q_a, k_a, v_a, cos_t, sin_t, kp, vp, tables, lens):
             qh = q_a.reshape(b, l, self.num_heads, self.head_dim)
@@ -340,12 +353,10 @@ class LlamaAttention(Layer):
             return (out.reshape(b, l, self.num_heads * self.head_dim),
                     kp2, vp2)
 
-        ctx, kp2, vp2 = apply_jax(
+        return apply_jax(
             "llama_attention_paged", attn_p, q, k, v, rope_cos, rope_sin,
             kv_cache[0], kv_cache[1], block_tables, cache_lens,
             n_outputs=3)
-        ctx = constraint(ctx, None, None, "mp")
-        return self.o_proj(ctx), (kp2, vp2)
 
     def _forward_ragged(self, q, k, v, rope_cos, rope_sin, kv_cache,
                         block_tables, cache_lens, ragged_meta, b, l):
@@ -356,6 +367,19 @@ class LlamaAttention(Layer):
         overflow position whose clamped rope garbage never survives
         the null-routed write), and the write+attend runs through
         ``ragged_paged_attention_decode``."""
+        ctx, kp2, vp2 = self._attend_ragged(q, k, v, rope_cos,
+                                            rope_sin, kv_cache,
+                                            block_tables, cache_lens,
+                                            ragged_meta, b, l)
+        ctx = constraint(ctx, None, None, "mp")
+        return self.o_proj(ctx), (kp2, vp2)
+
+    def _attend_ragged(self, q, k, v, rope_cos, rope_sin, kv_cache,
+                       block_tables, cache_lens, ragged_meta, b, l):
+        """Per-row rope + scatter + ragged attention WITHOUT the
+        O-projection — the shared core of ``_forward_ragged`` and the
+        fused decode path. Returns ``(ctx [B, L, H*D], k_pool,
+        v_pool)``."""
         (q_lens, row_starts, row_slot, row_pos, narrow_iota,
          win_iota) = ragged_meta
 
@@ -377,13 +401,11 @@ class LlamaAttention(Layer):
             return (out.reshape(b, l, self.num_heads * self.head_dim),
                     kp2, vp2)
 
-        ctx, kp2, vp2 = apply_jax(
+        return apply_jax(
             "llama_attention_ragged", attn_r, q, k, v, rope_cos,
             rope_sin, kv_cache[0], kv_cache[1], block_tables,
             cache_lens, q_lens, row_starts, row_slot, row_pos,
             narrow_iota, win_iota, n_outputs=3)
-        ctx = constraint(ctx, None, None, "mp")
-        return self.o_proj(ctx), (kp2, vp2)
 
     def _forward_cached(self, q, k, v, rope_cos, rope_sin, kv_cache,
                         offset, b, l, attention_mask=None,
@@ -467,10 +489,77 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
 
+    def _fused_decode_eligible(self):
+        """Fused decode-tick path gate: a serving trace armed the
+        fused scope (``ops/pallas/decode_fused`` — engine kill switch,
+        config flag, GSPMD-TP exclusion all fold into the mode) and
+        every weight the fused kernels would consume is a plain float
+        tensor (weight-only int8 layers keep the module path)."""
+        from ..ops.pallas import decode_fused as _df
+        if _df.fused_decode_mode() is None:
+            return False
+        attn, mlp = self.self_attn, self.mlp
+        return _df.fused_params_ok(
+            self.input_layernorm.weight,
+            self.post_attention_layernorm.weight,
+            getattr(attn.q_proj, "weight", None),
+            getattr(attn.k_proj, "weight", None),
+            getattr(attn.v_proj, "weight", None),
+            getattr(attn.o_proj, "weight", None),
+            getattr(mlp.gate_proj, "weight", None),
+            getattr(mlp.up_proj, "weight", None),
+            getattr(mlp.down_proj, "weight", None))
+
+    def _forward_decode_fused(self, hidden_states, rope_cos, rope_sin,
+                              kv_cache, block_tables, cache_lens,
+                              ragged_meta):
+        """Mega-kernelized decode tick (ISSUE 13): the four per-layer
+        fusion boundaries closed — RMSNorm fused into the QKV
+        projection prologue, the attention epilogue into the
+        O-projection + residual add, the post-attention RMSNorm into
+        the gate/up prologue, and swiglu into the down-projection +
+        residual add — via ``ops/pallas/decode_fused``. Per-layer
+        activations stay in VMEM across every boundary on TPU; the
+        XLA fallback is bitwise this layer's unfused ops, so CPU
+        engines with fusion ON compile today's graph unchanged."""
+        from ..ops.pallas import decode_fused as _df
+        attn = self.self_attn
+        b, l, _ = hidden_states.shape
+        eps = self.input_layernorm._epsilon
+        q, k, v = _df.norm_matmul(
+            hidden_states, self.input_layernorm.weight, None,
+            [attn.q_proj.weight, attn.k_proj.weight,
+             attn.v_proj.weight],
+            [attn.q_proj.bias, attn.k_proj.bias, attn.v_proj.bias],
+            eps=eps, kind="rms")
+        if ragged_meta is not None:
+            ctx, kp2, vp2 = attn._attend_ragged(
+                q, k, v, rope_cos, rope_sin, kv_cache, block_tables,
+                cache_lens, ragged_meta, b, l)
+        else:
+            ctx, kp2, vp2 = attn._attend_paged(
+                q, k, v, rope_cos, rope_sin, kv_cache, block_tables,
+                cache_lens, b, l)
+        h = _df.matmul_residual([ctx], attn.o_proj.weight,
+                                attn.o_proj.bias, hidden_states)
+        mlp = self.mlp
+        g, u = _df.norm_matmul(
+            h, self.post_attention_layernorm.weight, None,
+            [mlp.gate_proj.weight, mlp.up_proj.weight], [None, None],
+            eps=self.post_attention_layernorm._epsilon, kind="rms")
+        out = _df.matmul_residual([g, u], mlp.down_proj.weight,
+                                  mlp.down_proj.bias, h, act="swiglu")
+        return out, (kp2, vp2)
+
     def forward(self, hidden_states, rope_cos, rope_sin,
                 attention_mask=None, kv_cache=None, offset=None,
                 position_ids=None, block_tables=None, cache_lens=None,
                 ragged_meta=None):
+        if kv_cache is not None and block_tables is not None \
+                and self._fused_decode_eligible():
+            return self._forward_decode_fused(
+                hidden_states, rope_cos, rope_sin, kv_cache,
+                block_tables, cache_lens, ragged_meta)
         residual = hidden_states
         h = self.input_layernorm(hidden_states)
         new_cache = None

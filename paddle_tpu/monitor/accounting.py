@@ -22,14 +22,16 @@ framework at compile time instead of from offline trace parses:
 """
 from __future__ import annotations
 
+import re as _re
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from .registry import get_registry
 
-__all__ = ["record_compiled_step", "collective_census", "step_report",
-           "step_reports", "sample_device_memory", "analytic_mfu",
+__all__ = ["record_compiled_step", "collective_census",
+           "kernel_census", "step_report", "step_reports",
+           "sample_device_memory", "analytic_mfu",
            "device_peak_flops"]
 
 # jaxpr primitive -> census op family
@@ -111,6 +113,110 @@ def collective_census(jaxpr) -> List[dict]:
     if n_constraint[0]:
         out.append({"op": "sharding_constraint", "axis": "",
                     "count": n_constraint[0], "bytes": 0})
+    return out
+
+
+# HLO entry-computation instructions that are bookkeeping, not kernel
+# thunks — everything else in the optimized entry is (approximately)
+# one launch on the target backend
+_HLO_SKIP_OPS = {"parameter", "constant", "tuple", "get-tuple-element",
+                 "bitcast", "after-all", "add-dependency", "domain",
+                 "partition-id", "replica-id"}
+
+# jaxpr primitives that root a kernel launch regardless of backend:
+# matmuls/convs (MXU), Pallas custom calls, and the data-movement /
+# reduction ops XLA cannot fuse into a neighbor. Elementwise chains
+# count 0 — XLA fuses them into these roots — so this is a LOWER-bound
+# launch proxy that is stable across backends (the interpret-mode
+# pallas_call stays ONE equation here even though its CPU emulation
+# expands in HLO, which is exactly what makes the fused-decode
+# collapse measurable on a CPU census).
+_LAUNCH_PRIMS = {"dot_general", "conv_general_dilated", "pallas_call",
+                 "sort", "gather", "scatter", "scatter-add",
+                 "scatter-mul", "scatter-min", "scatter-max",
+                 "argmax", "argmin", "top_k", "while", "fori"}
+
+_HLO_ENTRY_RE = _re.compile(r"^ENTRY [^\n]*\{\n(.*?)^\}",
+                            _re.S | _re.M)
+_HLO_INSTR_RE = _re.compile(
+    r"\s+(?:ROOT\s+)?[%\w\.\-]+ = (?:\([^=]*?\)|\S+) "
+    r"([a-zA-Z][\w\-]*)\(")
+
+
+def kernel_census(compiled=None, jaxpr=None) -> dict:
+    """Kernel-count census of one executable (ISSUE 13 — the
+    machinery behind ``ServingEngine.stats()['kernels_per_tick']`` and
+    the ``serving_kernels_per_tick`` gauge, so "kernel count per
+    decode layer down" is measured, not asserted). Two views:
+
+    - ``hlo_kernels`` (+ ``hlo_fusions``/``hlo_custom_calls``/
+      ``hlo_by_op``): instructions of the optimized HLO ENTRY
+      computation (``compiled.as_text()``), excluding pure
+      bookkeeping — each is approximately one kernel thunk on the
+      compiling backend. The truth on real TPU hardware.
+    - ``launch_proxy`` (+ ``launch_by_op``): a jaxpr walk (the PR 2
+      collective-census machinery, same recursion through
+      pjit/scan/while/shard_map bodies) counting launch-rooted
+      primitives. Backend-independent: a ``pallas_call`` is ONE entry
+      whether it will run as a real TPU kernel or under the
+      interpreter, so a CPU census of the fused decode tick shows the
+      same collapse the TPU compile gets.
+
+    Either input may be omitted; unavailable views are simply absent
+    (older jax without ``as_text`` degrades gracefully)."""
+    out = {}
+    if jaxpr is not None:
+        n = [0]
+        by: Dict[str, int] = {}
+
+        def walk(jx):
+            core = getattr(jx, "jaxpr", jx)     # ClosedJaxpr -> Jaxpr
+            for eqn in getattr(core, "eqns", ()):
+                name = eqn.primitive.name
+                if name in _LAUNCH_PRIMS or name.startswith("reduce_") \
+                        or name.startswith("cum"):
+                    n[0] += 1
+                    by[name] = by.get(name, 0) + 1
+                if name == "pallas_call":
+                    # ONE launch — its body's ops run INSIDE the
+                    # kernel, never as separate thunks (recursing
+                    # there would double-count the very boundaries
+                    # the fusion removed)
+                    continue
+                for v in eqn.params.values():
+                    vs = v if isinstance(v, (list, tuple)) else (v,)
+                    for e in vs:
+                        inner = getattr(e, "jaxpr", e)
+                        if hasattr(inner, "eqns"):
+                            walk(e)
+
+        try:
+            walk(jaxpr)
+            out["launch_proxy"] = n[0]
+            out["launch_by_op"] = dict(sorted(by.items()))
+        except Exception:       # pragma: no cover - census never fatal
+            pass
+    if compiled is not None:
+        try:
+            txt = compiled.as_text()
+        except Exception:       # pragma: no cover - older jax
+            txt = None
+        if txt:
+            m = _HLO_ENTRY_RE.search(txt)
+            body = m.group(1) if m else ""
+            by = {}
+            for line in body.splitlines():
+                im = _HLO_INSTR_RE.match(line)
+                if im is None:
+                    continue
+                op = im.group(1)
+                if op in _HLO_SKIP_OPS:
+                    continue
+                by[op] = by.get(op, 0) + 1
+            out["hlo_kernels"] = sum(by.values())
+            out["hlo_fusions"] = by.get("fusion", 0)
+            out["hlo_custom_calls"] = by.get("custom-call", 0)
+            out["hlo_by_op"] = dict(sorted(by.items()))
     return out
 
 

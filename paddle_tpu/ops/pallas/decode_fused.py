@@ -1,0 +1,573 @@
+"""Fused decode-tick kernels: norm -> projection(s) and
+projection -> residual-add, for the serving engine's ONE ragged
+executable.
+
+PR 7 collapsed the engine to one executable per tick, but INSIDE that
+executable each decoder layer was still a chain of separate kernels —
+norm, three QKV dots, attention, O-projection, norm, three MLP dots —
+every boundary a launch + an HBM round-trip of the per-layer
+activation. Per MPK ("Mega-Kernelizing Tensor Programs") and "Operator
+Fusion in XLA" (PAPERS.md) those boundaries dominate small-batch
+decode, which is bandwidth-bound: the activations are tiny
+(``R x hidden`` for the packed ragged rows) but each kernel writes
+them to HBM for the next kernel to read back. Two Pallas bodies close
+all four boundaries the ROADMAP names:
+
+- **``fused_norm_matmul``** — RMSNorm (Llama/Qwen2) or LayerNorm
+  (GPT) fused into the prologue of 1..3 projections sharing the same
+  normalized input (q/k/v, or the MLP's gate/up). The normalized
+  activation lives in VMEM scratch and never round-trips HBM; the
+  grid walks the CONCATENATED column tiles of all the weights, each
+  weight's BlockSpec index map clamping outside its own tile range so
+  Pallas's revisit-elision skips the dead DMAs (total weight traffic
+  stays one pass over each weight).
+- **``fused_matmul_residual``** — a projection with an optional
+  activation prologue (``swiglu`` for Llama's down-projection,
+  tanh-``gelu`` for GPT's second MLP linear, none for the
+  O-projection) and the residual add in the epilogue: the attention
+  output (or MLP hidden) goes MXU -> residual without touching HBM in
+  between.
+
+Both reuse the ragged row layout by construction — they are row-wise
+over the packed ``[R, hidden]`` buffer, so decode (1 row/slot),
+speculative verify (gamma+1 rows) and chunked prefill (chunk rows)
+widths ride one body exactly like the ragged attention kernel.
+
+**Fallback contract.** Off TPU (or for kernel-ineligible shapes) each
+entry point runs an XLA fallback that is BITWISE the unfused module
+path: the same ``F.rms_norm``/``F.layer_norm`` recipe (f32
+accumulation, cast to the activation dtype BEFORE the weight
+multiply), the same ``x @ w + b`` dots in the same order, the same
+``residual + y`` add. ``fused_decode=True`` on a CPU engine therefore
+produces bit-identical executables to ``fused_decode=False`` — the
+token-exactness tests pin this — while interpret mode
+(``PADDLE_TPU_FUSED_DECODE=interpret``) runs the real kernels under
+the Pallas interpreter so CPU tests and the bench census exercise the
+fused graph end-to-end (the ``PADDLE_TPU_MOE_FUSED_GMM=interpret``
+precedent).
+
+**Gating.** The serving engine arms a thread-local scope
+(``fused_decode_scope``) around every ``_compile_*`` trace — exactly
+the ``serving_tp_scope`` pattern — so ``generate()``'s paged loop,
+training forwards and other engines on other threads are never
+rerouted. Inside a GSPMD tensor-parallel trace the scope reports
+"off": an opaque ``pallas_call`` cannot be partitioned (the same gate
+that keeps megablox/moe_gmm off TP serving traces), so TP engines keep
+the unfused projections and GSPMD's sharding of them. Kill switch
+``PADDLE_TPU_FUSED_DECODE=0`` beats an explicit
+``ServingConfig(fused_decode=True)`` and restores today's graph
+bit-for-bit. Layers with non-float projection weights (weight-only
+int8 from ``quantize_for_inference``) fall back per layer.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["resolve_fused_mode", "fused_decode_scope",
+           "fused_decode_mode", "fused_params_ok", "norm_matmul",
+           "matmul_residual", "fused_norm_matmul",
+           "fused_matmul_residual", "pallas_norm_matmul",
+           "pallas_matmul_residual"]
+
+_COL_TILE = 128
+
+
+def _tile_count(n: int) -> int:
+    """Column-tile count for an ``n``-wide projection: ~128-wide tiles
+    when they divide evenly, else the largest divisor-friendly count
+    (interpret mode accepts any width; real-TPU eligibility is gated
+    stricter in ``_eligible``)."""
+    t = max(n // _COL_TILE, 1)
+    while n % t:
+        t -= 1
+    return t
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + trace-time scope
+# ---------------------------------------------------------------------------
+
+def resolve_fused_mode(cfg_flag=True):
+    """Resolve the fused-decode mode ONCE at engine construction:
+    ``None`` (off), ``"kernel"`` (Pallas on TPU, bitwise-unfused XLA
+    fallback elsewhere) or ``"interpret"`` (Pallas under the
+    interpreter on any backend — CPU tests/bench exercise the fused
+    graph). Env twin ``PADDLE_TPU_FUSED_DECODE``: ``0`` is the kill
+    switch and beats an explicit config True; ``interpret`` forces
+    interpret mode; unset/``1`` follows the config flag."""
+    env = os.environ.get("PADDLE_TPU_FUSED_DECODE", "1")
+    if env == "0":
+        return None
+    if env == "interpret":
+        return "interpret"
+    return "kernel" if cfg_flag else None
+
+
+_SCOPE = threading.local()      # thread-scoped like serving_tp_scope
+
+
+@contextlib.contextmanager
+def fused_decode_scope(mode):
+    """Arm the fused decode path for the duration of one trace (the
+    engine's ``_trace_ctx`` enters this around every ``_compile_*``).
+    ``mode`` None is a no-op arm, so call sites stay unconditional."""
+    prev = getattr(_SCOPE, "mode", None)
+    _SCOPE.mode = mode
+    try:
+        yield
+    finally:
+        _SCOPE.mode = prev
+
+
+def fused_decode_mode():
+    """The armed mode, or None outside a scope / inside a GSPMD
+    tensor-parallel trace (an opaque pallas_call cannot be partitioned
+    — the moe_gmm/megablox gate, applied here)."""
+    mode = getattr(_SCOPE, "mode", None)
+    if mode is None:
+        return None
+    from .paged_attention import serving_tp_active
+    if serving_tp_active():
+        return None
+    return mode
+
+
+def fused_params_ok(*params) -> bool:
+    """True when every given parameter exists and is a plain float
+    tensor — weight-only-quantized layers (int8 weights) keep the
+    module path, whose quantized matmul the kernels don't speak."""
+    from ...framework.core import as_jax
+    for p in params:
+        if p is None:
+            continue
+        try:
+            if not jnp.issubdtype(as_jax(p).dtype, jnp.floating):
+                return False
+        except Exception:
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies
+# ---------------------------------------------------------------------------
+
+def _norm_mm_kernel(*refs, eps, kind, has_beta, nw, offs, tiles,
+                    has_bias):
+    """Grid ``(sum(tiles),)`` over the concatenated column tiles of
+    all ``nw`` weights. Step 0 computes the normalized activation into
+    VMEM scratch (f32, cast through the activation dtype exactly like
+    the unfused norm so kernel and fallback agree to rounding); every
+    step contracts it against its weight's current column tile."""
+    i = 2 + (1 if has_beta else 0)
+    x_ref, g_ref = refs[0], refs[1]
+    b_ref = refs[2] if has_beta else None
+    w_refs = refs[i:i + nw]
+    i += nw
+    bias_refs = []
+    for hb in has_bias:
+        bias_refs.append(refs[i] if hb else None)
+        i += 1 if hb else 0
+    o_refs = refs[i:i + nw]
+    y_scr = refs[i + nw]
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _normalize():
+        xf = x_ref[...].astype(jnp.float32)
+        if kind == "rms":
+            var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+            y = xf * jax.lax.rsqrt(var + eps)
+        else:
+            m = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.mean((xf - m) * (xf - m), axis=-1, keepdims=True)
+            y = (xf - m) * jax.lax.rsqrt(var + eps)
+        # the unfused path casts to the activation dtype BEFORE the
+        # weight multiply — mirror it so bf16 parity holds
+        y = y.astype(x_ref.dtype).astype(jnp.float32)
+        y = y * g_ref[...].astype(jnp.float32)[None, :]
+        if has_beta:
+            y = y + b_ref[...].astype(jnp.float32)[None, :]
+        y_scr[...] = y
+
+    y = y_scr[...]
+    for idx in range(nw):
+        @pl.when((j >= offs[idx]) & (j < offs[idx] + tiles[idx]))
+        def _project(idx=idx):
+            acc = jax.lax.dot_general(
+                y, w_refs[idx][...].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if bias_refs[idx] is not None:
+                acc = acc + bias_refs[idx][...].astype(
+                    jnp.float32)[None, :]
+            o_refs[idx][...] = acc.astype(o_refs[idx].dtype)
+
+
+def _mm_res_kernel(*refs, act, has_bias, n_in):
+    """Grid ``(col_tiles,)`` over the output width. Step 0 computes
+    the (optionally activated) matmul input into VMEM scratch; every
+    step contracts it against one weight column tile, adds bias +
+    residual tile in the epilogue, and stores — the projection input
+    and its residual sum never round-trip HBM."""
+    x_refs = refs[:n_in]
+    i = n_in
+    w_ref = refs[i]
+    i += 1
+    b_ref = refs[i] if has_bias else None
+    i += 1 if has_bias else 0
+    res_ref = refs[i]
+    o_ref = refs[i + 1]
+    a_scr = refs[i + 2]
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _activate():
+        if act == "swiglu":
+            a = jax.nn.silu(x_refs[0][...].astype(jnp.float32)) \
+                * x_refs[1][...].astype(jnp.float32)
+        elif act == "gelu_tanh":
+            a = jax.nn.gelu(x_refs[0][...].astype(jnp.float32),
+                            approximate=True)
+        else:
+            a = x_refs[0][...].astype(jnp.float32)
+        a_scr[...] = a
+
+    acc = jax.lax.dot_general(
+        a_scr[...], w_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    if b_ref is not None:
+        acc = acc + b_ref[...].astype(jnp.float32)[None, :]
+    acc = acc + res_ref[...].astype(jnp.float32)
+    o_ref[...] = acc.astype(o_ref.dtype)
+
+
+try:    # pallas/tpu lowering may be absent on this jax build
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from .flash_attention_kernel import _CompilerParams
+
+    def pallas_norm_matmul(x2, gamma, beta, ws, bs, *, eps, kind,
+                           interpret=None):
+        """x2: ``[R, d]`` packed rows; gamma/beta: ``[d]`` norm params
+        (beta None for RMSNorm); ws: 1..3 weights ``[d, n_i]``; bs:
+        matching biases ``[n_i]`` or None. Returns a tuple of
+        ``[R, n_i]`` outputs. ``kind``: ``"rms" | "ln"``."""
+        import functools
+        r, d = x2.shape
+        nw = len(ws)
+        widths = [w.shape[-1] for w in ws]
+        tiles = [_tile_count(n) for n in widths]
+        tcs = [n // t for n, t in zip(widths, tiles)]
+        offs = list(np.cumsum([0] + tiles[:-1]))
+        has_bias = [b is not None for b in bs]
+        kernel = functools.partial(
+            _norm_mm_kernel, eps=np.float32(eps), kind=kind,
+            has_beta=beta is not None, nw=nw, offs=offs, tiles=tiles,
+            has_bias=has_bias)
+
+        def _w_map(off, t):
+            return lambda j: (0, jnp.clip(j - off, 0, t - 1))
+
+        def _b_map(off, t):
+            return lambda j: (jnp.clip(j - off, 0, t - 1),)
+
+        in_specs = [
+            pl.BlockSpec((r, d), lambda j: (0, 0)),
+            pl.BlockSpec((d,), lambda j: (0,)),
+        ]
+        if beta is not None:
+            in_specs.append(pl.BlockSpec((d,), lambda j: (0,)))
+        for w, tc, off, t in zip(ws, tcs, offs, tiles):
+            in_specs.append(pl.BlockSpec((d, tc), _w_map(off, t)))
+        args = [x2, gamma] + ([beta] if beta is not None else []) \
+            + list(ws)
+        for b, tc, off, t in zip(bs, tcs, offs, tiles):
+            if b is not None:
+                in_specs.append(pl.BlockSpec((tc,), _b_map(off, t)))
+                args.append(b)
+        out_specs = [pl.BlockSpec((r, tc), _w_map(off, t))
+                     for tc, off, t in zip(tcs, offs, tiles)]
+        out_shape = [jax.ShapeDtypeStruct((r, n), x2.dtype)
+                     for n in widths]
+        outs = pl.pallas_call(
+            kernel,
+            grid=(int(sum(tiles)),),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=[pltpu.VMEM((r, d), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_interpret_flag(interpret),
+        )(*args)
+        return tuple(outs)
+
+    def pallas_matmul_residual(xs, w, b, residual, *, act=None,
+                               interpret=None):
+        """xs: 1 (or 2, for swiglu) inputs ``[R, K]``; w: ``[K, n]``;
+        b: ``[n]`` or None; residual: ``[R, n]``. Returns
+        ``residual + act(xs) @ w (+ b)`` as ``[R, n]``."""
+        import functools
+        r, kdim = xs[0].shape
+        n = w.shape[-1]
+        t = _tile_count(n)
+        tc = n // t
+        kernel = functools.partial(
+            _mm_res_kernel, act=act, has_bias=b is not None,
+            n_in=len(xs))
+        in_specs = [pl.BlockSpec((r, kdim), lambda j: (0, 0))
+                    for _ in xs]
+        in_specs.append(pl.BlockSpec((kdim, tc), lambda j: (0, j)))
+        args = list(xs) + [w]
+        if b is not None:
+            in_specs.append(pl.BlockSpec((tc,), lambda j: (j,)))
+            args.append(b)
+        in_specs.append(pl.BlockSpec((r, tc), lambda j: (0, j)))
+        args.append(residual)
+        out = pl.pallas_call(
+            kernel,
+            grid=(t,),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((r, tc), lambda j: (0, j)),
+            out_shape=jax.ShapeDtypeStruct((r, n), residual.dtype),
+            scratch_shapes=[pltpu.VMEM((r, kdim), jnp.float32)],
+            compiler_params=_CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=_interpret_flag(interpret),
+        )(*args)
+        return out
+
+    _kernel_import_error = None
+except Exception as _e:     # pragma: no cover - environment dependent
+    pallas_norm_matmul = None
+    pallas_matmul_residual = None
+    _kernel_import_error = _e
+
+
+def _interpret_flag(interpret):
+    if interpret is not None:
+        return interpret
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# XLA fallbacks — bitwise the unfused module path
+# ---------------------------------------------------------------------------
+
+def _xla_norm_matmul(x, gamma, beta, ws, bs, *, eps, kind):
+    """Bitwise the unfused path: exactly ``F.rms_norm``/
+    ``F.layer_norm``'s recipe (f32 accumulation, cast to the
+    activation dtype BEFORE the weight multiply) followed by each
+    projection's ``x @ w (+ b)`` — same ops, same order, so a CPU
+    engine with fusion ON compiles bit-identical executables to one
+    with fusion OFF."""
+    xf = x.astype(jnp.float32)
+    if kind == "rms":
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    else:
+        m = jnp.mean(xf, axis=-1, keepdims=True)
+        v = jnp.var(xf, axis=-1, keepdims=True)
+        y = ((xf - m) * jax.lax.rsqrt(v + eps)).astype(x.dtype)
+    y = y * gamma
+    if beta is not None:
+        y = y + beta
+    outs = []
+    for w, b in zip(ws, bs):
+        o = y @ w + b if b is not None else y @ w
+        outs.append(o)
+    return tuple(outs)
+
+
+def _xla_matmul_residual(xs, w, b, residual, *, act=None):
+    """Bitwise the unfused path: the module's activation (``swiglu`` =
+    ``silu(g) * u``, tanh-``gelu``), the projection dot, bias, then
+    ``residual + y`` in the decoder layer's order."""
+    if act == "swiglu":
+        xin = jax.nn.silu(xs[0]) * xs[1]
+    elif act == "gelu_tanh":
+        xin = jax.nn.gelu(xs[0], approximate=True)
+    else:
+        xin = xs[0]
+    y = xin @ w + b if b is not None else xin @ w
+    return residual + y
+
+
+# ---------------------------------------------------------------------------
+# dispatchers
+# ---------------------------------------------------------------------------
+
+def _warn_fallback(kind, shape):
+    """A TPU trace that asked for the fused kernel but fell back lost
+    a fusion boundary — count it on the shared serving_kernel_fallback
+    telemetry (same counter/dict the paged-attention entry points
+    bump, so ``stats()['kernel_fallbacks']`` folds these in)."""
+    from . import paged_attention as _pa
+    _pa._fallback_counts[kind] = _pa._fallback_counts.get(kind, 0) + 1
+    try:
+        from ... import monitor
+        monitor.counter(
+            "serving_kernel_fallback",
+            "paged-attention entry points routed to the XLA gather "
+            "fallback on a TPU backend (kernel missing or shape "
+            "ineligible)", labels=("path",)).labels(path=kind).inc()
+    except Exception:       # pragma: no cover - never break the trace
+        pass
+    if kind in _pa._fallback_warned:
+        return
+    _pa._fallback_warned.add(kind)
+    import warnings
+    warnings.warn(
+        "%s: shape %s not kernel-eligible (dims must be %d-multiples,"
+        " rows an 8-multiple); using the XLA fallback"
+        % (kind, tuple(shape), _COL_TILE))
+
+
+# VMEM the kernels may budget for resident buffers (scratch + the
+# whole-[R, d] input block + double-buffered weight/bias/residual
+# tiles); conservative against the ~16 MB/core of current TPUs so an
+# oversized shape takes the graceful XLA fallback instead of failing
+# Mosaic compilation at engine construction
+_VMEM_BUDGET = 12 << 20
+
+
+def _vmem_bytes(rows, d, widths, n_in=1):
+    tc = max(min(n, _COL_TILE) for n in widths)
+    return 4 * ((1 + n_in) * rows * d   # f32 scratch + n input blocks
+                + 2 * d * tc            # double-buffered weight tile
+                + 2 * rows * tc)        # output (+ residual) tiles
+
+
+def _eligible(d, widths, rows, strict, n_in=1):
+    """Kernel eligibility. ``strict`` (the real-TPU path): every dim a
+    128-multiple and the packed row count an 8-sublane multiple (so
+    Mosaic never pads a tile) AND the resident buffers fit the VMEM
+    budget (``n_in`` > 1: swiglu keeps both gate/up blocks resident);
+    interpret mode accepts any shape the tiling divides."""
+    if rows > 4096 or rows < 1:
+        return False
+    if strict:
+        return d % _COL_TILE == 0 \
+            and all(n % _COL_TILE == 0 for n in widths) \
+            and rows % 8 == 0 \
+            and _vmem_bytes(rows, d, widths, n_in) <= _VMEM_BUDGET
+    return True
+
+
+def fused_norm_matmul(x, gamma, beta, ws, bs, *, eps, kind):
+    """Array-level dispatcher: route the fused norm->projection(s) to
+    the Pallas kernel (TPU, or interpret mode) or the bitwise-unfused
+    XLA fallback. ``x`` keeps its ``[..., d]`` leading shape — the
+    fallback runs on it UNRESHAPED so its ops are exactly the module
+    path's; only the kernel flattens to packed rows."""
+    mode = fused_decode_mode()
+    d = x.shape[-1]
+    widths = [w.shape[-1] for w in ws]
+    rows = int(np.prod(x.shape[:-1]))
+    use_kernel = interp = False
+    if mode == "interpret":
+        use_kernel = interp = _eligible(d, widths, rows, False) \
+            and pallas_norm_matmul is not None
+    elif mode == "kernel":
+        on_tpu = jax.default_backend() == "tpu"
+        use_kernel = on_tpu and pallas_norm_matmul is not None \
+            and _eligible(d, widths, rows, True)
+        if on_tpu and not use_kernel:
+            _warn_fallback("fused_norm_matmul", x.shape)
+    if not use_kernel:
+        return _xla_norm_matmul(x, gamma, beta, ws, bs, eps=eps,
+                                kind=kind)
+    outs = pallas_norm_matmul(
+        x.reshape(rows, d), gamma, beta, list(ws), list(bs), eps=eps,
+        kind=kind, interpret=True if interp else None)
+    return tuple(o.reshape(x.shape[:-1] + (o.shape[-1],))
+                 for o in outs)
+
+
+def fused_matmul_residual(xs, w, b, residual, *, act=None):
+    """Array-level dispatcher for the projection->residual epilogue
+    (optionally swiglu/gelu prologue); same routing contract as
+    ``fused_norm_matmul``."""
+    mode = fused_decode_mode()
+    kdim = xs[0].shape[-1]
+    n = w.shape[-1]
+    rows = int(np.prod(xs[0].shape[:-1]))
+    use_kernel = interp = False
+    if mode == "interpret":
+        use_kernel = interp = _eligible(kdim, [n], rows, False) \
+            and pallas_matmul_residual is not None
+    elif mode == "kernel":
+        on_tpu = jax.default_backend() == "tpu"
+        use_kernel = on_tpu and pallas_matmul_residual is not None \
+            and _eligible(kdim, [n], rows, True, n_in=len(xs))
+        if on_tpu and not use_kernel:
+            _warn_fallback("fused_matmul_residual", xs[0].shape)
+    if not use_kernel:
+        return _xla_matmul_residual(xs, w, b, residual, act=act)
+    out = pallas_matmul_residual(
+        [x.reshape(rows, kdim) for x in xs], w, b,
+        residual.reshape(rows, n), act=act,
+        interpret=True if interp else None)
+    return out.reshape(residual.shape)
+
+
+# ---------------------------------------------------------------------------
+# Tensor-level entry points (what the decoder layers call)
+# ---------------------------------------------------------------------------
+
+def norm_matmul(x, gamma, beta, ws, bs, *, eps, kind):
+    """Tensor-level fused norm -> 1..3 projections. ``ws`` is the list
+    of projection weights sharing the normalized input; ``bs`` their
+    biases (None entries allowed). Returns a tuple of Tensors."""
+    from ...framework.core import apply_jax
+    nw = len(ws)
+    has_beta = beta is not None
+    has_bias = [b is not None for b in bs]
+
+    def f(x_a, g_a, *rest):
+        i = 0
+        beta_a = rest[i] if has_beta else None
+        i += 1 if has_beta else 0
+        w_as = rest[i:i + nw]
+        i += nw
+        b_as = []
+        for hb in has_bias:
+            b_as.append(rest[i] if hb else None)
+            i += 1 if hb else 0
+        return fused_norm_matmul(x_a, g_a, beta_a, list(w_as), b_as,
+                                 eps=eps, kind=kind)
+
+    args = [x, gamma] + ([beta] if has_beta else []) + list(ws) \
+        + [b for b in bs if b is not None]
+    out = apply_jax("fused_norm_matmul", f, *args, n_outputs=nw)
+    return out if isinstance(out, tuple) else (out,)
+
+
+def matmul_residual(xs, w, b, residual, *, act=None):
+    """Tensor-level fused (activation ->) projection -> residual-add:
+    ``residual + act(xs) @ w (+ b)``."""
+    from ...framework.core import apply_jax
+    n_in = len(xs)
+    has_bias = b is not None
+
+    def f(*arrs):
+        x_as = arrs[:n_in]
+        w_a = arrs[n_in]
+        b_a = arrs[n_in + 1] if has_bias else None
+        res_a = arrs[-1]
+        return fused_matmul_residual(list(x_as), w_a, b_a, res_a,
+                                     act=act)
+
+    args = list(xs) + [w] + ([b] if has_bias else []) + [residual]
+    return apply_jax("fused_matmul_residual", f, *args)

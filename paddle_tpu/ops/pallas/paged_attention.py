@@ -74,6 +74,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import math
+import os
 import threading
 
 import jax
@@ -101,6 +102,16 @@ def _interpret() -> bool:
         return jax.default_backend() != "tpu"
     except Exception:
         return True
+
+
+def _force_kernel_routing() -> bool:
+    """``PADDLE_TPU_PAGED_KERNEL=interpret``: route eligible shapes to
+    the Pallas kernels even OFF TPU (they run under the interpreter —
+    ``_interpret()`` already flips there). Lets CPU tests and the
+    decode-tick fusion bench compile the REAL kernelized graph, so the
+    kernel census measures what TPU hardware would launch (the
+    ``PADDLE_TPU_MOE_FUSED_GMM=interpret`` precedent)."""
+    return os.environ.get("PADDLE_TPU_PAGED_KERNEL", "") == "interpret"
 
 
 # ---------------------------------------------------------------------------
@@ -665,7 +676,8 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, context_lens,
     Routes to the Pallas kernel on TPU, the gather fallback elsewhere."""
     use_kernel = False
     try:
-        use_kernel = jax.default_backend() == "tpu" \
+        use_kernel = (jax.default_backend() == "tpu"
+                      or _force_kernel_routing()) \
             and pallas_paged_attention is not None \
             and _kernel_eligible(q, k_pool)
     except Exception:
@@ -725,7 +737,8 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables,
         shape=(block_tables.shape[0], q.shape[1], q.shape[2]))
     use_kernel = False
     try:
-        use_kernel = jax.default_backend() == "tpu" \
+        use_kernel = (jax.default_backend() == "tpu"
+                      or _force_kernel_routing()) \
             and pallas_ragged_paged_attention is not None \
             and _kernel_eligible(q_tok, k_pool)
     except Exception:
@@ -931,7 +944,8 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables,
         shape=(q.shape[0], q.shape[2], q.shape[3]))
     use_kernel = False
     try:
-        use_kernel = jax.default_backend() == "tpu" \
+        use_kernel = (jax.default_backend() == "tpu"
+                      or _force_kernel_routing()) \
             and pallas_paged_verify_attention is not None \
             and _kernel_eligible(q_tok, k_pool)
     except Exception:

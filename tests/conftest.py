@@ -59,6 +59,13 @@ import pytest
 # but each test builds 2-3 tiny engines so the cost is compile-bound
 # and stable); no new entries, tier-1 measured 617s solo with the
 # file aboard (618 passed) — ~250s of headroom under the 870s budget.
+# r13 re-sweep (mega-kernelized decode tick + per-slot sampling): the
+# 25 new test_decode_fused.py tests measured ~50s total solo (slowest
+# 5.8s — the generate() jit-cache pin, which compiles one dense + one
+# paged decode loop; everything else 2-5s tiny-engine compiles), all
+# far under the ~9s line — no new entries. Existing serving tests pay
+# a few extra ms per compile for the kernel census (HLO text parse);
+# not measurable against the compile itself.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s
