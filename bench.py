@@ -892,6 +892,169 @@ def _goodput_bench():
     return out
 
 
+def _preempt_bench():
+    """FIFO vs preemptive scheduling under mixed-priority overload
+    (the ISSUE-14 bar): the same closed-loop workload — a few LONG
+    low-priority requests arriving first, a majority of SHORT
+    high-priority requests behind them, concurrency above the slot
+    count so the queue never drains — served by two engines differing
+    ONLY in ``enable_preemption``. The FIFO arm head-of-line-blocks
+    the shorts behind the longs' prefills; the preemptive arm admits
+    by priority and spills low-priority victims to the host-DRAM KV
+    tier when the high class needs their slots. Reported: goodput at
+    a fixed SLO (calibrated 4x/3x off an UNLOADED single-request
+    probe, so 'good' means 'barely queued'), high-priority TTFT p99
+    per arm, preemption/spill/restore counts and the measured
+    recompute-vs-swap cost-model rates. On CPU absolute latencies are
+    a structure proxy (``cpu_proxy``); the FIFO-vs-preemptive SHAPE
+    (who waits behind whom) is backend-independent."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.inference.loadgen import SLO, run_load
+
+    # default shape is the CPU-proxy sweet spot: small enough that
+    # tick time does not drown the scheduling signal (the thing under
+    # test is who waits behind whom, not FLOPs) — raise via env on
+    # real chips
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_PREEMPT_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_PREEMPT_HIDDEN", 512)),
+        intermediate_size=int(os.environ.get("BENCH_PREEMPT_FFN",
+                                             1408)),
+        num_hidden_layers=int(os.environ.get("BENCH_PREEMPT_LAYERS",
+                                             2)),
+        num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=1024, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    # class mix mirrors real tenant traffic: latency-sensitive shorts
+    # are the MAJORITY (the goodput denominator), a few long batch
+    # jobs are the head-of-line blockers whose preemption-stalled
+    # TPOT is the accepted price
+    slots = int(os.environ.get("BENCH_PREEMPT_SLOTS", 4))
+    n_lo = int(os.environ.get("BENCH_PREEMPT_LO", 4))
+    n_hi = int(os.environ.get("BENCH_PREEMPT_HI", 12))
+    new = int(os.environ.get("BENCH_PREEMPT_NEW", 8))
+    lo_len = int(os.environ.get("BENCH_PREEMPT_LO_LEN", 256))
+    hi_len = int(os.environ.get("BENCH_PREEMPT_HI_LEN", 24))
+    rng = np.random.RandomState(0)
+    # longs FIRST (one per slot — the FIFO arm's head-of-line wall) on
+    # an open-loop arrival schedule: they are admitted and RUNNING by
+    # the time the shorts arrive, so the FIFO arm blocks the shorts
+    # behind them while the preemptive arm must actually preempt to
+    # serve them. Alternating long lengths put some longs in DECODE
+    # (preemption spills their live blocks to the host tier and
+    # swap/recompute-resumes them) and some mid-PREFILL (preempted to
+    # a fresh requeue over their published blocks) — both victim
+    # classes measured in one window.
+    lo_lens = [lo_len if j % 2 == 0 else 2 * hi_len
+               for j in range(n_lo)]
+    prompts = [rng.randint(1, cfg.vocab_size, (n,))
+               for n in lo_lens] + \
+              [rng.randint(1, cfg.vocab_size, (hi_len,))
+               for _ in range(n_hi)]
+    prios = [0] * n_lo + [2] * n_hi
+
+    # small per-tick prefill budget: a long prompt spreads over many
+    # SHORT ticks instead of a few 0.5s ones, so admission decisions
+    # (the thing under test) happen at a useful granularity and a
+    # bypassing short's first token isn't gated on a monster launch
+    pf_rows = int(os.environ.get("BENCH_PREEMPT_PF_ROWS", 64))
+
+    def build(preempt):
+        return ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=512,
+            max_new_tokens=new, ragged_prefill_rows=pf_rows,
+            enable_preemption=preempt))
+
+    # SLO calibration: one UNLOADED short request per class of
+    # interest — the budget a request that never queued would meet
+    probe_eng = build(False)
+    probe = run_load(probe_eng,
+                     [rng.randint(1, cfg.vocab_size, (hi_len,))
+                      for _ in range(3)],
+                     mode="closed", concurrency=1,
+                     max_new_tokens=new)
+    probe_eng.shutdown()
+    # TTFT budget = 4x the unloaded first token plus ONE decode wave
+    # (new x unloaded per-token): a short request may wait out one
+    # batch of peers and still be "good", but waiting behind a LONG
+    # prefill (the FIFO failure mode) blows it — the budget that
+    # separates the arms by policy rather than by raw speed
+    slo = SLO(
+        ttft_ms=float(os.environ.get(
+            "BENCH_PREEMPT_SLO_TTFT_MS",
+            4.0 * max(probe["ttft_p50_ms"], 1.0)
+            + new * max(probe["tpot_p50_ms"], 1.0))),
+        itl_ms=float(os.environ.get(
+            "BENCH_PREEMPT_SLO_ITL_MS",
+            3.0 * max(probe["tpot_p50_ms"], 1.0))))
+
+    # offered load: a burst WELL past the knee — 4x the slot count
+    # times the single-stream short-request rate, so the whole mixed
+    # window arrives while the longs are still mid-service (the
+    # overload regime where scheduling policy decides who eats the
+    # queueing delay; under-offered loads make both arms trivially
+    # meet SLO and measure nothing)
+    qps = float(os.environ.get("BENCH_PREEMPT_QPS", 0) or 0) or \
+        4.0 * slots * max(probe["achieved_qps"], 0.2)
+    arms = {}
+    for name, preempt in (("fifo", False), ("preemptive", True)):
+        eng = build(preempt)
+        # warm the executables outside the timed window
+        eng.serve([rng.randint(1, cfg.vocab_size, (hi_len,))],
+                  max_new_tokens=4)
+        rep = run_load(eng, [p.copy() for p in prompts],
+                       qps=round(qps, 3), mode="open",
+                       arrival="uniform", max_new_tokens=new,
+                       slo=slo, priorities=list(prios))
+        st = eng.stats()
+        rep["engine"] = {k: st[k] for k in (
+            "preemptions", "kv_blocks_spilled", "kv_blocks_restored",
+            "preempt_swap_resumes", "preempt_recompute_resumes",
+            "host_tier_bytes", "prefill_rows_per_s_est",
+            "host_xfer_bytes_per_s_est", "preemption_enabled")}
+        arms[name] = rep
+        eng.shutdown()
+        del eng
+        gc.collect()
+
+    fifo, pre = arms["fifo"], arms["preemptive"]
+    hi_key = "2"
+    out = {
+        "workload": {"n_lo": n_lo, "n_hi": n_hi, "lo_len": lo_len,
+                     "hi_len": hi_len, "max_new": new,
+                     "num_slots": slots,
+                     "offered_qps": round(qps, 3)},
+        "slo": {"ttft_ms": round(slo.ttft_ms, 3),
+                "itl_ms": round(slo.itl_ms, 3)},
+        "unloaded_probe": probe,
+        "fifo": fifo,
+        "preemptive": pre,
+        "goodput_fifo": fifo["goodput"],
+        "goodput_preemptive": pre["goodput"],
+        "goodput_delta": round(pre["goodput"] - fifo["goodput"], 4),
+        "hi_ttft_p99_fifo_ms":
+            fifo.get("by_priority", {}).get(hi_key,
+                                            fifo)["ttft_p99_ms"],
+        "hi_ttft_p99_preempt_ms":
+            pre.get("by_priority", {}).get(hi_key,
+                                           pre)["ttft_p99_ms"],
+        "kv_blocks_spilled": pre["engine"]["kv_blocks_spilled"],
+        "preemptions": pre["engine"]["preemptions"],
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def _fusion_bench():
     """Decode-tick fusion A/B (the ISSUE-13 bar): fused vs unfused
     serving engines at the serving-bench shape. Two axes:
@@ -1966,6 +2129,10 @@ def main():
     except Exception as exc:
         fusion = {"error": repr(exc)}
     try:
+        preempt = _preempt_bench()
+    except Exception as exc:
+        preempt = {"error": repr(exc)}
+    try:
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
@@ -1987,6 +2154,7 @@ def main():
               "goodput": goodput,
               "cluster": cluster,
               "fusion": fusion,
+              "preempt": preempt,
               "flashmask": flashmask,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
@@ -2005,7 +2173,7 @@ def main():
             if k not in ("decode", "serving", "speculative",
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
-                         "cluster", "fusion", "flashmask",
+                         "cluster", "fusion", "preempt", "flashmask",
                          "moe_profile", "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
@@ -2117,7 +2285,16 @@ def main():
              if isinstance(fusion, dict) else None,
              "kernels_per_tick_ratio":
              fusion.get("kernels_per_tick_ratio")
-             if isinstance(fusion, dict) else None},
+             if isinstance(fusion, dict) else None,
+             "preempt_goodput_delta":
+             preempt.get("goodput_delta")
+             if isinstance(preempt, dict) else None,
+             "preempt_ttft_p99_ms":
+             preempt.get("hi_ttft_p99_preempt_ms")
+             if isinstance(preempt, dict) else None,
+             "kv_blocks_spilled":
+             preempt.get("kv_blocks_spilled")
+             if isinstance(preempt, dict) else None},
     }
     # trajectory contract (ISSUE 11/12 CI satellites): the goodput SLO
     # and cluster keys must be present in every round's summary — fail
@@ -2127,7 +2304,8 @@ def main():
               "cluster_tokens_per_sec", "cluster_speedup",
               "cluster_ttft_p99_ms", "cluster_affinity_hit_rate",
               "fusion_tokens_per_sec", "fusion_speedup",
-              "kernels_per_tick_ratio"):
+              "kernels_per_tick_ratio", "preempt_goodput_delta",
+              "preempt_ttft_p99_ms", "kv_blocks_spilled"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

@@ -396,6 +396,47 @@ def main(argv=None):
           f"{st11['kernel_launch_proxy_per_tick']}); per-request "
           f"sampling: greedy row exact next to a hot row, "
           f"{st11s['executables_compiled']} executable")
+
+    # ---- 12. SLO-aware preemptive scheduling + host-DRAM KV tier
+    # A low-priority long request streams a few tokens, then two
+    # high-priority requests arrive: the scheduler preempts the long
+    # (its live KV blocks spill to the host-DRAM tier), serves the
+    # high class FIRST, and resumes the victim token-exact — its full
+    # stream matches the never-preempted reference bit-for-bit.
+    eng_ref = ServingEngine(model, ServingConfig(
+        num_slots=4, block_size=8, max_model_len=96,
+        prefill_chunk=16))
+    ref12 = eng_ref.serve(list(prompts), max_new_tokens=6)
+    eng_ref.shutdown()
+    stream_events = []
+    eng_p = ServingEngine(
+        model, ServingConfig(num_slots=2, block_size=8,
+                             max_model_len=96, prefill_chunk=16),
+        stream_callback=lambda rid, tok: stream_events.append(rid))
+    rid_lo12 = eng_p.submit(prompts[0], 6, priority=0)
+    for _ in range(3):
+        eng_p.step()                 # the long streams a few tokens
+    rid_a = eng_p.submit(prompts[1], 6, priority=2)
+    rid_b = eng_p.submit(prompts[2], 6, priority=2)
+    done12 = eng_p.run()
+    st12 = eng_p.stats()
+    for rid, want in zip((rid_lo12, rid_a, rid_b), ref12):
+        assert done12[rid].tolist() == want.tolist(), \
+            "preempted/resumed stream diverged from never-preempted"
+    assert st12["preemptions"] >= 1 and st12["kv_blocks_spilled"] >= 1
+    # the high class CUT IN: both hi requests delivered their first
+    # token while the preempted low request still had tokens to stream
+    lo_last = len(stream_events) - 1 - stream_events[::-1].index(
+        rid_lo12)
+    assert stream_events.index(rid_a) < lo_last
+    assert stream_events.index(rid_b) < lo_last
+    eng_p.shutdown()
+    print(f"preemptive scheduling: {st12['preemptions']} preemption, "
+          f"{st12['kv_blocks_spilled']} blocks spilled to host / "
+          f"{st12['kv_blocks_restored']} restored "
+          f"({st12['preempt_swap_resumes']} swap, "
+          f"{st12['preempt_recompute_resumes']} recompute resumes); "
+          f"resumed stream token-exact vs never-preempted")
     return n_ok / 12.0, losses
 
 

@@ -21,6 +21,7 @@ import numpy as np
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "GenerationPredictor", "create_generation_predictor",
            "ServingConfig", "ServingEngine", "ServingRequest",
+           "QueueShedError",
            "ClusterConfig", "EngineCluster", "Router",
            "SLO", "run_load",
            "PrecisionType", "PlaceType", "get_version"]
@@ -30,7 +31,7 @@ def __getattr__(name):
     # lazy: the serving engine pulls in jax/model machinery that plain
     # Predictor users never need
     if name in ("ServingConfig", "ServingEngine", "ServingRequest",
-                "PrefilledRequest"):
+                "PrefilledRequest", "QueueShedError"):
         from . import serving
         return getattr(serving, name)
     if name in ("ClusterConfig", "EngineCluster", "Router"):

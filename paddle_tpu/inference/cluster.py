@@ -92,7 +92,8 @@ import numpy as np
 from .. import monitor
 from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
-from .serving import PrefilledRequest, ServingConfig, ServingEngine
+from .serving import (PrefilledRequest, QueueShedError, ServingConfig,
+                      ServingEngine)
 
 __all__ = ["ClusterConfig", "Router", "EngineCluster"]
 
@@ -169,12 +170,17 @@ class Router:
         self._bs = int(block_size)
 
     def route(self, prompt,
-              engines: Dict[int, ServingEngine]
+              engines: Dict[int, ServingEngine],
+              priority: int = 0
               ) -> Tuple[int, int, Dict[int, int]]:
         """Pick a replica for ``prompt`` among ``engines`` (index ->
         engine). Returns ``(index, overlap_blocks, depths)`` where
-        ``depths`` is every candidate's queued + active count at
-        scoring time."""
+        ``depths`` is every candidate's queue depth at scoring time —
+        PRIORITY-WEIGHTED when the replicas run the preemptive
+        scheduler: work below ``priority`` is discounted (it can be
+        preempted or bypassed, so it barely delays this arrival),
+        which steers high-priority traffic toward replicas whose load
+        is preemptible rather than merely toward short queues."""
         if not engines:
             raise ValueError("route() needs at least one candidate")
         ids = np.asarray(prompt, np.int32).reshape(-1)
@@ -184,7 +190,7 @@ class Router:
         depths = {}
         for idx, eng in engines.items():
             ov = eng.published_overlap(hashes)
-            depth = eng.num_queued + eng.num_active
+            depth = eng.queue_depth(priority)
             depths[idx] = depth
             key = (ov, -depth, -idx)    # longest run, then least
             if best is None or key > best[0]:   # loaded, then lowest i
@@ -324,7 +330,8 @@ class EngineCluster:
                    for i in self._decode_idx if i not in self._failed)
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
-               top_k=None, top_p=None) -> int:
+               top_k=None, top_p=None, priority=0,
+               max_queue_wait_ms=None) -> int:
         """Route one request to a replica (prefill tier when
         disaggregated) and queue it there; returns the CLUSTER-global
         request id tokens stream under.
@@ -332,7 +339,12 @@ class EngineCluster:
         sampling overrides, forwarded to the owning replica's per-slot
         sampling tensors (and preserved across a failure-drain
         requeue; in disaggregated mode they travel with the KV handoff
-        payload to the decode replica)."""
+        payload to the decode replica). ``priority`` is the request's
+        scheduling class — it weights the router's queue-depth
+        tiebreak, orders admission on the owning replica, may preempt
+        strictly-lower work there, rides the disaggregated handoff,
+        and survives a failure-drain requeue. ``max_queue_wait_ms``
+        bounds the replica-side queue wait (outcome="timeout")."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         if self._disagg:
             # mirror engine.submit()'s pool-fit rejection for the
@@ -363,8 +375,12 @@ class EngineCluster:
                     f"only {cap}")
         rid = self._next_rid
         samp = {k: v for k, v in (("temperature", temperature),
-                                  ("top_k", top_k), ("top_p", top_p))
+                                  ("top_k", top_k), ("top_p", top_p),
+                                  ("max_queue_wait_ms",
+                                   max_queue_wait_ms))
                 if v is not None}
+        if int(priority):
+            samp["priority"] = int(priority)
         self._route_submit(rid, ids, max_new_tokens, samp)
         self._next_rid += 1
         if samp:
@@ -374,20 +390,40 @@ class EngineCluster:
         return rid
 
     def cancel(self, request_id: int) -> bool:
-        """Cancel a request still waiting in its replica's admission
-        queue (same semantics as ``ServingEngine.cancel``)."""
+        """Cancel a request anywhere in its cluster lifetime: queued
+        or IN FLIGHT on its replica (forwarded to
+        ``ServingEngine.cancel``, which retires the slot mid-decode
+        and frees its blocks), or parked as a pending disaggregated
+        handoff (the payload is dropped — its prefill-engine blocks
+        were already freed at export). A request that already
+        streamed tokens surfaces them as a partial result through
+        ``run()``."""
         owner = self._owner.get(request_id)
         if owner is None:
             return False
         idx, lrid = owner
+        streamed = bool(self._tokens.get(request_id))
         if not self._engines[idx].cancel(lrid):
-            return False
+            # not queued / not in a slot there: a pending handoff?
+            for k, (src, rec) in enumerate(self._pending):
+                if (src, rec.request_id) == (idx, lrid):
+                    del self._pending[k]
+                    break
+            else:
+                return False
+        # the replica may have parked a partial result under the local
+        # rid — drop it; the cluster's own stream records are the
+        # client-facing result
+        self._engines[idx]._done.pop(lrid, None)
         self._l2g.pop((idx, lrid), None)
         self._owner.pop(request_id, None)
-        self._tokens.pop(request_id, None)
-        self._submit_t.pop(request_id, None)
-        self._last_emit.pop(request_id, None)
         self._req_samp.pop(request_id, None)
+        if streamed:
+            self._finish(request_id)        # partial tokens + e2e obs
+        else:
+            self._tokens.pop(request_id, None)
+            self._submit_t.pop(request_id, None)
+            self._last_emit.pop(request_id, None)
         return True
 
     def step(self) -> List[tuple]:
@@ -448,8 +484,17 @@ class EngineCluster:
         for req in list(eng._queue):
             g = self._l2g.pop((index, req.request_id), None)
             eng.cancel(req.request_id)      # terminal queue-wait obs
-            if g is not None:
-                requeue.append((g, req.prompt, req.max_new_tokens))
+            if g is None:
+                continue
+            if req.resume is not None:
+                # a PREEMPTED request waiting to resume: its KV lives
+                # only on the failed replica (host-tier payload +
+                # published blocks), so it cannot continue elsewhere —
+                # terminate with the tokens already streamed, like an
+                # in-flight slot
+                self._finish(g)
+                continue
+            requeue.append((g, req.prompt, req.max_new_tokens))
         for slot in eng._slots:
             if slot is None:
                 continue
@@ -460,7 +505,18 @@ class EngineCluster:
             if g is not None:
                 self._finish(g)             # partial result
         for g, prompt, max_new in requeue:
-            self._route_submit(g, prompt, max_new)
+            try:
+                self._route_submit(g, prompt, max_new)
+            except QueueShedError:
+                # a surviving replica shed the drained request: the
+                # fault-tolerance path must not crash mid-drain (the
+                # remaining requeues' mappings are already popped) —
+                # terminate it with whatever streamed, like an
+                # in-flight casualty
+                warnings.warn(
+                    f"request {g} shed during the failure drain; "
+                    "terminating with the tokens already streamed")
+                self._finish(g)
 
     def stats(self) -> dict:
         """Cluster-aggregate snapshot: per-replica ``stats()`` dicts
@@ -484,6 +540,13 @@ class EngineCluster:
                 if self._n_routed else 0.0,
             "kv_blocks_transferred":
                 sum(r["kv_blocks_imported"] for r in reps),
+            "preemptions": sum(r["preemptions"] for r in reps),
+            "kv_blocks_spilled":
+                sum(r["kv_blocks_spilled"] for r in reps),
+            "kv_blocks_restored":
+                sum(r["kv_blocks_restored"] for r in reps),
+            "host_tier_bytes":
+                sum(r["host_tier_bytes"] for r in reps),
             "prefix_tokens_reused":
                 sum(r["prefix_tokens_reused"] for r in reps),
             "tokens_total": sum(r["tokens_total"] for r in reps),
@@ -565,7 +628,8 @@ class EngineCluster:
             # choose between, so affinity is meaningless here
             idx, overlap, depths = next(iter(cands)), 0, {}
         else:
-            idx, overlap, depths = self._router.route(prompt, cands)
+            idx, overlap, depths = self._router.route(
+                prompt, cands, priority=int(samp.get("priority", 0)))
         # submit FIRST: a validation rejection must not skew the
         # router counters (the hit rate is an acceptance metric)
         lrid = self._engines[idx].submit(prompt, max_new_tokens,

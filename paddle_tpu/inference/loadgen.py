@@ -58,6 +58,8 @@ class RequestRecord:
     arrival_s: float                    # scheduled arrival offset
     submit_t: float                     # actual submit() wall time
     token_t: List[float] = field(default_factory=list)
+    priority: int = 0                   # scheduling class (preemptive
+    #                                     engines; 0 = default class)
 
     @property
     def completed(self) -> bool:
@@ -137,6 +139,7 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
              concurrency: Optional[int] = None,
              max_new_tokens: Optional[int] = None,
              slo: Optional[SLO] = None, arrival: str = "poisson",
+             priorities: Optional[Sequence[int]] = None,
              seed: int = 0) -> dict:
     """Serve ``prompts`` through ``engine`` — a ``ServingEngine`` OR
     any object with the same ``submit/step/num_queued/num_active/
@@ -151,6 +154,12 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     reported ``achieved_qps`` is then the target's capacity at that
     concurrency.
 
+    ``priorities`` (one int per prompt) forwards each request's
+    scheduling class to ``submit(priority=)`` — the mixed-priority
+    overload workloads the preemptive scheduler is measured on — and
+    the report gains a ``by_priority`` breakdown (per-class goodput /
+    TTFT / TPOT, each class its own SLO denominator).
+
     The target's ``stream_callback`` is chained, not replaced: an
     application callback installed at construction still fires.
     """
@@ -158,9 +167,23 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if mode == "open" and not qps:
         raise ValueError("open-loop mode needs a target qps")
+    if priorities is not None and len(priorities) != len(prompts):
+        raise ValueError(
+            f"priorities ({len(priorities)}) must match prompts "
+            f"({len(prompts)})")
     slo = slo or SLO()
     n = len(prompts)
     records: Dict[int, RequestRecord] = {}
+
+    def _submit(idx, arrival_s):
+        kw = {} if priorities is None \
+            else {"priority": int(priorities[idx])}
+        rid = engine.submit(prompts[idx], max_new_tokens, **kw)
+        records[rid] = RequestRecord(
+            rid, float(arrival_s), time.monotonic(),
+            priority=0 if priorities is None
+            else int(priorities[idx]))
+        return rid
 
     prev_cb = engine._stream
 
@@ -197,16 +220,12 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
             now = time.monotonic() - t_start
             if mode == "open":
                 while idx < n and offsets[idx] <= now:
-                    rid = engine.submit(prompts[idx], max_new_tokens)
-                    records[rid] = RequestRecord(
-                        rid, float(offsets[idx]), time.monotonic())
+                    _submit(idx, offsets[idx])
                     idx += 1
             else:
                 while idx < n and (engine.num_queued
                                    + engine.num_active) < concurrency:
-                    rid = engine.submit(prompts[idx], max_new_tokens)
-                    records[rid] = RequestRecord(
-                        rid, now, time.monotonic())
+                    _submit(idx, now)
                     idx += 1
             if engine.num_queued or engine.num_active:
                 engine.step()
@@ -243,6 +262,16 @@ def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,
             else 0.0
 
     good = sum(r.meets(slo) for r in done)
+    by_priority = None
+    classes = sorted({r.priority for r in records})
+    if len(classes) > 1:
+        by_priority = {}
+        for p in classes:
+            sub = [r for r in records if r.priority == p]
+            rep = summarize(sub, slo, wall_s, offered_qps=None,
+                            mode=mode)
+            rep.pop("by_priority", None)
+            by_priority[str(p)] = rep
     return {
         "mode": mode,
         "requests": len(records),
@@ -260,4 +289,6 @@ def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,
         "tpot_p50_ms": pct(tpots, 50), "tpot_p99_ms": pct(tpots, 99),
         "e2e_p50_ms": pct(e2es, 50), "e2e_p99_ms": pct(e2es, 99),
         "wall_s": round(wall_s, 3),
+        **({"by_priority": by_priority}
+           if by_priority is not None else {}),
     }

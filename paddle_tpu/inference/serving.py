@@ -221,7 +221,16 @@ from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
 __all__ = ["ServingConfig", "ServingRequest", "ServingEngine",
-           "PrefilledRequest"]
+           "PrefilledRequest", "QueueShedError"]
+
+
+class QueueShedError(RuntimeError):
+    """Raised by ``submit()`` when queue-depth load shedding is armed
+    (``ServingConfig.shed_queue_depth``) and the admission queue is
+    already at the threshold: the request is REFUSED at the front
+    door (a ``serving_queue_wait_ms{outcome="shed"}`` observation is
+    the only trace it leaves) so queued work keeps its latency budget
+    instead of everyone timing out together under overload."""
 
 # trace-viewer pid per engine (and the stats() engine_id)
 _ENGINE_IDS = itertools.count()
@@ -332,6 +341,55 @@ class ServingConfig:
     # receives ``admit_prefilled()`` imports (any role accepts them —
     # the flag documents cluster intent and shows up in stats()).
     role: str = "both"
+    # -- SLO-aware preemptive scheduling + host-DRAM KV tier ----------
+    # (docs/OPS.md "Preemption & hierarchical KV offload"). True (the
+    # default) arms: priority classes on submit(priority=) — highest
+    # class admits first, FIFO within a class; a WATERMARK admission
+    # policy that may overcommit the block pool (admit on
+    # immediately-needed blocks + headroom instead of the worst-case
+    # prompt+max_new reservation — the 1.88x int8 slot win becomes
+    # usable); and preemption under slot/block pressure: the
+    # lowest-priority victim slot is spilled (full blocks published
+    # into the prefix index, live bytes exported to the host-DRAM
+    # tier), freed, and re-enqueued at the front of its class — on
+    # re-admission it either swap-restores the spilled bytes or
+    # re-prefills from the published blocks (recompute-vs-swap cost
+    # model), continuing token-exact vs never-preempted. False (or the
+    # PADDLE_TPU_PREEMPT=0 kill switch, which beats an explicit True)
+    # restores the worst-case-reservation FIFO scheduler bit-for-bit:
+    # priorities are ignored, nothing spills, no host tier exists.
+    # Preemption needs the chunked-prefill path (the recompute resume
+    # IS a chunk prefill) and never runs on a role="prefill" engine
+    # (its slots only park for handoff).
+    enable_preemption: bool = True
+    # watermark admission headroom in blocks: a request is admitted
+    # when the worst-case reservation fits (the old policy, unchanged
+    # when the pool is ample) OR when free blocks cover its immediate
+    # allocation plus this headroom (overcommit — growth past it is
+    # reclaimed by preemption). None = num_slots (one growth block per
+    # slot of headroom).
+    admission_watermark_blocks: Optional[int] = None
+    # host-DRAM KV tier capacity (bytes) for spilled blocks: preempted
+    # victims' live bytes and LRU-evicted published blocks park here
+    # (ops/paged_cache.HostKVTier) and restore through the fixed-width
+    # import executable. 0 disables the tier — victims always resume
+    # by recompute, evicted cached blocks just die (pre-tier
+    # behavior).
+    host_kv_tier_bytes: int = 64 << 20
+    # resume path for preempted victims: "auto" picks per victim from
+    # the measured recompute-vs-swap cost model (chunk-prefill tok/s
+    # vs host-transfer bytes/s), "swap"/"recompute" force one path
+    # (tests, tuning).
+    preempt_resume: str = "auto"
+    # queue-depth load shedding: submit() raises QueueShedError (and
+    # lands a serving_queue_wait_ms{outcome="shed"} observation) when
+    # the admission queue already holds this many requests. None = off.
+    shed_queue_depth: Optional[int] = None
+    # default per-request queue-wait budget: a request still queued
+    # after this many ms exits with outcome="timeout" (empty result,
+    # stream never starts). None = unbounded; submit(max_queue_wait_ms=)
+    # overrides per request.
+    max_queue_wait_ms: Optional[float] = None
     # mega-kernelized decode tick (ops/pallas/decode_fused.py): fuse
     # RMSNorm/LayerNorm into the QKV projection prologue, the
     # attention epilogue into the O-projection + residual add, and the
@@ -356,6 +414,19 @@ class ServingConfig:
         if self.role not in ("both", "prefill", "decode"):
             raise ValueError(
                 f"role must be both|prefill|decode, got {self.role!r}")
+        if self.preempt_resume not in ("auto", "swap", "recompute"):
+            raise ValueError(
+                f"preempt_resume must be auto|swap|recompute, got "
+                f"{self.preempt_resume!r}")
+        if self.host_kv_tier_bytes < 0:
+            raise ValueError(
+                f"host_kv_tier_bytes must be >= 0, got "
+                f"{self.host_kv_tier_bytes!r}")
+        if self.shed_queue_depth is not None \
+                and int(self.shed_queue_depth) < 1:
+            raise ValueError(
+                f"shed_queue_depth must be >= 1 (or None), got "
+                f"{self.shed_queue_depth!r}")
 
 
 def _num_experts(cfg) -> int:
@@ -380,6 +451,18 @@ class ServingRequest:
     temperature: Optional[float] = None
     top_k: Optional[int] = None
     top_p: Optional[float] = None
+    # scheduling class: higher admits first under the preemptive
+    # scheduler and may preempt strictly-lower-priority victims; FIFO
+    # within a class. Ignored (pure FIFO) when preemption is off.
+    priority: int = 0
+    # queue-wait budget (ms): still queued past it -> outcome="timeout"
+    max_queue_wait_ms: Optional[float] = None
+    # preemption carry-over (None for fresh requests): the victim's
+    # continuation state — {"cache_len", "last_token", "n_emitted",
+    # "history", "worst_blocks", "n_blocks", "nbytes", "key"} — plus
+    # the resolved per-slot sampling row, so re-admission seats the
+    # slot EXACTLY where the preempted one stopped
+    resume: Optional[dict] = None
 
 
 @dataclass
@@ -405,18 +488,25 @@ class PrefilledRequest:
     temperature: Optional[float] = None
     top_k: Optional[float] = None
     top_p: Optional[float] = None
+    # the request's scheduling class rides the handoff so the decode
+    # replica's preemptive scheduler sees the same priority the
+    # prefill tier admitted under
+    priority: int = 0
 
 
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
                  "last_token", "n_emitted", "max_new", "history",
                  "prompt", "pend_pos", "pend_row", "admit_t",
-                 "handoff")
+                 "handoff", "priority", "resume")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
                  max_new, history=None, prompt=None, pend_pos=None):
         self.admit_t = time.monotonic()   # request-span start (trace)
         self.handoff = False    # prefill-role slot parked for export
+        self.priority = 0       # scheduling class (preemptive sched)
+        self.resume = None      # (last_token, n_emitted) to restore
+        #                         when a recompute re-prefill completes
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -704,6 +794,46 @@ class ServingEngine:
         self._n_handoffs = 0            # prefills exported (this engine)
         self._n_blocks_exported = 0
         self._n_blocks_imported = 0
+        # -- SLO-aware preemptive scheduling + host-DRAM KV tier ------
+        # resolved ONCE at construction: config flag AND the
+        # PADDLE_TPU_PREEMPT env twin (0 = kill switch beating an
+        # explicit True — the worst-case FIFO scheduler returns
+        # bit-for-bit); a prefill-role engine never decodes so it has
+        # nothing to preempt, and the recompute resume path IS a chunk
+        # prefill, so the bucketed-prefill fallback disables it too
+        self._preempt_on = bool(getattr(cfg, "enable_preemption",
+                                        True)) \
+            and os.environ.get("PADDLE_TPU_PREEMPT", "1") != "0" \
+            and self._role != "prefill" and self._chunked
+        wm = getattr(cfg, "admission_watermark_blocks", None)
+        self._watermark = int(cfg.num_slots if wm is None else wm)
+        self._resume_policy = str(getattr(cfg, "preempt_resume",
+                                          "auto"))
+        self._shed_depth = getattr(cfg, "shed_queue_depth", None)
+        self._default_qwait = getattr(cfg, "max_queue_wait_ms", None)
+        tier_bytes = int(getattr(cfg, "host_kv_tier_bytes", 0) or 0)
+        self._host_tier = _pc.HostKVTier(tier_bytes) \
+            if self._preempt_on and tier_bytes > 0 else None
+        if self._host_tier is not None and self._prefix_on:
+            # LRU-evicted published blocks spill their bytes to host
+            # instead of dying — a later prefix hit restores them
+            # through the fixed-width import scatter
+            self._alloc.on_evict = self._spill_evicted
+        self._n_preempt = 0             # victim slots preempted
+        self._n_spilled = 0             # KV blocks spilled to host
+        self._n_restored = 0            # KV blocks restored from host
+        self._n_swap_resumes = 0
+        self._n_recompute_resumes = 0
+        self._n_shed = 0
+        self._n_timeout = 0
+        self._n_cancelled = 0           # in-flight cancels
+        # recompute-vs-swap cost model, measured online: EMA of chunk-
+        # prefill row throughput (rows/s — what a recompute resume
+        # pays per cached token) and of host-transfer bandwidth
+        # (bytes/s over the export/import executables — what a swap
+        # pays per payload byte)
+        self._prefill_rows_s = 0.0
+        self._xfer_bytes_s = 0.0
         # per-engine counts (the monitor counters below are process-
         # global telemetry shared by every engine; stats() must report
         # THIS engine)
@@ -779,6 +909,28 @@ class ServingEngine:
             "KV blocks streamed between engine pools (disaggregated "
             "prefill -> decode handoffs; counted at import, data + "
             "scales travel together on int8 pools)")
+        # -- preemption + host-tier telemetry (registered
+        # unconditionally so stats()/JSONL always carry the keys —
+        # FIFO/killed engines report zeros, dashboards never KeyError
+        # across a mixed or rolled-back fleet)
+        self._m_preempt = monitor.counter(
+            "serving_preemptions",
+            "victim slots preempted (blocks published + spilled, slot "
+            "freed, request re-enqueued at the front of its priority "
+            "class)")
+        self._m_spill = monitor.counter(
+            "serving_kv_blocks_spilled",
+            "KV blocks spilled to the host-DRAM tier (preempted "
+            "victims' live blocks + LRU-evicted published blocks; "
+            "int8 data + scales travel together)")
+        self._m_restore = monitor.counter(
+            "serving_kv_blocks_restored",
+            "KV blocks restored from the host-DRAM tier (swap resumes "
+            "+ prefix hits on spilled published blocks)")
+        self._m_host_bytes = monitor.gauge(
+            "serving_host_tier_bytes",
+            "bytes resident in the host-DRAM KV tier (spilled block "
+            "payloads awaiting restore or LRU eviction)")
         monitor.info(
             "serving_tp_degree",
             "tensor-parallel degree of the most recent engine").set(
@@ -919,18 +1071,39 @@ class ServingEngine:
     # -- public API ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
-               top_k=None, top_p=None) -> int:
+               top_k=None, top_p=None, priority=0,
+               max_queue_wait_ms=None) -> int:
         """Queue one request; returns its request id. Tokens stream to
         ``stream_callback`` as ``step()``/``run()`` produce them.
         ``temperature``/``top_k``/``top_p`` override the engine's
         ``ServingConfig`` values FOR THIS REQUEST ONLY (sampling
         engines; they land in the per-slot sampling tensors at
-        admission — device data, never a recompile). A validation
-        rejection still leaves a terminal queue-wait observation
-        (outcome="rejected") so the latency digest sees every request
-        that touched the front door, not only the admitted
-        survivors."""
+        admission — device data, never a recompile). ``priority`` is
+        the request's scheduling class under the preemptive scheduler
+        (higher admits first and may preempt strictly-lower victims;
+        FIFO within a class; ignored when preemption is off).
+        ``max_queue_wait_ms`` bounds the queue wait — a request still
+        queued past it exits with outcome="timeout" and an empty
+        result (default: ``ServingConfig.max_queue_wait_ms``). A
+        validation rejection still leaves a terminal queue-wait
+        observation (outcome="rejected") so the latency digest sees
+        every request that touched the front door, not only the
+        admitted survivors; queue-depth shedding
+        (``ServingConfig.shed_queue_depth``) refuses with
+        :class:`QueueShedError` and an outcome="shed" observation."""
         t0 = time.monotonic()
+        if self._shed_depth is not None \
+                and len(self._queue) >= int(self._shed_depth):
+            self._n_shed += 1
+            self._m_queue_wait.labels(outcome="shed").observe(0.0)
+            self._d_queue.observe(0.0)
+            if self._trace is not None:
+                self._trace.instant("shed", tid=self._tid_queue,
+                                    args={"queued": len(self._queue)})
+            raise QueueShedError(
+                f"admission queue at shed threshold "
+                f"({len(self._queue)} >= {int(self._shed_depth)}): "
+                "request refused (load shedding)")
         try:
             ids = np.asarray(prompt, np.int32).reshape(-1)
             if ids.size == 0:
@@ -969,6 +1142,17 @@ class ServingEngine:
             if top_p is not None and not 0.0 < float(top_p) <= 1.0:
                 raise ValueError(
                     f"top_p must be in (0, 1], got {top_p}")
+            if isinstance(priority, bool) or not isinstance(
+                    priority, (int, np.integer)):
+                raise ValueError(
+                    f"priority must be an int, got {priority!r}")
+            if max_queue_wait_ms is None:
+                max_queue_wait_ms = self._default_qwait
+            if max_queue_wait_ms is not None \
+                    and float(max_queue_wait_ms) <= 0.0:
+                raise ValueError(
+                    f"max_queue_wait_ms must be > 0 (or None), got "
+                    f"{max_queue_wait_ms}")
         except ValueError:
             wait = 1000.0 * (time.monotonic() - t0)
             self._m_queue_wait.labels(outcome="rejected").observe(wait)
@@ -983,29 +1167,101 @@ class ServingEngine:
             temperature=None if temperature is None
             else float(temperature),
             top_k=None if top_k is None else int(top_k),
-            top_p=None if top_p is None else float(top_p))
+            top_p=None if top_p is None else float(top_p),
+            priority=int(priority),
+            max_queue_wait_ms=None if max_queue_wait_ms is None
+            else float(max_queue_wait_ms))
         self._queue.append(req)
         self._submit_t[rid] = req.submit_time
         if self._trace is not None:
             self._trace.instant(
                 "submit", tid=self._tid_queue,
                 args={"rid": rid, "prompt_tokens": int(ids.size),
-                      "max_new": max_new})
+                      "max_new": max_new, "priority": int(priority)})
         return rid
 
     def cancel(self, request_id: int) -> bool:
-        """Cancel a request still waiting in the admission queue.
-        Returns True when it was removed (its terminal queue-wait
-        observation lands with outcome="cancelled"); False when the id
-        is unknown or already admitted — mid-flight preemption is a
-        scheduler feature this engine does not implement yet (ROADMAP
-        "SLO-aware multi-tenant scheduling")."""
+        """Cancel a request ANYWHERE in its lifetime. Queued: removed
+        with a terminal queue-wait observation (outcome="cancelled");
+        a queued PREEMPTED request additionally lands its e2e
+        observation and surfaces the tokens already streamed. In
+        flight (mid-prefill or mid-decode): the slot is retired
+        immediately — its blocks are freed WITHOUT publishing (a
+        cancelled stream's continuation must not seed the prefix
+        cache), its spilled payload (if any) is dropped from the host
+        tier, the partial tokens land in ``run()``'s results, and the
+        e2e digest observes submit -> cancel. Returns False only when
+        the id is unknown (never submitted, already finished, or
+        already cancelled)."""
         for k, req in enumerate(self._queue):
             if req.request_id == request_id:
                 del self._queue[k]
                 self._queue_exit(req, "cancelled")
+                self._finish_unserved(req)
+                return True
+        for i, s in enumerate(self._slots):
+            if s is not None and s.rid == request_id:
+                self._cancel_slot(i)
                 return True
         return False
+
+    def _finish_unserved(self, req, record_empty=False):
+        """Terminal bookkeeping for a request leaving the QUEUE without
+        service (cancel / timeout): surface what already streamed (the
+        partial tokens of a preempted request; ``record_empty`` lands
+        an empty result for never-admitted timeouts so ``run()``
+        consumers never KeyError), drop any spilled payload, and land
+        the e2e observation for requests that DID stream (their
+        clients saw tokens; the digest must see the request end)."""
+        rid = req.request_id
+        if req.resume is not None:
+            if self._host_tier is not None:
+                self._host_tier.pop(("victim", rid), restore=False)
+                self._m_host_bytes.set(self._host_tier.bytes_used)
+            # anchor on the request's own submit time — _queue_exit
+            # already popped _submit_t for terminal outcomes, and a
+            # preempted request DID stream, so its end must land in
+            # the e2e digest
+            self._submit_t.pop(rid, None)
+            self._d_e2e.observe(
+                1000.0 * (time.monotonic() - req.submit_time))
+        self._last_emit.pop(rid, None)
+        toks = self._results.pop(rid, None)
+        if self.config.retain_results and (
+                toks is not None or record_empty):
+            self._done[rid] = np.asarray(toks or [], np.int64)
+
+    def _cancel_slot(self, i):
+        """Retire slot ``i`` mid-flight on behalf of ``cancel()``: no
+        completion accounting, no publishing (the cancelled stream
+        must not seed the prefix index with its continuation), blocks
+        freed, stream terminated with the tokens already emitted."""
+        slot = self._slots[i]
+        now = time.monotonic()
+        t0 = self._submit_t.pop(slot.rid, None)
+        if t0 is not None:
+            self._d_e2e.observe(1000.0 * (now - t0))
+        self._last_emit.pop(slot.rid, None)
+        if self._trace is not None:
+            self._trace.emit(
+                f"req{slot.rid}", tid=1 + i, t0=slot.admit_t, t1=now,
+                args={"tokens": slot.n_emitted,
+                      "cache_len": slot.cache_len, "cancelled": True})
+            self._trace.instant("cancelled", tid=1 + i,
+                                args={"rid": slot.rid})
+        if slot.handoff and i in self._handoff_ready:
+            self._handoff_ready.remove(i)
+        self._alloc.free(slot.blocks)
+        self._reserved -= slot.worst_blocks - len(slot.blocks)
+        self._tables[i, :] = 0
+        self._tables_dev = None
+        self._slots[i] = None
+        self._set_slot_samp(i)
+        toks = self._results.pop(slot.rid, [])
+        if self.config.retain_results:
+            self._done[slot.rid] = np.asarray(toks, np.int64)
+        self._n_cancelled += 1
+        self._m_occupancy.set(self.num_active)
 
     def _trace_tick(self, t_tick, exec_name: str, path: str, **extra):
         """One engine-tick span (tid 0) — ALL three step paths emit
@@ -1030,7 +1286,9 @@ class ServingEngine:
         wait = 1000.0 * (now - req.submit_time)
         self._m_queue_wait.labels(outcome=outcome).observe(wait)
         self._d_queue.observe(wait)
-        if outcome != "admitted":       # request will never emit/retire
+        if outcome not in ("admitted", "resumed"):
+            # request will never emit/retire (a "resumed" one keeps
+            # its original submit anchor for the e2e digest)
             self._submit_t.pop(req.request_id, None)
         if self._trace is not None:
             self._trace.emit(
@@ -1067,7 +1325,9 @@ class ServingEngine:
             if self._kv_read_pend:      # prefill-only tick: the chunk
                 self._note_kv_read(0)   # reads ARE the tick's traffic
             return emitted
-        self._ensure_blocks(active)
+        active = self._ensure_blocks(active)
+        if not active:                  # everyone preempted for blocks
+            return emitted
 
         cfg = self.config
         lens = np.zeros(cfg.num_slots, np.int32)
@@ -1142,7 +1402,9 @@ class ServingEngine:
             return emitted
         g = self._gamma
         # room for the full window: positions cache_len .. cache_len+g
-        self._ensure_blocks(active, horizon=g + 1)
+        active = self._ensure_blocks(active, horizon=g + 1)
+        if not active:                  # everyone preempted for blocks
+            return emitted
 
         cfg = self.config
         lens = np.zeros(cfg.num_slots, np.int32)
@@ -1287,8 +1549,11 @@ class ServingEngine:
             return emitted
         if active:
             # room for this tick's write positions (the verify window
-            # overhangs by up to gamma speculated slots)
-            self._ensure_blocks(active, horizon=g + 1)
+            # overhangs by up to gamma speculated slots); growth under
+            # an overcommitted pool may preempt — survivors only
+            active = self._ensure_blocks(active, horizon=g + 1)
+            if not active and not pending:
+                return emitted
 
         # -- pack the tick's work into per-slot row counts -------------
         q_lens = np.zeros(n_slots, np.int64)
@@ -1299,6 +1564,15 @@ class ServingEngine:
         for i in active:
             q_lens[i] = g + 1
             base[i] = self._slots[i].cache_len
+        # a growth preemption above may have victimized a pending slot
+        pending = [i for i in pending if self._slots[i] is not None]
+        if self._preempt_on and len(pending) > 1:
+            # the per-tick prefill row budget is a scheduled resource
+            # too: the highest class prefills first (its TTFT is the
+            # SLO), FIFO within a class — under the kill switch the
+            # slot-index order is untouched, bit-for-bit
+            pending.sort(key=lambda i: (-self._slots[i].priority,
+                                        self._slots[i].admit_t, i))
         for i in pending:
             if budget <= 0:
                 break
@@ -1458,6 +1732,10 @@ class ServingEngine:
                     self._n_spec_accepted / self._n_spec_proposed)
 
         # -- commit prefill progress -----------------------------------
+        if given:
+            # cost-model input: rows prefilled this launch / wall time
+            self._note_prefill_rate(sum(given.values()),
+                                    t_sync - t_l0)
         for i, k in given.items():
             slot = self._slots[i]
             slot.pend_pos += k
@@ -1582,6 +1860,25 @@ class ServingEngine:
             "prefills_exported": self._n_handoffs,
             "kv_blocks_exported": self._n_blocks_exported,
             "kv_blocks_imported": self._n_blocks_imported,
+            # preemptive-scheduler + host-tier keys: ALWAYS present
+            # (zeros under the PADDLE_TPU_PREEMPT=0 kill switch or
+            # enable_preemption=False), so dashboards never KeyError
+            # across a mixed or rolled-back fleet
+            "preemption_enabled": self._preempt_on,
+            "preemptions": self._n_preempt,
+            "kv_blocks_spilled": self._n_spilled,
+            "kv_blocks_restored": self._n_restored,
+            "host_tier_bytes": self._host_tier.bytes_used
+            if self._host_tier is not None else 0,
+            "host_tier_capacity_bytes": self._host_tier.capacity
+            if self._host_tier is not None else 0,
+            "preempt_swap_resumes": self._n_swap_resumes,
+            "preempt_recompute_resumes": self._n_recompute_resumes,
+            "prefill_rows_per_s_est": round(self._prefill_rows_s, 3),
+            "host_xfer_bytes_per_s_est": round(self._xfer_bytes_s, 1),
+            "requests_shed": self._n_shed,
+            "requests_timed_out": self._n_timeout,
+            "requests_cancelled": self._n_cancelled,
             "tp_degree": self._tp,
             # always present (0 / full pool when single-device), so a
             # tp_degree>1 request downgraded by the PADDLE_TPU_SERVE_TP=0
@@ -1657,7 +1954,12 @@ class ServingEngine:
             return 0
         n = 0
         for h in hashes:
-            if self._alloc.lookup(h) is None:
+            if self._alloc.lookup(h) is None and not (
+                    self._host_tier is not None
+                    and ("pub", h) in self._host_tier):
+                # host-tier entries count: a spilled published block
+                # restores on admission, so the replica still serves
+                # the prefix without re-prefilling it
                 break
             n += 1
         return n
@@ -1694,7 +1996,7 @@ class ServingEngine:
                 max_new_tokens=slot.max_new,
                 n_blocks=len(slot.blocks), payload=payload,
                 temperature=float(samp[0]), top_k=float(samp[1]),
-                top_p=float(samp[2])))
+                top_p=float(samp[2]), priority=int(slot.priority)))
             self._release_handoff(i)
         self._handoff_ready = []
         return out
@@ -1762,6 +2064,8 @@ class ServingEngine:
             rid, blocks, worst, n_real, tok, max_new,
             history=list(map(int, prompt)) + [tok],
             prompt=prompt, pend_pos=None)
+        self._slots[i].priority = int(getattr(prefilled, "priority",
+                                              0) or 0)
         self._set_slot_samp(i, prefilled)
         self._m_occupancy.set(self.num_active)
         if self._trace is not None:
@@ -2188,22 +2492,40 @@ class ServingEngine:
 
     def _admit(self) -> List[tuple]:
         emitted = []
+        self._expire_queue()
         while self._queue:
+            k = self._pick_next_idx()
+            req = self._queue[k]
             free = [i for i, s in enumerate(self._slots) if s is None]
             if not free:
+                # slot-pressure preemption: a strictly-lower-priority
+                # victim yields its slot to the waiting request
+                # (blocks published + spilled, victim requeued at the
+                # front of ITS class)
+                if not self._preempt_on:
+                    break
+                v = self._pick_victim(below=req.priority)
+                if v is None:
+                    break
+                self._preempt(v)
+                free = [v]
+            if not self._admission_fits(req):
                 break
-            req = self._queue[0]
+            # remove by IDENTITY: a preemption above appendleft'ed the
+            # victim's resume request, shifting every index right —
+            # ``k`` may no longer point at ``req``
+            for k2, r2 in enumerate(self._queue):
+                if r2 is req:
+                    del self._queue[k2]
+                    break
+            i = free[0]
+            if req.resume is not None:
+                # a preempted request re-admits through its own seat
+                # path (swap-restore or recompute re-prefill)
+                self._seat_resume(i, req, emitted)
+                continue
             n_real = int(req.prompt.size)
             worst = self._worst_for(n_real, req.max_new_tokens)
-            # worst-case reservation: admit only what can NEVER run the
-            # pool dry mid-decode (FIFO — no head-of-line bypass, which
-            # keeps "every request completes exactly once" trivial).
-            # free_blocks counts LRU-cached blocks (evictable on
-            # demand), so the prefix cache never blocks admission.
-            if self._alloc.free_blocks - self._reserved < worst:
-                break
-            self._queue.popleft()
-            i = free[0]
             blocks, cached = self._map_prefix(req.prompt, n_real)
             self._reserved += worst - len(blocks)
             self._tables[i, :] = 0
@@ -2230,6 +2552,7 @@ class ServingEngine:
                 history=list(map(int, req.prompt)),
                 prompt=np.asarray(req.prompt, np.int32),
                 pend_pos=cached)
+            self._slots[i].priority = int(req.priority)
             self._set_slot_samp(i, req)
             self._m_occupancy.set(self.num_active)
             if self._trace is not None:
@@ -2258,6 +2581,450 @@ class ServingEngine:
         self._sync_cache_metrics()
         return emitted
 
+    # -- preemptive scheduling + host-DRAM KV tier --------------------
+
+    def _pick_next_idx(self) -> int:
+        """Queue position to admit next: highest priority class first,
+        FIFO within a class (stable max — the leftmost of the winning
+        class; a preempted request re-enters via ``appendleft``, so it
+        leads its class). Plain FIFO when preemption is off."""
+        if not self._preempt_on or len(self._queue) < 2:
+            return 0
+        best, bp = 0, self._queue[0].priority
+        for k in range(1, len(self._queue)):
+            p = self._queue[k].priority
+            if p > bp:
+                best, bp = k, p
+        return best
+
+    def _expire_queue(self):
+        """Queue-wait timeouts: requests queued past their
+        ``max_queue_wait_ms`` exit with outcome="timeout" and an empty
+        result (the stream never started). Preempted requests are
+        exempt — they already streamed tokens; timing them out
+        mid-stream would truncate a live response."""
+        if not any(r.max_queue_wait_ms is not None
+                   for r in self._queue):
+            return
+        now = time.monotonic()
+        kept = deque()
+        for r in self._queue:
+            w = r.max_queue_wait_ms
+            if w is not None and r.resume is None \
+                    and 1000.0 * (now - r.submit_time) > float(w):
+                self._n_timeout += 1
+                self._queue_exit(r, "timeout")
+                self._finish_unserved(r, record_empty=True)
+            else:
+                kept.append(r)
+        self._queue = kept
+
+    def _admission_fits(self, req) -> bool:
+        """Admission block policy. The worst-case reservation check
+        (prompt + max_new + gamma covered for EVERY active slot) stays
+        the first gate — when the pool is ample, behavior is identical
+        to the pre-preemption scheduler. When it fails and the
+        preemptive scheduler is on, the WATERMARK policy may overcommit:
+        admit on the immediately-needed blocks plus
+        ``admission_watermark_blocks`` of growth headroom, preempting
+        strictly-lower-priority victims to reach it — growth past the
+        headroom is reclaimed by preemption against the host tier.
+        Resume re-admissions need only their restored block set (their
+        reservation was already granted once)."""
+        if req.resume is not None:
+            need, target = int(req.resume["n_blocks"]), 0
+        else:
+            n_real = int(req.prompt.size)
+            worst = self._worst_for(n_real, req.max_new_tokens)
+            if self._alloc.free_blocks - self._reserved >= worst:
+                return True
+            if not self._preempt_on:
+                return False
+            need = _pc.blocks_for(n_real, self._bs)
+            target = self._watermark
+        while self._alloc.free_blocks - need < target:
+            v = self._pick_victim(below=req.priority)
+            if v is None:
+                break
+            self._preempt(v)
+        return self._alloc.free_blocks - need >= target
+
+    def _pick_victim(self, below=None, exclude=()):
+        """Victim policy: the lowest priority class loses first;
+        within a class MID-PREFILL slots lose before decoding ones
+        (they have streamed nothing yet — preempting them costs no
+        client-visible stall, and their full blocks publish into the
+        prefix index so the re-prefill is mostly a cache hit), then
+        the most recently admitted slot (LIFO — the oldest resident
+        keeps its progress, which is what bounds thrash).
+        Parked-handoff slots are never victims. ``below`` restricts to
+        strictly-lower classes (slot/admission preemption);
+        ``exclude`` keeps a growing slot from victimizing itself."""
+        cands = [i for i, s in enumerate(self._slots)
+                 if s is not None and not s.handoff
+                 and i not in exclude
+                 and (below is None or s.priority < below)]
+        if not cands:
+            return None
+        return min(cands, key=lambda i: (
+            self._slots[i].priority,
+            0 if self._slots[i].pend_pos is not None else 1,
+            -self._slots[i].admit_t))
+
+    def _alloc_with_preempt(self, n, exclude=(), below=None):
+        """Allocate ``n`` blocks, preempting victims under pool
+        pressure (preemptive scheduler only; lowest class first,
+        optionally bounded by ``below``). Raises like ``alloc`` when
+        even preemption cannot cover the demand."""
+        if self._preempt_on:
+            while self._alloc.free_blocks < n:
+                v = self._pick_victim(below=below, exclude=exclude)
+                if v is None:
+                    break
+                self._preempt(v)
+        return self._alloc.alloc(n)
+
+    def _preempt(self, i):
+        """Preempt slot ``i``: trim the verify-window overhang, publish
+        the full blocks into the prefix index (the recompute path's
+        warm start), spill the live bytes to the host-DRAM tier (the
+        swap path), free everything, and re-enqueue the request at the
+        FRONT of its priority class carrying the exact continuation
+        state (cache_len / last_token / n_emitted / history / sampling
+        row) — resume is token-exact by construction on either
+        path."""
+        slot = self._slots[i]
+        samp_row = self._slot_samp[i].copy()
+        # a mid-prefill slot is "pending" ONLY when it carries no
+        # continuation: a previously-preempted request re-prefilling
+        # its context (slot.resume set) must keep that continuation —
+        # treating it as fresh would reset n_emitted and overrun the
+        # client's stream past max_new
+        pending = slot.pend_pos is not None and slot.resume is None
+        # 1) blocks past cache_len hold rolled-back/garbage positions
+        # (or not-yet-prefilled prompt room, for a mid-prefill victim)
+        # — return them first so the spill payload is exactly live
+        # bytes
+        keep = max(_pc.blocks_for(slot.cache_len, self._bs), 1)
+        while len(slot.blocks) > keep:
+            blk = slot.blocks.pop()
+            self._alloc.free([blk])
+            self._tables[i, len(slot.blocks)] = 0
+            self._reserved += 1
+            self._tables_dev = None
+        # 2) publish full blocks (same walk as _retire)
+        if self._prefix_on and slot.cache_len >= self._bs:
+            n_full = min(len(slot.blocks), slot.cache_len // self._bs)
+            for b, h in zip(slot.blocks[:n_full],
+                            _pc.chain_hashes(
+                                self._fp,
+                                slot.history[:n_full * self._bs],
+                                self._bs)):
+                self._alloc.publish(b, h)
+        # 3) spill live bytes to the host tier (swap-resume payload).
+        # A MID-PREFILL victim skips the spill: it has streamed
+        # nothing, so it requeues as a FRESH request — its published
+        # full blocks (step 2) make the re-prefill mostly a prefix-
+        # cache hit, no continuation state needed.
+        key = None
+        nbytes = 0
+        if slot.pend_pos is None and self._host_tier is not None \
+                and slot.blocks \
+                and len(slot.blocks) <= self._mb_xfer:
+            # spill only a fully-valid cache (a decoding victim); a
+            # mid-re-prefill victim keeps its continuation but its
+            # partial KV cannot back a swap — it resumes by recompute
+            payload = _pc.payload_rows(
+                self._export_payload(slot.blocks), len(slot.blocks))
+            nbytes = _pc.payload_nbytes(payload)
+            key = ("victim", slot.rid)
+            if self._host_tier.put(key, payload, nbytes):
+                self._n_spilled += len(slot.blocks)
+                self._m_spill.inc(len(slot.blocks))
+            else:
+                key = None      # refused (too big): recompute resume
+            self._m_host_bytes.set(self._host_tier.bytes_used)
+        n_spilled_blocks = len(slot.blocks) if key is not None else 0
+        # 4) free the blocks (published ones park in the LRU cache)
+        self._alloc.free(slot.blocks)
+        self._reserved -= slot.worst_blocks - len(slot.blocks)
+        self._tables[i, :] = 0
+        self._tables_dev = None
+        self._slots[i] = None
+        self._set_slot_samp(i)
+        self._m_occupancy.set(self.num_active)
+        # 5) re-enqueue at the front of its class; a DECODING victim
+        # carries the exact continuation state, a mid-prefill victim
+        # goes back as a fresh request (nothing streamed yet). The
+        # ORIGINAL submit time anchors queue-wait/e2e observations
+        # either way.
+        resume = None
+        if not pending:
+            if slot.resume is not None:
+                # twice-preempted mid-re-prefill: the ORIGINAL
+                # continuation carries over; its full context is the
+                # stored history minus the pending last_token
+                last_token, n_emitted = slot.resume
+            else:
+                last_token, n_emitted = slot.last_token, slot.n_emitted
+            n_ctx = len(slot.history) - 1   # == cache_len for a
+            #                                 decoding victim
+            resume = {"cache_len": int(n_ctx),
+                      "last_token": int(last_token),
+                      "n_emitted": int(n_emitted),
+                      "history": list(slot.history),
+                      "worst_blocks": int(slot.worst_blocks),
+                      "n_blocks": _pc.blocks_for(n_ctx, self._bs),
+                      "nbytes": int(nbytes), "key": key}
+        req = ServingRequest(
+            slot.rid, np.asarray(slot.prompt, np.int32), slot.max_new,
+            temperature=float(samp_row[0]) if self._do_sample
+            else None,
+            top_k=int(samp_row[1]) if self._do_sample else None,
+            top_p=float(samp_row[2]) if self._do_sample else None,
+            priority=int(slot.priority), resume=resume)
+        req.submit_time = self._submit_t.get(slot.rid,
+                                             req.submit_time)
+        self._queue.appendleft(req)
+        self._n_preempt += 1
+        self._m_preempt.inc()
+        if self._trace is not None:
+            self._trace.instant(
+                "preempt", tid=1 + i,
+                args={"rid": slot.rid, "priority": int(slot.priority),
+                      "cache_len": int(slot.cache_len),
+                      "blocks_spilled": n_spilled_blocks})
+
+    def _seat_resume(self, i, req, emitted):
+        """Re-admit a preempted request into slot ``i`` exactly where
+        it stopped. Swap: import the spilled bytes at freshly
+        allocated blocks (bitwise the preempted pool state) and seat
+        the slot ACTIVE. Recompute: map the published prefix blocks
+        (the prefix cache IS the recompute fast path) and re-prefill
+        only what eviction lost, through the ordinary chunk machinery;
+        ``_finish_prefill`` then restores the continuation instead of
+        emitting. Either way last_token / n_emitted / history / the
+        sampling row carry over, so the resumed stream is token-exact
+        vs never-preempted."""
+        r = req.resume
+        rid = req.request_id
+        n_ctx = int(r["cache_len"])
+        ctx = np.asarray(r["history"][:n_ctx], np.int32)
+        payload = None
+        if self._host_tier is not None and r["key"] is not None:
+            payload = self._host_tier.get(r["key"])
+        mode = self._resume_mode(r, payload)
+        self._queue_exit(req, "resumed")
+        if rid not in self._results:        # kept across preemption
+            self._results[rid] = []
+        if mode == "swap":
+            n_blocks = int(r["n_blocks"])
+            blocks = self._alloc.alloc(n_blocks)
+            self._import_payload(blocks, payload)
+            self._host_tier.pop(r["key"])
+            self._n_restored += n_blocks
+            self._m_restore.inc(n_blocks)
+            self._m_host_bytes.set(self._host_tier.bytes_used)
+            self._n_swap_resumes += 1
+            self._reserved += int(r["worst_blocks"]) - n_blocks
+            self._tables[i, :] = 0
+            self._tables[i, :n_blocks] = blocks
+            self._tables_dev = None
+            slot = _Slot(rid, blocks, int(r["worst_blocks"]), n_ctx,
+                         int(r["last_token"]), int(req.max_new_tokens),
+                         history=list(r["history"]), prompt=ctx,
+                         pend_pos=None)
+            slot.n_emitted = int(r["n_emitted"])
+        else:
+            if self._host_tier is not None and r["key"] is not None:
+                # the stale payload (if any) will never be imported
+                self._host_tier.pop(r["key"], restore=False)
+                self._m_host_bytes.set(self._host_tier.bytes_used)
+            self._n_recompute_resumes += 1
+            blocks, cached = self._map_prefix(ctx, n_ctx)
+            self._reserved += int(r["worst_blocks"]) - len(blocks)
+            self._tables[i, :] = 0
+            if self._ragged or not (self._chunked
+                                    and self._chunk_budget > 0):
+                self._tables[i, :len(blocks)] = blocks
+            self._tables_dev = None
+            slot = _Slot(rid, blocks, int(r["worst_blocks"]), cached,
+                         None, int(req.max_new_tokens),
+                         history=list(r["history"]), prompt=ctx,
+                         pend_pos=cached)
+            slot.resume = (int(r["last_token"]), int(r["n_emitted"]))
+        slot.priority = int(req.priority)
+        self._slots[i] = slot
+        self._set_slot_samp(i, req)
+        self._m_occupancy.set(self.num_active)
+        if self._trace is not None:
+            self._trace.instant(
+                "resume", tid=1 + i,
+                args={"rid": rid, "mode": mode, "cache_len": n_ctx})
+        if mode != "swap":
+            # a shared suffix-boundary block is COW'd before the
+            # recomputed tail writes into it — same as a fresh
+            # admission's full-prompt-hit path
+            bidx = cached // self._bs
+            if self._alloc.is_shared(blocks[bidx]):
+                self._cow(i, bidx)
+            if not self._ragged and self._chunk_budget <= 0:
+                tok = self._advance_prefill(i)
+                self._finish_prefill(i, tok, emitted)
+
+    def _resume_mode(self, r, payload) -> str:
+        """Recompute-vs-swap, per victim: restore time ~= payload
+        bytes / measured host-transfer bandwidth; recompute time ~=
+        cached tokens / measured chunk-prefill row throughput. A
+        missing payload (tier off, dropped under pressure, or refused)
+        forces recompute; un-measured rates default to swap (bytes
+        beat re-running the model until the prefill rate proves
+        otherwise). ``ServingConfig.preempt_resume`` pins one path."""
+        if payload is None:
+            return "recompute"
+        if self._resume_policy in ("swap", "recompute"):
+            return self._resume_policy
+        if self._prefill_rows_s > 0 and self._xfer_bytes_s > 0:
+            t_swap = float(r["nbytes"]) / self._xfer_bytes_s
+            t_rec = float(r["cache_len"]) / self._prefill_rows_s
+            return "swap" if t_swap <= t_rec else "recompute"
+        return "swap"
+
+    def _export_payload(self, blocks):
+        """Gather ``blocks``' self-contained bytes to host DRAM through
+        THE fixed-width export executable (shared with the
+        disaggregated handoff — compiled once per engine). The
+        ``payload_to_host`` materialization blocks on the gather, so
+        the timing feeds the cost model's transfer-bandwidth EMA."""
+        ids = np.zeros(self._mb_xfer, np.int32)
+        ids[:len(blocks)] = blocks
+        ids_dev = self._dev(ids)
+        if self._export_exec is None:
+            self._export_exec = self._aot_compile(
+                "export", jax.jit(_pc.export_blocks),
+                (self._pools, ids_dev))
+        t0 = time.monotonic()
+        host = _pc.payload_to_host(
+            self._export_exec(self._pools, ids_dev))
+        self._note_xfer(_pc.payload_nbytes(host),
+                        time.monotonic() - t0)
+        return host
+
+    def _import_payload(self, blocks, payload):
+        """Scatter a host payload back into this engine's pools at
+        ``blocks`` through THE fixed-width import executable (shared
+        with ``admit_prefilled`` — compiled once). Short payloads are
+        zero-padded back to the fixed width; pad rows scatter into the
+        null block."""
+        ids = np.zeros(self._mb_xfer, np.int32)
+        ids[:len(blocks)] = blocks
+        ids_dev = self._dev(ids)
+        dev = self._payload_dev(
+            _pc.payload_pad(payload, self._mb_xfer))
+        if self._import_exec is None:
+            self._import_exec = self._aot_compile(
+                "import",
+                jax.jit(_pc.import_blocks, donate_argnums=(0,)),
+                (self._pools, ids_dev, dev))
+        with _quiet_donation():
+            self._pools = self._import_exec(self._pools, ids_dev, dev)
+
+    def _payload_dev(self, payload):
+        """Host payload -> device operands for the import executable;
+        under TP each array is placed with the pool's kv_head sharding
+        (the compiled executable is strict about input shardings)."""
+        if self._mesh is None:
+            def d(x):
+                if isinstance(x, _pc.QuantKV):
+                    return _pc.QuantKV(jnp.asarray(x.data),
+                                       jnp.asarray(x.scale))
+                return jnp.asarray(x)
+        else:
+            dsh = self._pool_sharding
+            ssh = _pc.scale_sharding(dsh)
+
+            def d(x):
+                if isinstance(x, _pc.QuantKV):
+                    return _pc.QuantKV(jax.device_put(x.data, dsh),
+                                       jax.device_put(x.scale, ssh))
+                return jax.device_put(x, dsh)
+        return [(d(k), d(v)) for k, v in payload]
+
+    def _spill_evicted(self, b, h):
+        """Allocator eviction hook (``BlockAllocator.on_evict``): an
+        LRU-cached published block is being reclaimed — gather its
+        bytes to the host tier first, keyed by content hash, so a
+        later prefix hit restores it instead of re-prefilling. The
+        export launch is issued before the evicting caller's next
+        write, so the bytes read are the published ones."""
+        payload = _pc.payload_rows(self._export_payload([b]), 1)
+        if self._host_tier.put(("pub", h), payload,
+                               _pc.payload_nbytes(payload)):
+            self._n_spilled += 1
+            self._m_spill.inc()
+        self._m_host_bytes.set(self._host_tier.bytes_used)
+
+    def _restore_published(self, h):
+        """Host-tier prefix restore: a prompt hash that misses the
+        device index but hits the host tier re-materializes the block
+        — alloc (opportunistic: never preempts for a cache hit),
+        import, re-publish — and the admission walk continues as if
+        the block had never been evicted. Returns the block id (one
+        reference, owned by the caller's slot) or None."""
+        if self._host_tier is None:
+            return None
+        payload = self._host_tier.get(("pub", h))
+        if payload is None:
+            return None
+        if self._alloc.free_blocks < 1:
+            return None
+        (b,) = self._alloc.alloc(1)
+        self._import_payload([b], payload)
+        self._host_tier.pop(("pub", h))
+        self._alloc.publish(b, h)
+        self._n_restored += 1
+        self._m_restore.inc()
+        self._m_host_bytes.set(self._host_tier.bytes_used)
+        return b
+
+    def _note_xfer(self, nbytes, dt):
+        """Host-transfer bandwidth EMA (the swap side of the
+        recompute-vs-swap cost model)."""
+        if dt <= 0.0 or nbytes <= 0:
+            return
+        bps = nbytes / dt
+        self._xfer_bytes_s = bps if not self._xfer_bytes_s \
+            else 0.7 * self._xfer_bytes_s + 0.3 * bps
+
+    def _note_prefill_rate(self, rows, dt):
+        """Chunk-prefill throughput EMA (the recompute side of the
+        cost model). Fed by ticks that carried prefill rows — the
+        whole launch is attributed to them, so the estimate is
+        conservative (recompute looks slower than it is, biasing
+        toward swap; the transfer EMA is measured the same
+        wall-clock way)."""
+        if dt <= 0.0 or rows <= 0:
+            return
+        rps = rows / dt
+        self._prefill_rows_s = rps if not self._prefill_rows_s \
+            else 0.7 * self._prefill_rows_s + 0.3 * rps
+
+    def queue_depth(self, priority=None):
+        """Queued + active work. With ``priority`` given (and the
+        preemptive scheduler on) lower-priority work is DISCOUNTED to
+        0.25 — it can be preempted or bypassed by an arrival of that
+        class, so it blocks the arrival far less than peer work does.
+        The cluster router's priority-weighted tiebreak reads this."""
+        if priority is None or not self._preempt_on:
+            return self.num_queued + self.num_active
+        w = 0.0
+        for r in self._queue:
+            w += 1.0 if r.priority >= priority else 0.25
+        for s in self._slots:
+            if s is not None:
+                w += 1.0 if s.priority >= priority else 0.25
+        return w
+
     def _map_prefix(self, prompt, n_real):
         """Map the longest cached prefix of ``prompt`` — leading FULL
         blocks whose rolling content hashes hit the allocator's index
@@ -2278,9 +3045,16 @@ class ServingEngine:
             for h in _pc.prompt_block_hashes(self._fp, prompt,
                                              self._bs):
                 b = self._alloc.lookup(h)
-                if b is None:
+                if b is not None:
+                    matched.append(self._alloc.ref(b))
+                    continue
+                # device-index miss: the block may have been LRU-
+                # evicted INTO the host tier — restore it (one
+                # fixed-width import) and keep walking
+                rb = self._restore_published(h)
+                if rb is None:
                     break
-                matched.append(self._alloc.ref(b))
+                matched.append(rb)
         cached = len(matched) * self._bs
         if cached >= n_real:                     # full-prompt hit
             cached = n_real - 1
@@ -2306,7 +3080,8 @@ class ServingEngine:
         holders)."""
         slot = self._slots[i]
         old = slot.blocks[bidx]
-        (new,) = self._alloc.alloc(1)
+        (new,) = self._alloc_with_preempt(1, exclude=(i,),
+                                          below=slot.priority + 1)
         if self._cow_exec is None:
             self._cow_exec = self._compile_cow(self._pools)
         with _quiet_donation():
@@ -2423,6 +3198,19 @@ class ServingEngine:
         if self._tables[i, 0] == 0:          # interleaved: publish the
             self._tables[i, :len(slot.blocks)] = slot.blocks   # row now
             self._tables_dev = None
+        if slot.resume is not None:
+            # recompute resume completing: the re-prefilled cache now
+            # holds EXACTLY the preempted state — restore the
+            # continuation instead of emitting (the client already
+            # holds these tokens; the stream resumes next decode tick)
+            last_token, n_emitted = slot.resume
+            slot.resume = None
+            slot.last_token = int(last_token)
+            slot.n_emitted = int(n_emitted)
+            if self._trace is not None:
+                self._trace.instant("resumed", tid=1 + i,
+                                    args={"rid": slot.rid})
+            return
         slot.last_token = tok
         slot.history.append(tok)
         self._emit(slot.rid, tok)
@@ -2503,16 +3291,38 @@ class ServingEngine:
         """Grow any slot whose next ``horizon`` write positions cross
         into unallocated blocks (covered by the admission reservation;
         speculative mode needs ``gamma + 1`` positions of headroom for
-        the verify window)."""
+        the verify window). Returns the SURVIVING active list: under
+        the watermark policy the pool may be overcommitted, so a
+        growth that finds it dry preempts the lowest
+        same-or-lower-priority victim — or, when no other candidate
+        exists, the growing slot itself (spilled + requeued; it skips
+        this tick and resumes token-exact later)."""
+        out = []
         for i in active:
             slot = self._slots[i]
+            if slot is None:        # preempted as an earlier victim
+                continue
             need = _pc.blocks_for(slot.cache_len + horizon, self._bs)
+            grown = True
             while len(slot.blocks) < need:
-                (blk,) = self._alloc.alloc(1)
+                try:
+                    (blk,) = self._alloc_with_preempt(
+                        1, exclude=(i,), below=slot.priority + 1)
+                except RuntimeError:
+                    if not self._preempt_on:
+                        raise
+                    self._preempt(i)    # self-preempt: out of options
+                    grown = False
+                    break
                 self._tables[i, len(slot.blocks)] = blk
                 slot.blocks.append(blk)
                 self._tables_dev = None
                 self._reserved -= 1
+            if grown:
+                out.append(i)
+        # a LATER slot's growth may have victimized an EARLIER
+        # survivor — keep only slots still seated
+        return [i for i in out if self._slots[i] is not None]
 
     def _trim_blocks(self, i):
         """Speculative rollback, block side: return blocks only the

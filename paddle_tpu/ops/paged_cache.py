@@ -68,7 +68,9 @@ __all__ = ["NULL_BLOCK", "BlockAllocator", "blocks_for", "init_pool",
            "pool_head_slice", "ragged_row_meta", "QuantKV",
            "kv_quantize", "kv_dequantize", "resolve_kv_cache_dtype",
            "pool_bytes", "scale_sharding", "model_fingerprint",
-           "prompt_block_hashes", "export_blocks", "import_blocks"]
+           "prompt_block_hashes", "export_blocks", "import_blocks",
+           "HostKVTier", "payload_to_host", "payload_nbytes",
+           "payload_rows", "payload_pad"]
 
 # block id 0 is never allocated: inactive slots' tables point here, so
 # their scatter/gather indices stay valid while their data is garbage
@@ -206,6 +208,13 @@ class BlockAllocator:
         self._by_hash = {}          # content hash -> block id (bijective)
         self._lru = OrderedDict()   # refcount-0 published blocks, LRU->MRU
         self.evictions = 0          # cached blocks reclaimed by alloc()
+        # eviction hook (host-DRAM KV tier): called as
+        # ``on_evict(block_id, content_hash)`` the moment ``alloc``
+        # reclaims an LRU-cached block — BEFORE the id is handed back
+        # out, so the owner of the pool bytes can still spill them to
+        # host DRAM (launches issue in host order, so a spill gather
+        # submitted here reads the block before any new write lands)
+        self.on_evict = None
 
     @property
     def free_blocks(self) -> int:
@@ -230,8 +239,11 @@ class BlockAllocator:
                 f"{self.free_blocks} free of {self.num_blocks - 1}")
         while len(self._free) < n:
             b, _ = self._lru.popitem(last=False)     # oldest first
-            self._by_hash.pop(self._hash_of.pop(b), None)
+            h = self._hash_of.pop(b)
+            self._by_hash.pop(h, None)
             self.evictions += 1
+            if self.on_evict is not None:
+                self.on_evict(b, h)
             self._free.append(b)
         out = self._free[-n:][::-1]
         del self._free[-n:]
@@ -700,6 +712,150 @@ def import_blocks(pools, block_ids, payload):
             f"has {len(pools)}")
     return [(sx(kp, kr), sx(vp, vr))
             for (kp, vp), (kr, vr) in zip(pools, payload)]
+
+
+def payload_to_host(payload):
+    """Materialize an :func:`export_blocks` payload into host DRAM:
+    every jax array becomes a numpy copy (int8 pools keep their
+    :class:`QuantKV` shell around numpy data + scale halves, so the
+    bytes stay self-contained). This is the spill half of the
+    host-DRAM KV tier — the ``np.asarray`` also blocks on the export
+    gather, so callers timing the transfer measure real bytes/s."""
+    def h(x):
+        if isinstance(x, QuantKV):
+            return QuantKV(np.asarray(x.data), np.asarray(x.scale))
+        return np.asarray(x)
+
+    return [(h(k), h(v)) for k, v in payload]
+
+
+def payload_nbytes(payload) -> int:
+    """Total bytes of an export/spill payload (int8: data + scales) —
+    the host-tier accounting unit and the swap half of the
+    recompute-vs-swap cost model."""
+    return sum(int(k.nbytes) + int(v.nbytes) for k, v in payload)
+
+
+def payload_rows(payload, n: int):
+    """First ``n`` block rows of a payload — the export executable is
+    fixed-width, so a spill of fewer blocks slices the gather down
+    before parking it in host DRAM (the tier accounts REAL bytes, not
+    the padded width)."""
+    def s(x):
+        if isinstance(x, QuantKV):
+            return QuantKV(x.data[:n], x.scale[:n])
+        return x[:n]
+
+    return [(s(k), s(v)) for k, v in payload]
+
+
+def payload_pad(payload, m: int):
+    """Zero-pad a host payload back to the fixed import width ``m``
+    (inverse of :func:`payload_rows`): pad rows ride id slots holding
+    the null block, so the import scatter discards them by
+    construction."""
+    def p(x):
+        if isinstance(x, QuantKV):
+            return QuantKV(p(x.data), p(x.scale))
+        n = x.shape[0]
+        if n == m:
+            return x
+        pad = np.zeros((m - n,) + tuple(x.shape[1:]),
+                       np.asarray(x).dtype)
+        return np.concatenate([np.asarray(x), pad], axis=0)
+
+    return [(p(k), p(v)) for k, v in payload]
+
+
+class HostKVTier:
+    """Host-DRAM block tier: an LRU byte-capacity cache of spilled KV
+    payloads (``payload_to_host`` output). Two kinds of entries share
+    it — LRU-EVICTED published blocks (keyed ``("pub", content_hash)``,
+    one block each: a prefix-cache hit that misses the device index can
+    restore the block instead of re-prefilling it) and PREEMPTED victim
+    payloads (keyed ``("victim", rid)``, the whole slot's live blocks:
+    the swap half of preemptive scheduling — a resumed request imports
+    the bytes back instead of recomputing them). The tier is pure host
+    memory (numpy buffers) and pure bookkeeping: device transfers
+    happen in the engine through the ONE fixed-width
+    ``export_blocks``/``import_blocks`` executables, so the tier adds
+    zero compiled code.
+
+    ``capacity_bytes`` bounds resident bytes; inserting past it drops
+    oldest entries first (a dropped victim payload forces that
+    request's resume onto the recompute path — correctness never
+    depends on the tier holding anything). Counters: ``spills`` /
+    ``restores`` / ``drops`` and the ``bytes_used`` gauge feed the
+    ``serving_host_tier_bytes`` telemetry."""
+
+    def __init__(self, capacity_bytes: int):
+        self.capacity = int(capacity_bytes)
+        if self.capacity <= 0:
+            raise ValueError(
+                f"HostKVTier needs a positive byte capacity, got "
+                f"{capacity_bytes!r} (0 disables the tier — pass None "
+                "to the engine instead)")
+        self._items = OrderedDict()     # key -> (payload, nbytes, meta)
+        self.bytes_used = 0
+        self.spills = 0                 # payloads accepted
+        self.restores = 0               # payloads consumed via pop()
+        self.drops = 0                  # payloads evicted / refused
+
+    def __len__(self):
+        return len(self._items)
+
+    def __contains__(self, key):
+        return key in self._items
+
+    def put(self, key, payload, nbytes: int, meta=None) -> bool:
+        """Insert (or refresh) ``key``; evicts oldest entries to fit.
+        Returns False (counted as a drop) when the payload alone
+        exceeds capacity — the caller falls back to recompute."""
+        nbytes = int(nbytes)
+        if nbytes > self.capacity:
+            self.drops += 1
+            return False
+        old = self._items.pop(key, None)
+        if old is not None:
+            self.bytes_used -= old[1]
+        while self.bytes_used + nbytes > self.capacity and self._items:
+            _, (_, nb, _) = self._items.popitem(last=False)
+            self.bytes_used -= nb
+            self.drops += 1
+        self._items[key] = (payload, nbytes, meta)
+        self.bytes_used += nbytes
+        self.spills += 1
+        return True
+
+    def get(self, key):
+        """Peek (MRU-touch) — payload or None; the entry stays
+        resident (cost-model probing must not consume it)."""
+        it = self._items.get(key)
+        if it is None:
+            return None
+        self._items.move_to_end(key)
+        return it[0]
+
+    def meta(self, key):
+        it = self._items.get(key)
+        return None if it is None else it[2]
+
+    def nbytes_of(self, key) -> int:
+        it = self._items.get(key)
+        return 0 if it is None else it[1]
+
+    def pop(self, key, restore: bool = True):
+        """Remove and return ``key``'s payload (None when absent).
+        ``restore=False`` discards without counting a restore (a
+        resumed-by-recompute request's stale victim payload, a
+        cancelled request's spill)."""
+        it = self._items.pop(key, None)
+        if it is None:
+            return None
+        self.bytes_used -= it[1]
+        if restore:
+            self.restores += 1
+        return it[0]
 
 
 def gather_dense(pool, block_tables):

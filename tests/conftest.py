@@ -66,6 +66,12 @@ import pytest
 # far under the ~9s line — no new entries. Existing serving tests pay
 # a few extra ms per compile for the kernel census (HLO text parse);
 # not measurable against the compile itself.
+# r14 re-sweep (preemptive scheduling + host-DRAM KV tier): the 21
+# new test_preemption.py tests measured ~35s total solo (slowest
+# ~4s — the TP=2 swap-resume pairing; everything else 1-3s
+# tiny-engine compiles), all far under the ~9s line — no new
+# entries. test_tracing.py's outcome-labels test was updated in
+# place (in-flight cancel now succeeds), no timing change.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s

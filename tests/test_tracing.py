@@ -362,7 +362,11 @@ def test_queue_wait_terminal_outcomes_no_survivor_bias(llama_tiny):
     with pytest.raises(ValueError, match="empty"):
         eng.submit([])                     # rejected
     eng.step()                             # admits r1 (1 slot)
-    assert eng.cancel(r1) is False         # admitted: not cancellable
+    # admitted requests ARE cancellable since the preemptive-scheduler
+    # round (slot retired mid-decode, blocks freed, partial result) —
+    # their queue-wait was already observed as "admitted"
+    assert eng.cancel(r1) is True
+    assert eng.cancel(r1) is False         # already gone
     eng.shutdown()                         # r2 still queued
     assert count("admitted") - before["admitted"] == 1
     assert count("cancelled") - before["cancelled"] == 1
@@ -370,7 +374,9 @@ def test_queue_wait_terminal_outcomes_no_survivor_bias(llama_tiny):
     assert count("shutdown") - before["shutdown"] == 1
     st = eng.stats()
     assert st["queue_wait_ms"]["count"] == 4
+    assert st["requests_cancelled"] == 1   # the in-flight cancel
     assert r2 not in eng._submit_t         # no leaked bookkeeping
+    assert r1 not in eng._submit_t
 
 
 # ------------------------------------------------------------ goodput
