@@ -790,6 +790,62 @@ def _kv_quant_bench():
     return out
 
 
+def _roofline_bench():
+    """Per-tick roofline attribution (ISSUE 15): serve a short mixed
+    workload and read ``stats()['roofline']`` — every executable's
+    cost-model FLOPs / HBM bytes fused with the measured per-tick
+    step time into live MFU, HBM-bandwidth utilization and a
+    compute-vs-bandwidth-bound classification. On CPU the chip peaks
+    are nominal constants (``cpu_proxy``) — this block exists so the
+    real-TPU bench round lands with its attribution harness already
+    wired: the summary keys ``step_mfu``/``hbm_bw_util`` are
+    trajectory-asserted every round."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_ROOF_VOCAB", 32000)),
+        hidden_size=int(os.environ.get("BENCH_ROOF_HIDDEN", 1024)),
+        intermediate_size=int(os.environ.get("BENCH_ROOF_FFN", 2816)),
+        num_hidden_layers=int(os.environ.get("BENCH_ROOF_LAYERS", 4)),
+        num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=1024, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        num_slots=int(os.environ.get("BENCH_ROOF_SLOTS", 4)),
+        block_size=32, max_model_len=512))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (n,))
+               for n in (32, 64, 48, 96)]
+    eng.serve(prompts,
+              max_new_tokens=int(os.environ.get("BENCH_ROOF_NEW",
+                                                16)))
+    roof = eng.stats()["roofline"]
+    eng.shutdown()
+    tick = roof["tick_executable"]
+    out = {
+        "step_mfu": roof["step_mfu"],
+        "hbm_bw_util": roof["step_hbm_bw_util"],
+        "tick_executable": tick,
+        "bound": roof["per_executable"].get(tick, {}).get("bound"),
+        "ridge_flops_per_byte": roof["ridge_flops_per_byte"],
+        "peak_flops_per_s": roof["peak_flops_per_s"],
+        "peak_hbm_bytes_per_s": roof["peak_hbm_bytes_per_s"],
+        "per_executable": roof["per_executable"],
+        "cpu_proxy": roof["cpu_proxy"]
+        or jax.default_backend() != "tpu",
+    }
+    del model, eng
+    gc.collect()
+    return out
+
+
 def _goodput_bench():
     """Goodput under SLO (the ISSUE-11 observability bar): the
     serving-bench model driven by the closed-loop load harness
@@ -2121,6 +2177,10 @@ def main():
     except Exception as exc:
         goodput = {"error": repr(exc)}
     try:
+        roofline = _roofline_bench()
+    except Exception as exc:
+        roofline = {"error": repr(exc)}
+    try:
         cluster = _cluster_bench()
     except Exception as exc:
         cluster = {"error": repr(exc)}
@@ -2152,6 +2212,7 @@ def main():
               "serving_ragged": serving_ragged,
               "kv_quant": kv_quant,
               "goodput": goodput,
+              "roofline": roofline,
               "cluster": cluster,
               "fusion": fusion,
               "preempt": preempt,
@@ -2173,8 +2234,9 @@ def main():
             if k not in ("decode", "serving", "speculative",
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
-                         "cluster", "fusion", "preempt", "flashmask",
-                         "moe_profile", "moe_fused", "moe_serving")
+                         "roofline", "cluster", "fusion", "preempt",
+                         "flashmask", "moe_profile", "moe_fused",
+                         "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -2264,6 +2326,15 @@ def main():
              "itl_p99_ms":
              goodput.get("itl_p99_ms")
              if isinstance(goodput, dict) else None,
+             "step_mfu":
+             roofline.get("step_mfu")
+             if isinstance(roofline, dict) else None,
+             "hbm_bw_util":
+             roofline.get("hbm_bw_util")
+             if isinstance(roofline, dict) else None,
+             "roofline_cpu_proxy":
+             roofline.get("cpu_proxy")
+             if isinstance(roofline, dict) else None,
              "cluster_tokens_per_sec":
              cluster.get("two_replicas", {}).get(
                  "aggregate_tokens_per_sec")
@@ -2305,7 +2376,8 @@ def main():
               "cluster_ttft_p99_ms", "cluster_affinity_hit_rate",
               "fusion_tokens_per_sec", "fusion_speedup",
               "kernels_per_tick_ratio", "preempt_goodput_delta",
-              "preempt_ttft_p99_ms", "kv_blocks_spilled"):
+              "preempt_ttft_p99_ms", "kv_blocks_spilled",
+              "step_mfu", "hbm_bw_util", "roofline_cpu_proxy"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

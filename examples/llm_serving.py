@@ -437,6 +437,51 @@ def main(argv=None):
           f"({st12['preempt_swap_resumes']} swap, "
           f"{st12['preempt_recompute_resumes']} recompute resumes); "
           f"resumed stream token-exact vs never-preempted")
+
+    # ---- 13. Fleet flight recorder: one merged Perfetto trace +
+    # per-tick roofline attribution. A disaggregated cluster (1
+    # prefill + 1 decode replica) serves a few requests; the merged
+    # trace shows one pid per replica, the router lane, and each
+    # request's prefill -> handoff (flow arrow) -> decode spans under
+    # ONE cluster-global rid; stats()['roofline'] attributes where
+    # each tick's time went (MFU / HBM-BW per executable).
+    from paddle_tpu.inference.cluster import (ClusterConfig,
+                                              EngineCluster)
+    cl = EngineCluster(
+        model, ClusterConfig(num_replicas=1, prefill_replicas=1),
+        ServingConfig(num_slots=2, block_size=8, max_model_len=96,
+                      prefill_chunk=16))
+    rids13 = [cl.submit(p, 5) for p in prompts]
+    done13 = cl.run()
+    assert sorted(done13) == sorted(rids13)
+    doc = cl.export_trace()
+    procs = {e["pid"]: e["args"]["name"]
+             for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert {"replica0:decode", "replica1:prefill",
+            "EngineCluster"} <= set(procs.values())
+    flows_s = {e["id"] for e in doc["traceEvents"]
+               if e.get("ph") == "s"}
+    flows_f = {e["id"] for e in doc["traceEvents"]
+               if e.get("ph") == "f"}
+    assert flows_s and flows_s == flows_f, \
+        "every handoff flow start must resolve to a finish"
+    g = rids13[0]
+    req_pids = {e["pid"] for e in doc["traceEvents"]
+                if e.get("name") == f"req{g}" and e.get("ph") == "X"}
+    assert len(req_pids) == 2, \
+        "one global rid must span prefill AND decode pids"
+    roof = cl.stats()["roofline"]
+    assert roof["step_mfu"] > 0 and roof["step_hbm_bw_util"] > 0
+    with tempfile.TemporaryDirectory() as d13:
+        cl.export_trace(os.path.join(d13, "fleet.json"))
+    cl.shutdown()
+    print(f"flight recorder: merged trace spans {len(procs)} pids, "
+          f"{len(flows_s)} handoff flow links resolved, req{g} "
+          f"end-to-end across 2 replicas; roofline step_mfu "
+          f"{roof['step_mfu']:.4f}, hbm_bw_util "
+          f"{roof['step_hbm_bw_util']:.4f} "
+          f"(cpu_proxy={roof['cpu_proxy']})")
     return n_ok / 12.0, losses
 
 

@@ -81,7 +81,9 @@ See docs/OPS.md "Engine replication & disaggregated prefill".
 """
 from __future__ import annotations
 
+import json
 import os
+import re
 import time
 import warnings
 from dataclasses import dataclass, replace as _dc_replace
@@ -90,6 +92,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import monitor
+from ..monitor import tracing as _tracing
 from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
 from .serving import (PrefilledRequest, QueueShedError, ServingConfig,
@@ -295,6 +298,30 @@ class EngineCluster:
         self._d_ttft = LatencyDigest()
         self._d_itl = LatencyDigest()
         self._d_e2e = LatencyDigest()
+        # -- fleet flight recorder (ISSUE 15) -------------------------
+        # the cluster's OWN trace lane (router decisions, handoff
+        # placements, cluster ticks) plus a (engine, local rid) ->
+        # global rid history: the live _l2g map pops entries on
+        # completion, but export_trace() must rewrite EVERY buffered
+        # span — including retired requests' — to the cluster-global
+        # id namespace. The history is populated only while tracing
+        # (under PADDLE_TPU_TRACE=0 it would be dead weight) and is
+        # FIFO-bounded: each ring holds at most `capacity` events, so
+        # rids older than every ring's reach can never need rewriting
+        # — one cap'd dict, not unbounded growth on a long-lived
+        # fleet.
+        self._l2g_hist: Dict[tuple, int] = {}
+        self._trace = None
+        if _tracing.tracing_enabled():
+            tr = _tracing.Tracer("EngineCluster")
+            tr.set_thread(0, "router")
+            self._trace = tr
+        self._hist_cap = (len(self._engines) + 1) \
+            * _tracing.trace_buffer_capacity()
+        # one bounded jax.profiler window around the next N CLUSTER
+        # ticks (each replica's work runs inside the cluster tick, so
+        # one process-wide capture covers the fleet)
+        self._prof = _tracing.ProfilerWindow()
         self._m_affinity = monitor.counter(
             "serving_router_affinity_hits",
             "requests the cluster router placed on a replica already "
@@ -430,7 +457,14 @@ class EngineCluster:
         """One cluster tick: advance every prefill engine and stream
         its finished prompts' KV blocks into decode replicas, then
         advance every decode replica. Returns this tick's
-        ``[(request_id, token), ...]`` across the whole cluster."""
+        ``[(request_id, token), ...]`` across the whole cluster. An
+        armed profiling window (``profile(n_ticks)``) brackets the
+        whole cluster tick."""
+        with self._prof.tick():
+            return self._step_impl()
+
+    def _step_impl(self) -> List[tuple]:
+        t0 = time.monotonic()
         self._tick_buf = []
         for i in list(self._prefill_idx):
             if i in self._failed:
@@ -449,6 +483,12 @@ class EngineCluster:
             if eng.num_queued or eng.num_active:
                 self._safe_step(i)
         self._collect_done()
+        if self._trace is not None:
+            self._trace.emit(
+                "cluster tick", tid=0, t0=t0,
+                args={"pending_handoffs": len(self._pending),
+                      "emitted": len(self._tick_buf),
+                      "failed": len(self._failed)})
         return self._tick_buf
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -518,12 +558,116 @@ class EngineCluster:
                     "terminating with the tokens already streamed")
                 self._finish(g)
 
+    def owner_of(self, request_id: int) -> Optional[Tuple[int, int]]:
+        """Current ``(replica_index, local_rid)`` of a LIVE request,
+        or None once it finished — the loadgen record export stamps
+        its NDJSON rows with this so offline analysis can join them
+        against the merged trace's per-replica pids."""
+        return self._owner.get(request_id)
+
+    def profile(self, n_ticks: int, path: Optional[str] = None):
+        """Arm ONE bounded ``jax.profiler`` capture around the next
+        ``n_ticks`` CLUSTER ticks — every replica's executables run
+        inside the cluster tick, so one process-wide capture covers
+        the fleet (jax allows a single live profiler session; this is
+        the cluster-forwarded form of ``ServingEngine.profile``).
+        ``path`` defaults to ``$PADDLE_TPU_PROFILE_DIR``; returns the
+        capture dir, or None under ``PADDLE_TPU_TRACE=0``."""
+        return self._prof.arm(n_ticks, path)
+
+    def _hist_put(self, key, g):
+        """Record one (replica, local rid) -> global rid mapping for
+        the trace rewrite. No-op when tracing is disabled (nothing
+        will ever be exported); FIFO-pruned past ``_hist_cap`` (an
+        rid older than every ring buffer's reach cannot appear in any
+        buffered span, so its mapping is dead)."""
+        if self._trace is None:
+            return
+        h = self._l2g_hist
+        h[key] = g
+        if len(h) > self._hist_cap:
+            # dicts iterate in insertion order: drop the oldest (one
+            # insert can only overflow by one)
+            h.pop(next(iter(h)))
+
+    # request-span names the trace rewrite maps into the global id
+    # namespace: "req<rid>" and "req<rid> queued"
+    _REQ_NAME = re.compile(r"^req(\d+)(\s.*)?$")
+
+    def export_trace(self, path: Optional[str] = None):
+        """Merge the router's and EVERY replica's span ring buffers
+        into ONE Chrome/Perfetto trace: each replica keeps its own
+        pid lane (process names rewritten to ``replica<i>:<role>``),
+        the cluster's router lane rides alongside, and every request
+        id — span names like ``req3`` AND ``rid`` args — is rewritten
+        to the CLUSTER-global id, so a disaggregated request's route
+        decision, prefill chunks, handoff flow arrow, decode ticks
+        and preempt/resume marks line up under one rid end-to-end.
+        Returns the trace dict when ``path`` is None, else writes the
+        JSON and returns ``path``; None when tracing is disabled
+        (``PADDLE_TPU_TRACE=0`` — the recorder is inert)."""
+        if self._trace is None:
+            return None
+        events = list(self._trace.chrome_events())
+        for idx, eng in enumerate(self._engines):
+            tr = eng.tracer
+            if tr is None:          # pragma: no cover - mixed switch
+                continue
+            events.extend(
+                self._rewrite_events(idx, eng, tr.chrome_events()))
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is None:
+            return doc
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+        return path
+
+    def _rewrite_events(self, idx, eng, evs):
+        """One replica's Chrome events, mapped into the cluster
+        namespace: request ids -> global ids (span names and args),
+        process name -> ``replica<i>:<role>``. Events whose local rid
+        never passed through this cluster (none, in practice) keep
+        their local id rather than guessing."""
+        out = []
+        for ev in evs:
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    ev = dict(ev, args={
+                        "name": f"replica{idx}:{eng._role}"})
+                out.append(ev)
+                continue
+            name = ev.get("name", "")
+            args = ev.get("args")
+            new = None
+            m = self._REQ_NAME.match(name)
+            if m is not None:
+                g = self._l2g_hist.get((idx, int(m.group(1))))
+                if g is not None:
+                    new = dict(ev,
+                               name=f"req{g}{m.group(2) or ''}")
+            if args and "rid" in args:
+                g = self._l2g_hist.get((idx, args["rid"]))
+                if g is not None:
+                    new = dict(new if new is not None else ev)
+                    new["args"] = dict(args, rid=g)
+            out.append(new if new is not None else ev)
+        return out
+
     def stats(self) -> dict:
         """Cluster-aggregate snapshot: per-replica ``stats()`` dicts
         under ``replicas`` plus rolled-up routing / transfer /
         throughput / latency keys (the client-side view across the
         whole cluster — the goodput harness's denominators)."""
         reps = [e.stats() for e in self._engines]
+        # headline roofline roll-up: the busiest replica's numbers as
+        # a PAIR from that ONE replica — a per-metric max could
+        # combine an MFU and a bandwidth figure no single replica
+        # exhibits, which is useless for bound classification
+        busy = max(range(len(reps)), key=lambda i: (
+            reps[i]["roofline"]["step_mfu"],
+            reps[i]["roofline"]["step_hbm_bw_util"]))
         return {
             "num_replicas": len(self._decode_idx),
             "prefill_replicas": len(self._prefill_idx),
@@ -557,6 +701,23 @@ class EngineCluster:
             "ttft_ms": self._d_ttft.summary(),
             "itl_ms": self._d_itl.summary(),
             "e2e_ms": self._d_e2e.summary(),
+            # fleet flight recorder (ISSUE 15): ALWAYS present —
+            # killed/idle clusters report False/0 so dashboards never
+            # KeyError across a rolled-back fleet
+            "tracing": self._trace is not None,
+            "trace_events_dropped":
+                (self._trace.dropped
+                 if self._trace is not None else 0)
+                + sum(r["trace_events_dropped"] for r in reps),
+            "profile_captures": self._prof.captures,
+            "roofline": {
+                "cpu_proxy": any(r["roofline"]["cpu_proxy"]
+                                 for r in reps),
+                "busiest_replica": busy,
+                "step_mfu": reps[busy]["roofline"]["step_mfu"],
+                "step_hbm_bw_util":
+                    reps[busy]["roofline"]["step_hbm_bw_util"],
+            },
             "replicas": reps,
         }
 
@@ -642,6 +803,16 @@ class EngineCluster:
             self._m_affinity.inc()
         self._l2g[(idx, lrid)] = g
         self._owner[g] = (idx, lrid)
+        self._hist_put((idx, lrid), g)
+        if self._trace is not None:
+            # router-decision span: which replica won, on how much
+            # published-prefix overlap, against which queue depths
+            self._trace.instant(
+                "route", tid=0,
+                args={"rid": g, "replica": idx,
+                      "overlap": int(overlap),
+                      "depths": {str(i): float(d)
+                                 for i, d in depths.items()}})
 
     def _place_handoffs(self):
         """Import pending prefilled requests into decode replicas,
@@ -679,6 +850,12 @@ class EngineCluster:
                     self._l2g.pop((src, rec.request_id), None)
                     self._l2g[(i, drid)] = g
                     self._owner[g] = (i, drid)
+                    self._hist_put((i, drid), g)
+                    if self._trace is not None:
+                        self._trace.instant(
+                            "handoff placed", tid=0,
+                            args={"rid": g, "src": src, "dst": i,
+                                  "blocks": rec.n_blocks})
                     placed = True
                     break
             if not placed:

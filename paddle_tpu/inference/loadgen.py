@@ -32,6 +32,8 @@ within digest error plus callback overhead.
 """
 from __future__ import annotations
 
+import json
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -40,7 +42,7 @@ import numpy as np
 
 __all__ = ["SLO", "RequestRecord", "poisson_arrivals",
            "uniform_arrivals", "run_load", "summarize",
-           "conversation_workload"]
+           "conversation_workload", "write_records"]
 
 
 @dataclass
@@ -60,6 +62,9 @@ class RequestRecord:
     token_t: List[float] = field(default_factory=list)
     priority: int = 0                   # scheduling class (preemptive
     #                                     engines; 0 = default class)
+    # replica index the cluster router placed the request on (from
+    # EngineCluster.owner_of at submit time); None for a plain engine
+    replica: Optional[int] = None
 
     @property
     def completed(self) -> bool:
@@ -140,6 +145,7 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
              max_new_tokens: Optional[int] = None,
              slo: Optional[SLO] = None, arrival: str = "poisson",
              priorities: Optional[Sequence[int]] = None,
+             record_path: Optional[str] = None,
              seed: int = 0) -> dict:
     """Serve ``prompts`` through ``engine`` — a ``ServingEngine`` OR
     any object with the same ``submit/step/num_queued/num_active/
@@ -160,6 +166,13 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     the report gains a ``by_priority`` breakdown (per-class goodput /
     TTFT / TPOT, each class its own SLO denominator).
 
+    ``record_path`` (ISSUE 15 satellite) additionally writes ONE
+    NDJSON row per request (:func:`write_records`: submit /
+    first-token / last-token monotonic timestamps, priority, outcome,
+    routed replica) so offline analysis can join load-gen records
+    against the cluster's merged trace — the trace's ``ts`` values
+    are the same ``time.monotonic()`` base in integer microseconds.
+
     The target's ``stream_callback`` is chained, not replaced: an
     application callback installed at construction still fires.
     """
@@ -175,14 +188,18 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     n = len(prompts)
     records: Dict[int, RequestRecord] = {}
 
+    owner_of = getattr(engine, "owner_of", None)
+
     def _submit(idx, arrival_s):
         kw = {} if priorities is None \
             else {"priority": int(priorities[idx])}
         rid = engine.submit(prompts[idx], max_new_tokens, **kw)
+        owner = owner_of(rid) if owner_of is not None else None
         records[rid] = RequestRecord(
             rid, float(arrival_s), time.monotonic(),
             priority=0 if priorities is None
-            else int(priorities[idx]))
+            else int(priorities[idx]),
+            replica=owner[0] if owner is not None else None)
         return rid
 
     prev_cb = engine._stream
@@ -239,8 +256,47 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
 
     offered = float(qps) if mode == "open" else \
         (n / wall if wall > 0 else 0.0)
-    return summarize(list(records.values()), slo, wall,
-                     offered_qps=offered, mode=mode)
+    report = summarize(list(records.values()), slo, wall,
+                       offered_qps=offered, mode=mode)
+    if record_path is not None:
+        report["record_path"] = write_records(records.values(),
+                                              record_path)
+    return report
+
+
+def write_records(records, path: str) -> str:
+    """One NDJSON row per request (ISSUE 15 satellite): submit /
+    first-token / last-token timestamps (``time.monotonic()``
+    seconds — the SAME clock base the span tracer exports, whose
+    Chrome ``ts`` is monotonic microseconds, so rows join against a
+    merged trace by rid + time), priority, routed replica and
+    outcome. Returns ``path``."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        for r in sorted(records, key=lambda r: r.rid):
+            row = {
+                "rid": r.rid,
+                "priority": r.priority,
+                "replica": r.replica,
+                "arrival_s": round(float(r.arrival_s), 6),
+                "submit_t_s": r.submit_t,
+                "first_token_t_s": r.token_t[0] if r.token_t
+                else None,
+                "last_token_t_s": r.token_t[-1] if r.token_t
+                else None,
+                "n_tokens": len(r.token_t),
+                "ttft_ms": round(r.ttft_ms, 3) if r.completed
+                else None,
+                "tpot_ms": round(r.tpot_ms, 3) if r.completed
+                else None,
+                "e2e_ms": round(r.e2e_ms, 3) if r.completed
+                else None,
+                "outcome": "completed" if r.completed
+                else "no_tokens",
+            }
+            f.write(json.dumps(row) + "\n")
+    return path
 
 
 def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,

@@ -492,6 +492,11 @@ class PrefilledRequest:
     # replica's preemptive scheduler sees the same priority the
     # prefill tier admitted under
     priority: int = 0
+    # trace flow-link id (monitor/tracing.next_flow_id): the export
+    # side records the flow start, the import side the finish, so a
+    # merged trace draws the handoff as an arrow between the two
+    # replicas' request spans. None when tracing is disabled.
+    flow_id: Optional[int] = None
 
 
 class _Slot:
@@ -989,6 +994,37 @@ class ServingEngine:
             "kernel count of the engine's compiled tick executable "
             "(optimized-HLO entry instructions — fusions, dots, "
             "custom calls; the decode-tick fusion headline metric)")
+        # -- per-tick roofline attribution (ISSUE 15 layer 2) ---------
+        # static half: every _aot_compile captures the executable's
+        # cost_analysis FLOPs + bytes accessed (the "Operator Fusion
+        # in XLA" accounting, live); measured half: each step path
+        # clocks its launch->sync wall time into a per-executable EMA.
+        # Fused they give per-executable MFU, HBM-bandwidth
+        # utilization and a compute-vs-bandwidth-bound classification
+        # (stats()['roofline']). Pure host accounting, independent of
+        # the trace kill switch — like the SLO digests.
+        self._exec_cost = {}        # exec name -> cost_analysis dict
+        self._step_time = {}        # exec name -> wall-seconds EMA
+        self._step_ticks = {}       # exec name -> timed launches
+        self._peak_flops = monitor.device_peak_flops()
+        self._peak_hbm_bw = monitor.device_peak_hbm_bw()
+        self._ridge = self._peak_flops / self._peak_hbm_bw
+        self._cpu_proxy = jax.default_backend() != "tpu"
+        self._m_mfu = monitor.gauge(
+            "serving_step_mfu",
+            "per-tick model FLOPs utilization of the tick executable "
+            "(cost_analysis FLOPs / measured launch->sync time / chip "
+            "peak FLOPs; nominal peaks off-TPU — cpu_proxy)")
+        self._m_bw_util = monitor.gauge(
+            "serving_hbm_bw_util",
+            "per-tick HBM-bandwidth utilization of the tick "
+            "executable (cost_analysis bytes accessed / measured "
+            "launch->sync time / chip peak HBM bytes/s; nominal "
+            "peaks off-TPU — cpu_proxy)")
+        # -- on-demand profiling windows (ISSUE 15 layer 3) -----------
+        # profile(n_ticks) arms a bounded jax.profiler capture around
+        # the next N ticks; PADDLE_TPU_TRACE=0 keeps it inert
+        self._prof = _tracing.ProfilerWindow()
         # MoE routing telemetry: per-expert load fractions + routing
         # entropy of every dispatch the engine's executables run,
         # observed at DECODE time through the trace-armed tap in
@@ -1310,7 +1346,14 @@ class ServingEngine:
         finished sequences. Returns this tick's
         ``[(request_id, token), ...]`` (admission prefills included).
         On the default ragged path one tick is ONE executable launch
-        covering decode + verify + prefill rows together."""
+        covering decode + verify + prefill rows together. An armed
+        profiling window (``profile(n_ticks)``) brackets the tick —
+        the capture starts before the first armed tick and stops
+        after the last, bounding the profile to exactly N ticks."""
+        with self._prof.tick():
+            return self._step_dispatch()
+
+    def _step_dispatch(self) -> List[tuple]:
         if self._ragged:
             return self._step_ragged()
         if self._gamma:
@@ -1352,6 +1395,7 @@ class ServingEngine:
 
         self._m_steps.inc()
         self._n_decode_steps += 1
+        self._note_step_time("decode", t_sync - t_l0)
         if self._mesh is not None:
             self._m_tp_bytes.inc(self._tp_step_bytes)
             self._n_tp_bytes += self._tp_step_bytes
@@ -1452,6 +1496,9 @@ class ServingEngine:
 
         self._m_steps.inc()
         self._n_decode_steps += 1
+        # the draft loop (if any) shares the window — the verify row
+        # is conservatively charged the whole draft+verify interval
+        self._note_step_time("verify", t_sync - t_l0)
         if self._mesh is not None:
             self._m_tp_bytes.inc(self._tp_step_bytes)
             self._n_tp_bytes += self._tp_step_bytes
@@ -1732,6 +1779,8 @@ class ServingEngine:
                     self._n_spec_accepted / self._n_spec_proposed)
 
         # -- commit prefill progress -----------------------------------
+        self._note_step_time("verify" if g else "decode",
+                             t_sync - t_l0)
         if given:
             # cost-model input: rows prefilled this launch / wall time
             self._note_prefill_rate(sum(given.values()),
@@ -1903,6 +1952,19 @@ class ServingEngine:
             "tracing": self._trace is not None,
             "trace_events": len(self._trace)
             if self._trace is not None else 0,
+            # ring-wrap loss accounting (ISSUE 15 satellite): events
+            # the bounded PADDLE_TPU_TRACE_EVENTS ring overwrote —
+            # the observer is no longer unobservable (0 when killed)
+            "trace_events_dropped": self._trace.dropped
+            if self._trace is not None else 0,
+            # on-demand profiling windows: completed captures +
+            # ticks left in an armed window (both 0 when idle/killed)
+            "profile_captures": self._prof.captures,
+            "profile_ticks_remaining": self._prof.pending,
+            # per-tick roofline attribution (always present — an
+            # un-ticked engine reports zeros; cpu_proxy flags
+            # nominal off-TPU peaks)
+            "roofline": self._roofline(),
             "ttft_ms": self._d_ttft.summary(),
             "itl_ms": self._d_itl.summary(),
             "queue_wait_ms": self._d_queue.summary(),
@@ -1990,13 +2052,25 @@ class ServingEngine:
             self._n_handoffs += 1
             self._n_blocks_exported += len(slot.blocks)
             samp = self._slot_samp[i]
+            fid = None
+            if self._trace is not None:
+                # flow START on the exporting slot: the matching
+                # finish lands wherever admit_prefilled seats the
+                # payload, so the merged trace draws the handoff as
+                # an arrow across the two replicas' lanes
+                fid = _tracing.next_flow_id()
+                self._trace.flow(
+                    "kv handoff", tid=1 + i, flow_id=fid, phase="s",
+                    args={"rid": slot.rid,
+                          "blocks": len(slot.blocks)})
             out.append(PrefilledRequest(
                 request_id=slot.rid, prompt=slot.prompt,
                 first_token=int(slot.last_token),
                 max_new_tokens=slot.max_new,
                 n_blocks=len(slot.blocks), payload=payload,
                 temperature=float(samp[0]), top_k=float(samp[1]),
-                top_p=float(samp[2]), priority=int(slot.priority)))
+                top_p=float(samp[2]), priority=int(slot.priority),
+                flow_id=fid))
             self._release_handoff(i)
         self._handoff_ready = []
         return out
@@ -2073,6 +2147,11 @@ class ServingEngine:
                 "admit_prefilled", tid=1 + i,
                 args={"rid": rid, "blocks": init,
                       "prompt_tokens": n_real})
+            fid = getattr(prefilled, "flow_id", None)
+            if fid:
+                self._trace.flow("kv handoff", tid=1 + i,
+                                 flow_id=int(fid), phase="f",
+                                 args={"rid": rid})
         return rid
 
     def _release_handoff(self, i):
@@ -2355,6 +2434,12 @@ class ServingEngine:
                     exec_ = jitted.lower(*args).compile()
                     kc = monitor.kernel_census(compiled=exec_)
                 self._kcensus[name] = kc
+                # roofline static half: the executable's cost-model
+                # FLOPs + HBM bytes (per-tick MFU / bandwidth
+                # utilization divide these by the measured step time)
+                cost = monitor.executable_cost(exec_)
+                if cost:
+                    self._exec_cost[name] = cost
                 if name in ("decode", "verify"):
                     # THE tick executable: the headline fusion metric
                     self._m_kernels.set(kc.get("hlo_kernels", 0))
@@ -3149,6 +3234,11 @@ class ServingEngine:
                     self._dpools = self._draft_chunk_exec(
                         self._dparams, ids_dev, self._dpools,
                         table_dev, pos)
+            # roofline sample for the chunk executable (wall clock
+            # around the launch — on async backends only the final
+            # chunk's first-token materialization syncs, so off-TPU
+            # treat the chunk row as structure, like every cpu_proxy)
+            self._note_step_time("chunk", time.monotonic() - t_c0)
             if self._trace is not None:
                 self._trace.emit(
                     f"prefill chunk[{slot.pend_pos // c}]",
@@ -3220,6 +3310,83 @@ class ServingEngine:
         elif self._role == "prefill":
             slot.handoff = True
             self._handoff_ready.append(i)
+
+    def _note_step_time(self, name, dt):
+        """Measured half of the roofline: one launch->sync wall-time
+        sample for executable ``name``, folded into a per-executable
+        EMA (so the estimate tracks the live batch mix, like the
+        preemption cost model's rates). The tick executable's sample
+        also refreshes the ``serving_step_mfu`` /
+        ``serving_hbm_bw_util`` gauges."""
+        if dt <= 0.0:
+            return
+        ema = self._step_time.get(name)
+        self._step_time[name] = dt if ema is None \
+            else 0.7 * ema + 0.3 * dt
+        self._step_ticks[name] = self._step_ticks.get(name, 0) + 1
+        if name == ("verify" if self._gamma else "decode"):
+            cost = self._exec_cost.get(name)
+            if cost:
+                if cost.get("flops"):
+                    self._m_mfu.set(
+                        cost["flops"] / dt / self._peak_flops)
+                if cost.get("bytes_accessed"):
+                    self._m_bw_util.set(
+                        cost["bytes_accessed"] / dt
+                        / self._peak_hbm_bw)
+
+    def _roofline(self) -> dict:
+        """Live per-executable roofline attribution (the
+        ``stats()['roofline']`` block): the XLA cost model's FLOPs /
+        HBM bytes of every executable this engine compiled, fused
+        with the measured per-tick step-time EMA into MFU and
+        HBM-bandwidth utilization. ``bound`` classifies each
+        executable against the chip's ridge point (peak FLOPs / peak
+        HBM bytes/s — arithmetic intensity below it means the
+        executable saturates bandwidth before compute). Off TPU the
+        chip peaks are nominal constants: read every number as
+        structure, not truth (``cpu_proxy``)."""
+        per = {}
+        for name, cost in self._exec_cost.items():
+            f = float(cost.get("flops", 0.0) or 0.0)
+            b = float(cost.get("bytes_accessed", 0.0) or 0.0)
+            ai = (f / b) if b else 0.0
+            dt = self._step_time.get(name)
+            per[name] = {
+                "flops": f, "bytes_accessed": b,
+                "arithmetic_intensity": round(ai, 4),
+                "bound": "compute" if ai >= self._ridge
+                else "bandwidth",
+                "ticks": self._step_ticks.get(name, 0),
+                "step_time_ms": round(1000.0 * dt, 4)
+                if dt is not None else None,
+                "mfu": round(f / dt / self._peak_flops, 6)
+                if dt and f else 0.0,
+                "hbm_bw_util": round(b / dt / self._peak_hbm_bw, 6)
+                if dt and b else 0.0,
+            }
+        tick = "verify" if self._gamma else "decode"
+        t = per.get(tick, {})
+        return {"cpu_proxy": self._cpu_proxy,
+                "tick_executable": tick,
+                "step_mfu": t.get("mfu", 0.0),
+                "step_hbm_bw_util": t.get("hbm_bw_util", 0.0),
+                "peak_flops_per_s": self._peak_flops,
+                "peak_hbm_bytes_per_s": self._peak_hbm_bw,
+                "ridge_flops_per_byte": round(self._ridge, 4),
+                "per_executable": per}
+
+    def profile(self, n_ticks: int, path: Optional[str] = None):
+        """Arm a BOUNDED ``jax.profiler`` capture around the next
+        ``n_ticks`` engine ticks (ISSUE 15 layer 3): the capture
+        starts before the next tick and stops after the Nth, so an
+        operator can grab a device-level profile of a live engine
+        without an always-on tracer. ``path`` defaults to
+        ``$PADDLE_TPU_PROFILE_DIR``. Returns the capture dir, or
+        None under the ``PADDLE_TPU_TRACE=0`` kill switch (the whole
+        flight recorder is inert there). Raises while a window is
+        already armed (jax allows one live capture per process)."""
+        return self._prof.arm(n_ticks, path)
 
     def _note_kv_read(self, positions):
         """Analytic KV HBM traffic of one tick: ``positions`` cache

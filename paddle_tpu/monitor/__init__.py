@@ -35,11 +35,13 @@ from .registry import (Counter, Gauge, Histogram, Info, Registry,
                        get_registry, metrics_dir, metrics_enabled,
                        prometheus_path)
 from .accounting import (analytic_mfu, collective_census,
-                         device_peak_flops, kernel_census,
+                         device_peak_flops, device_peak_hbm_bw,
+                         executable_cost, kernel_census,
                          record_compiled_step, sample_device_memory,
                          step_report, step_reports)
 from .digest import LatencyDigest, P2Quantile
-from .tracing import Tracer, tracing_enabled
+from .tracing import (ProfilerWindow, Tracer, next_flow_id,
+                      tracing_enabled)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Info", "Registry",
@@ -48,9 +50,11 @@ __all__ = [
     "export_jsonl", "report", "reset",
     "prometheus_dump", "prometheus_path",
     "LatencyDigest", "P2Quantile", "Tracer", "tracing_enabled",
+    "ProfilerWindow", "next_flow_id",
     "record_compiled_step", "collective_census", "kernel_census",
     "step_report", "step_reports", "sample_device_memory",
-    "analytic_mfu", "device_peak_flops",
+    "analytic_mfu", "device_peak_flops", "device_peak_hbm_bw",
+    "executable_cost",
 ]
 
 

@@ -32,7 +32,8 @@ from .registry import get_registry
 __all__ = ["record_compiled_step", "collective_census",
            "kernel_census", "step_report", "step_reports",
            "sample_device_memory", "analytic_mfu",
-           "device_peak_flops"]
+           "device_peak_flops", "device_peak_hbm_bw",
+           "executable_cost"]
 
 # jaxpr primitive -> census op family
 _COLLECTIVE_PRIMS = {
@@ -344,6 +345,46 @@ def device_peak_flops() -> float:
     if getattr(dev, "platform", "") == "cpu":
         return 1e12
     return 197e12
+
+
+def device_peak_hbm_bw() -> float:
+    """Peak HBM bytes/s of the local chip — the roofline's bandwidth
+    ceiling, paired with :func:`device_peak_flops` (their ratio is the
+    ridge point in FLOPs/byte). CPU returns a nominal 100 GB/s so
+    bandwidth utilization stays defined; consumers flag such numbers
+    ``cpu_proxy`` exactly like the MFU table."""
+    import jax
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return 1e11
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 2.765e12
+    if "v4" in kind:
+        return 1.2e12
+    if "v6" in kind:
+        return 1.64e12
+    if "v5" in kind or "lite" in kind:
+        return 8.1e11
+    if getattr(dev, "platform", "") == "cpu":
+        return 1e11
+    return 8.1e11
+
+
+def executable_cost(compiled) -> dict:
+    """XLA cost-model inputs of ONE compiled executable, merged:
+    ``cost_analysis()`` FLOPs + bytes accessed plus the
+    ``memory_analysis()`` fields (under ``"memory"``, incl.
+    ``peak_hbm_bytes``). The static half of the per-tick roofline
+    attribution — divide by a measured step time for live MFU /
+    HBM-bandwidth utilization. {} when the backend exposes neither
+    analysis (the caller then simply has no roofline row)."""
+    out = dict(_cost_dict(compiled))
+    mem = _memory_dict(compiled)
+    if mem:
+        out["memory"] = mem
+    return out
 
 
 def analytic_mfu(name: str, step_time_s: float,

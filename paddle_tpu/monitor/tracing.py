@@ -38,20 +38,35 @@ import json
 import os
 import threading
 import time
+import warnings
 import weakref
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from .registry import get_registry
+
 __all__ = ["Tracer", "tracing_enabled", "trace_buffer_capacity",
-           "live_tracers", "dump_chrome_trace"]
+           "live_tracers", "dump_chrome_trace", "next_flow_id",
+           "ProfilerWindow"]
 
 _TRACE_ENV = "PADDLE_TPU_TRACE"
 _CAP_ENV = "PADDLE_TPU_TRACE_EVENTS"
+_PROFILE_DIR_ENV = "PADDLE_TPU_PROFILE_DIR"
 
 _PIDS = itertools.count(1)
+# flow (arrow) ids are PROCESS-unique so a link's two ends — possibly
+# recorded by different tracers (the disaggregated handoff's export on
+# the prefill engine, import on the decode replica) — resolve in the
+# merged trace no matter which engines the spans landed on
+_FLOW_IDS = itertools.count(1)
 # every live Tracer, so a process-wide dump can merge engines into one
 # Perfetto file (each keeps its own pid lane)
 _TRACERS: "weakref.WeakSet[Tracer]" = weakref.WeakSet()
+
+
+def next_flow_id() -> int:
+    """Process-unique id for one flow link (see :meth:`Tracer.flow`)."""
+    return next(_FLOW_IDS)
 
 
 def tracing_enabled() -> bool:
@@ -91,6 +106,14 @@ class Tracer:
         self._lock = threading.Lock()
         self._threads: Dict[int, str] = {}
         self._n_dropped = 0          # events the ring overwrote
+        # satellite (ISSUE 15): ring wrap-around is OBSERVABLE — the
+        # process-wide counter makes silent truncation a metric, the
+        # per-tracer `dropped` property feeds engine stats()
+        self._m_dropped = get_registry().counter(
+            "trace_events_dropped",
+            "span events overwritten by a tracer ring buffer wrapping "
+            "(PADDLE_TPU_TRACE_EVENTS capacity) — the flight "
+            "recorder's own loss accounting")
         _TRACERS.add(self)
 
     # -- recording ----------------------------------------------------
@@ -104,6 +127,7 @@ class Tracer:
         with self._lock:
             if len(self._buf) == self.capacity:
                 self._n_dropped += 1
+                self._m_dropped.inc()
             self._buf.append(rec)
 
     def emit(self, name: str, tid: int = 0, t0: float = None,
@@ -125,6 +149,21 @@ class Tracer:
         """Record a point-in-time marker."""
         self._append(("i", name, int(tid), time.monotonic(), 0.0,
                       args))
+
+    def flow(self, name: str, tid: int = 0, flow_id: int = 0,
+             phase: str = "s", args: Optional[dict] = None):
+        """Record one end of a FLOW link (a Perfetto arrow between
+        spans): ``phase="s"`` starts the flow, ``"f"`` finishes it.
+        Both ends share ``flow_id`` (allocate with
+        :func:`next_flow_id`); each binds to the slice enclosing its
+        (pid, tid, ts), so a disaggregated KV handoff renders as an
+        arrow from the prefill slot's request span to the decode
+        replica's — across process lanes in a merged trace."""
+        if phase not in ("s", "f"):
+            raise ValueError(f"flow phase must be 's'|'f', "
+                             f"got {phase!r}")
+        self._append((phase, name, int(tid), time.monotonic(), 0.0,
+                      dict(args or {}, flow_id=int(flow_id))))
 
     def begin(self, name: str, tid: int = 0, **args):
         """Start a span; returns an opaque token for :meth:`end`.
@@ -201,6 +240,12 @@ class Tracer:
                   "cat": "paddle_tpu", "ts": int(t0 * 1e6)}
             if ph == "X":
                 ev["dur"] = int(dur * 1e6)
+            elif ph in ("s", "f"):      # flow start / finish
+                a = dict(args or {})
+                ev["id"] = a.pop("flow_id", 0)
+                if ph == "f":
+                    ev["bp"] = "e"      # bind to the enclosing slice
+                args = a or None
             else:                       # instant: thread-scoped
                 ev["s"] = "t"
             if args:
@@ -232,6 +277,124 @@ class Tracer:
                     {"pid": self.pid, "tracer": self.name, **ev},
                     default=str) + "\n")
         return path
+
+
+class ProfilerWindow:
+    """Bounded on-demand ``jax.profiler`` capture armed around the next
+    N ticks of a host loop (ISSUE 15 layer 3 — ``engine.profile(n)`` /
+    ``EngineCluster.profile(n)``). ``arm(n_ticks, path)`` schedules a
+    capture (``path`` defaults to ``PADDLE_TPU_PROFILE_DIR``); the
+    owner brackets each tick with ``tick_begin()`` / ``tick_end()`` —
+    the profiler starts before the first armed tick and stops after the
+    Nth, so the capture is exactly the requested window, never an
+    unbounded always-on trace.
+
+    Under the ``PADDLE_TPU_TRACE=0`` kill switch ``arm()`` refuses
+    (returns None) and the unarmed begin/end calls are integer
+    comparisons — the killed hot path runs zero profiler instructions.
+    A profiler failure (backend without profiling support, or a
+    concurrent capture — jax allows ONE live session per process)
+    disarms with a warning instead of taking down the serving loop.
+    The ``start``/``stop`` hooks exist for tests (and for embedding a
+    different profiler); they default to ``jax.profiler.start_trace``
+    / ``stop_trace``."""
+
+    def __init__(self, start=None, stop=None):
+        self._start = start
+        self._stop = stop
+        self._left = 0              # ticks remaining in the window
+        self._dir: Optional[str] = None
+        self._active = False
+        self.captures = 0           # windows completed
+        self.last_dir: Optional[str] = None
+
+    @property
+    def pending(self) -> int:
+        """Ticks left in the armed (or running) window (0 = idle)."""
+        return self._left
+
+    def arm(self, n_ticks: int, path: Optional[str] = None):
+        """Schedule a capture of the next ``n_ticks`` ticks into
+        ``path`` (default ``$PADDLE_TPU_PROFILE_DIR``). Returns the
+        capture dir, or None under ``PADDLE_TPU_TRACE=0`` (the whole
+        flight recorder is inert there). Raises while a window is
+        already armed/running — jax supports one capture at a time."""
+        if not tracing_enabled():
+            return None
+        n = int(n_ticks)
+        if n < 1:
+            raise ValueError(f"n_ticks must be >= 1, got {n_ticks!r}")
+        if self._left or self._active:
+            raise RuntimeError(
+                "a profiling window is already armed "
+                f"({self._left} ticks remaining)")
+        path = path or os.environ.get(_PROFILE_DIR_ENV)
+        if not path:
+            raise ValueError(
+                "no profile output dir: pass path= or set "
+                f"{_PROFILE_DIR_ENV}")
+        self._left = n
+        self._dir = str(path)
+        return self._dir
+
+    def tick_begin(self):
+        """Start the capture if a window is armed and not yet live."""
+        if self._left <= 0 or self._active:
+            return
+        try:
+            if self._start is not None:
+                self._start(self._dir)
+            else:
+                import jax
+                os.makedirs(self._dir, exist_ok=True)
+                jax.profiler.start_trace(self._dir)
+            self._active = True
+        except Exception as exc:    # pragma: no cover - backend quirk
+            warnings.warn(f"profiling window disarmed: {exc!r}")
+            self._left = 0
+            self._dir = None
+
+    def tick_end(self):
+        """Count one tick off the live window; stop the capture when
+        the window is spent. A failed stop disarms but is NOT counted
+        as a completed capture (``captures``/``last_dir`` only report
+        profiles that were actually written)."""
+        if not self._active:
+            return
+        self._left -= 1
+        if self._left > 0:
+            return
+        try:
+            if self._stop is not None:
+                self._stop()
+            else:
+                import jax
+                jax.profiler.stop_trace()
+        except Exception as exc:    # pragma: no cover - backend quirk
+            warnings.warn(f"profiler stop failed: {exc!r}")
+            self._active = False
+            self._dir = None
+            return
+        self._active = False
+        self.captures += 1
+        self.last_dir, self._dir = self._dir, None
+
+    @contextlib.contextmanager
+    def tick(self):
+        """Bracket ONE tick of the owner's host loop: starts the
+        capture if a window is armed, counts the tick off on exit.
+        The single call site shape for engines and clusters —
+        ``with prof.tick(): ...`` — so the bracketing semantics
+        cannot drift between owners. No-op (beyond an integer check)
+        when idle."""
+        if self._left <= 0 and not self._active:
+            yield
+            return
+        self.tick_begin()
+        try:
+            yield
+        finally:
+            self.tick_end()
 
 
 def live_tracers() -> List[Tracer]:

@@ -72,6 +72,15 @@ import pytest
 # tiny-engine compiles), all far under the ~9s line — no new
 # entries. test_tracing.py's outcome-labels test was updated in
 # place (in-flight cancel now succeeds), no timing change.
+# r15 re-sweep (fleet flight recorder): the 15 new
+# test_flight_recorder.py tests measured ~25s total solo (slowest
+# ~4s — the disaggregated merged-trace schema test building a 1+1
+# cluster; profiler-window tests are pure host code), and the new
+# stats-docs lint in test_metrics_docs.py is one more ~5s
+# fresh-interpreter probe — all far under the ~9s line, no new
+# entries. The per-compile executable_cost capture (cost_analysis on
+# an already-compiled executable) is not measurable against the
+# compile itself.
 _SLOW_TESTS = {
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s

@@ -51,3 +51,52 @@ def test_every_registered_metric_is_documented():
     assert not missing, (
         "metrics registered but undocumented — add them to the "
         f"docs/OPS.md metrics table: {missing}")
+
+
+# the ISSUE 15 twin: every ALWAYS-present stats() key — the keys a
+# PLAIN engine/cluster reports, i.e. the contract dashboards consume —
+# must appear as a `code` literal in docs/OPS.md's stats tables. The
+# probe builds both compile-free.
+_STATS_PROBE = """
+import json
+import paddle_tpu
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=1, heads=2,
+                       kv_heads=1, ffn=64)
+m = LlamaForCausalLM(cfg)
+m.eval()
+from paddle_tpu.inference import ServingConfig, ServingEngine
+from paddle_tpu.inference.cluster import ClusterConfig, EngineCluster
+scfg = ServingConfig(num_slots=2, block_size=8, max_model_len=32)
+eng = ServingEngine(m, scfg)
+cl = EngineCluster(m, ClusterConfig(num_replicas=1), scfg)
+print("ENGINE_KEYS=" + json.dumps(sorted(eng.stats())))
+print("CLUSTER_KEYS=" + json.dumps(sorted(cl.stats())))
+"""
+
+
+def test_every_always_present_stats_key_is_documented():
+    """ISSUE 15 satellite: a new always-present ``stats()`` key —
+    engine or cluster, roofline/trace keys included — cannot ship
+    without a row in an OPS.md stats table (checked as a backticked
+    literal so prose words like "active" cannot satisfy the lint)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", _STATS_PROBE],
+                          capture_output=True, text=True, cwd=_ROOT,
+                          env=env, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    keys = {}
+    for tag in ("ENGINE_KEYS", "CLUSTER_KEYS"):
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith(tag + "=")][-1]
+        keys[tag] = json.loads(line[len(tag) + 1:])
+    assert len(keys["ENGINE_KEYS"]) >= 50, keys["ENGINE_KEYS"]
+    assert "roofline" in keys["ENGINE_KEYS"]
+    assert "trace_events_dropped" in keys["CLUSTER_KEYS"]
+    with open(os.path.join(_ROOT, "docs", "OPS.md")) as f:
+        ops = f.read()
+    missing = sorted({k for ks in keys.values() for k in ks
+                      if f"`{k}`" not in ops})
+    assert not missing, (
+        "always-present stats() keys undocumented — add them to the "
+        f"docs/OPS.md stats tables: {missing}")
