@@ -1527,6 +1527,108 @@ def _spec_serving_bench():
     return results
 
 
+def _spec_tree_bench():
+    """Tree vs linear speculation at the SAME verify node budget (the
+    ISSUE-16 bar). A tiny Llama is TRAINED (Adam, fresh batches each
+    step so it learns the transition statistics rather than memorizing
+    sequences) on a first-order Markov corpus where every token has a
+    0.6-majority and 0.4-minority successor. Under sampled verify the
+    target really does take the minority branch 40% of the time, so a
+    linear gamma=4 chain stalls at depth 1 whenever its single guess
+    takes the wrong fork — while a tree spending one of the same 5
+    nodes on the sibling fork covers BOTH successors and keeps the
+    window alive. Reports mean accepted len per verify window and
+    aggregate tok/s for both shapes; accepted-len is the structural
+    claim (``cpu_proxy`` — wall-clock tok/s off-TPU only weakly
+    rewards deeper acceptance because the tick is latency- not
+    FLOP-bound on CPU)."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    vocab = 12
+    crng = np.random.RandomState(0)
+    succ1 = crng.permutation(vocab)
+    succ2 = (succ1 + 1 + crng.randint(0, vocab - 1, vocab)) % vocab
+
+    def sample_seq(n, r):
+        t = r.randint(vocab)
+        out = [t]
+        for _ in range(n - 1):
+            t = int(succ1[t]) if r.rand() < 0.6 else int(succ2[t])
+            out.append(t)
+        return np.array(out, np.int64)
+
+    paddle.seed(11)
+    np.random.seed(11)
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.Adam(5e-3, parameters=model.parameters())
+    trng = np.random.RandomState(1)
+    steps = int(os.environ.get("BENCH_SPEC_TREE_STEPS", 50))
+    for _ in range(steps):
+        b = np.stack([sample_seq(49, trng) for _ in range(16)])
+        loss = model(paddle.to_tensor(b[:, :-1]),
+                     labels=paddle.to_tensor(b[:, 1:]))
+        opt.clear_grad()
+        loss.backward()
+        opt.step()
+    model.eval()
+
+    new = int(os.environ.get("BENCH_SPEC_TREE_NEW", 32))
+    n_req = int(os.environ.get("BENCH_SPEC_TREE_REQS", 8))
+    prompts = [sample_seq(48, np.random.RandomState(100 + i))
+               for i in range(n_req)]
+
+    def run_engine(spec_tree):
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=4, block_size=16, max_model_len=128,
+            max_new_tokens=new, num_speculative_tokens=4,
+            spec_tree=spec_tree, spec_ngram_max=1,
+            decode_strategy="sampling", temperature=1.0, seed=5))
+        eng.serve(prompts[:2], max_new_tokens=4)   # warmup/compile
+        st0 = eng.stats()
+        for p in prompts:
+            eng.submit(p, new)
+        t0 = time.perf_counter()
+        while eng.num_queued or eng.num_active:
+            eng.step()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - st0["tokens_total"])
+                      / wall, 1),
+            "mean_accepted_len": round(st["spec_mean_accepted_len"],
+                                       3),
+            "acceptance_rate": round(st["spec_acceptance_rate"], 4),
+            "verify_node_budget": st["spec_tree_nodes"] or 5,
+            "recompiles_measured":
+                st["decode_compiles"] - st0["decode_compiles"],
+        }
+
+    linear = run_engine(None)
+    # depth-3 spine + one sibling fork off the root: 5 verify nodes,
+    # exactly the linear gamma=4 budget
+    tree = run_engine((0, 0, 1, 3))
+    out = {
+        "train_steps": steps, "final_loss": round(float(loss), 4),
+        "linear_g4": linear, "tree_g4": tree,
+        "tree_topology": [0, 0, 1, 3],
+        "accept_len_delta": round(tree["mean_accepted_len"]
+                                  - linear["mean_accepted_len"], 3),
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def _prefix_serving_bench():
     """Prefix-cached serving throughput (the ISSUE-5 bar): N requests
     sharing one long system prompt (distinct short suffixes — the
@@ -2157,6 +2259,10 @@ def main():
     except Exception as exc:
         speculative = {"error": repr(exc)}
     try:
+        spec_tree = _spec_tree_bench()
+    except Exception as exc:
+        spec_tree = {"error": repr(exc)}
+    try:
         serving_prefix = _prefix_serving_bench()
     except Exception as exc:
         serving_prefix = {"error": repr(exc)}
@@ -2207,6 +2313,7 @@ def main():
               "decode": decode,
               "serving": serving,
               "speculative": speculative,
+              "spec_tree": spec_tree,
               "serving_prefix": serving_prefix,
               "serving_tp": serving_tp,
               "serving_ragged": serving_ragged,
@@ -2232,6 +2339,7 @@ def main():
             k: (v.get("mfu") if isinstance(v, dict) else None)
             for k, v in detail.items()
             if k not in ("decode", "serving", "speculative",
+                         "spec_tree",
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
                          "roofline", "cluster", "fusion", "preempt",
@@ -2253,6 +2361,13 @@ def main():
              "spec_mean_accepted_len":
              speculative.get("ngram_g4", {}).get("mean_accepted_len")
              if isinstance(speculative, dict) else None,
+             "spec_tree_accept_len":
+             spec_tree.get("tree_g4", {}).get("mean_accepted_len")
+             if isinstance(spec_tree, dict) else None,
+             "spec_tree_tokens_per_sec":
+             spec_tree.get("tree_g4", {}).get(
+                 "aggregate_tokens_per_sec")
+             if isinstance(spec_tree, dict) else None,
              "prefix_serving_speedup":
              serving_prefix.get("speedup_tokens_per_sec")
              if isinstance(serving_prefix, dict) else None,
@@ -2377,7 +2492,8 @@ def main():
               "fusion_tokens_per_sec", "fusion_speedup",
               "kernels_per_tick_ratio", "preempt_goodput_delta",
               "preempt_ttft_p99_ms", "kv_blocks_spilled",
-              "step_mfu", "hbm_bw_util", "roofline_cpu_proxy"):
+              "step_mfu", "hbm_bw_util", "roofline_cpu_proxy",
+              "spec_tree_accept_len", "spec_tree_tokens_per_sec"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

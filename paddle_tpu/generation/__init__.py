@@ -228,7 +228,7 @@ class GenerationMixin:
                 "sampling, beam_search, group_beam_search")
         return strategy == "sampling"
 
-    def _build_model_step(self, binder, buffers):
+    def _build_model_step(self, binder, buffers, want_hidden=False):
         def model_step(params_a, tok_ids, caches, off, mask=None,
                       pos=None, block_tables=None, cache_lens=None,
                       ragged_meta=None):
@@ -250,11 +250,18 @@ class GenerationMixin:
                 # packed row buffer
                 kwargs["ragged_meta"] = tuple(
                     _wrap_out(x) for x in ragged_meta)
+            if want_hidden:
+                # draft-head speculation needs the final hidden state
+                # alongside the logits
+                kwargs["return_hidden"] = True
             out, _ = binder.call(
                 params_a, buffers, (_wrap_out(tok_ids),), kwargs)
             logits, new_caches = out
-            return as_jax(logits), [(as_jax(k), as_jax(v))
-                                    for k, v in new_caches]
+            new_caches = [(as_jax(k), as_jax(v)) for k, v in new_caches]
+            if want_hidden:
+                logits, hidden = logits
+                return (as_jax(logits), as_jax(hidden)), new_caches
+            return as_jax(logits), new_caches
         return model_step
 
     def _build_run(self, binder, buffers, b, prompt_len, max_new,
@@ -394,7 +401,8 @@ class GenerationMixin:
                  pad_token_id=None, seed=None, attention_mask=None,
                  cache_impl=None, pad_prompt_to_bucket=None,
                  num_speculative_tokens=None, draft_model=None,
-                 spec_ngram_max=None, kv_cache_dtype=None, **kwargs):
+                 spec_ngram_max=None, spec_tree=None,
+                 kv_cache_dtype=None, **kwargs):
         """Returns ``(ids, scores)``: generated token ids
         [B, max_new_tokens] (pad-filled after EOS) and the summed
         log-probability of the chosen tokens per sequence (for beam
@@ -557,9 +565,19 @@ class GenerationMixin:
         if draft_model is not None and gamma == 0:
             raise ValueError(
                 "draft_model requires num_speculative_tokens > 0")
+        if spec_tree is not None:
+            spec_tree = tuple(int(p) for p in spec_tree)
+            if gamma == 0:
+                raise ValueError(
+                    "spec_tree requires num_speculative_tokens > 0")
+            if len(spec_tree) != gamma:
+                raise ValueError(
+                    f"spec_tree has {len(spec_tree)} nodes; must equal "
+                    f"num_speculative_tokens={gamma}")
         if not speculative_enabled():        # PADDLE_TPU_SPECULATIVE=0
             gamma = 0
             draft_model = None
+            spec_tree = None
         if gamma:
             if is_beam:
                 raise NotImplementedError(
@@ -597,7 +615,7 @@ class GenerationMixin:
                        id(draft_model) if draft_model is not None
                        else None, ngram_max,
                        int(getattr(cfg, "kv_block_size", 16)),
-                       kv_dtype)
+                       kv_dtype, spec_tree)
             runner = self._generate_jit_cache.get(jit_key)
             _label = type(self).__name__
             if runner is None:
@@ -609,7 +627,7 @@ class GenerationMixin:
                     top_k=top_k, top_p=top_p, eos=eos, pad=pad,
                     block_size=int(getattr(cfg, "kv_block_size", 16)),
                     draft_model=draft_model, ngram_max=ngram_max,
-                    kv_cache_dtype=kv_dtype)
+                    kv_cache_dtype=kv_dtype, spec_tree=spec_tree)
                 self._generate_jit_cache[jit_key] = runner
             else:
                 _gen_cache_events.labels(model=_label,

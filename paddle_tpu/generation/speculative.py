@@ -45,9 +45,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.pallas.paged_attention import tree_ancestor_bits
+
 __all__ = ["speculative_enabled", "ngram_propose", "spec_exclusion_reason",
            "draft_exclusion_reason", "build_verify_step",
-           "accept_from_filtered", "build_draft_loop", "SpecGenerator"]
+           "accept_from_filtered", "build_draft_loop", "SpecGenerator",
+           "spec_tree_enabled", "tree_ancestor_bits",
+           "tree_chain_layout", "tree_fill_from_chains",
+           "ngram_propose_topk", "accept_tree_from_filtered",
+           "build_tree_verify_step"]
 
 
 def speculative_enabled() -> bool:
@@ -55,6 +61,16 @@ def speculative_enabled() -> bool:
     decoding everywhere (generate() and the serving engine fall back to
     plain single-token decode)."""
     return os.environ.get("PADDLE_TPU_SPECULATIVE", "1") != "0"
+
+
+def spec_tree_enabled() -> bool:
+    """Kill switch: ``PADDLE_TPU_SPEC_TREE=0`` disables TREE-structured
+    speculation specifically — ``spec_tree=...`` configs resolve back
+    to the linear draft chain (and the ``"heads"`` drafter to
+    ``"ngram"``) at construction time, restoring the pre-tree engine
+    trace bit-for-bit. The broader ``PADDLE_TPU_SPECULATIVE=0`` switch
+    still turns speculation off entirely."""
+    return os.environ.get("PADDLE_TPU_SPEC_TREE", "1") != "0"
 
 
 def spec_exclusion_reason(model) -> Optional[str]:
@@ -111,6 +127,112 @@ def ngram_propose(history, gamma: int, max_ngram: int = 3) -> List[int]:
                     out.append(out[-1])
                 return out
     return [history[-1]] * g
+
+
+def ngram_propose_topk(history, gamma: int, n_chains: int,
+                       max_ngram: int = 3) -> List[List[int]]:
+    """Multi-candidate prompt-lookup drafter: the top-``n_chains``
+    DISTINCT continuations of the current suffix, scanning matches in
+    the SAME order as :func:`ngram_propose` (longest suffix first,
+    most recent occurrence first) — so ``chains[0]`` is exactly
+    ``ngram_propose``'s proposal, and a chain-topology tree drafts the
+    identical window the linear path would. Later matches (older
+    occurrences, then shorter suffixes) supply the sibling candidates
+    a branching tree spends its extra nodes on — zero extra weights.
+    Chains are deduplicated by their FIRST token: sibling branches
+    diverge at their branch point, so two continuations sharing a head
+    would collide on the same depth-1 node and the extra chain would
+    cover nothing. When fewer than ``n_chains`` head-distinct
+    continuations exist, the remainder pads with the repeat-last-token
+    fallback chain."""
+    n = len(history)
+    g = int(gamma)
+    chains: List[List[int]] = []
+    seen = set()
+    for k in range(min(int(max_ngram), n - 1), 0, -1):
+        suf = history[n - k:]
+        for start in range(n - k - 1, -1, -1):
+            if history[start:start + k] == suf:
+                out = list(history[start + k: start + k + g])
+                while len(out) < g:
+                    out.append(out[-1])
+                if out[0] in seen:
+                    continue
+                seen.add(out[0])
+                chains.append(out)
+                if len(chains) == int(n_chains):
+                    return chains
+    fb = [history[-1]] * g
+    if not chains:
+        chains.append(fb)
+    while len(chains) < int(n_chains):
+        chains.append(list(fb))
+    return chains
+
+
+def tree_chain_layout(parents):
+    """Static layout of a speculative token tree given its parent
+    tuple (node ``k + 1``'s parent is ``parents[k]``; node 0 is the
+    committed root). Returns ``(depth, leaf_of, n_leaves,
+    max_depth)``:
+
+    - ``depth[i]``: node ``i``'s depth (root = 0),
+    - ``leaf_of[i]``: the chain index (= order among leaves) of node
+      ``i``'s first-child-descendant leaf — the chain whose tokens
+      fill node ``i`` when drafting from per-chain candidate lists,
+    - ``n_leaves``: how many root-to-leaf chains the tree realizes
+      (the drafter's candidate count),
+    - ``max_depth``: the chains' required length.
+
+    A chain topology (``tuple(range(gamma))``) has one leaf, so every
+    node maps to chain 0 — the drafter degenerates to exactly
+    :func:`ngram_propose`. NOTE: topologies whose branches share a
+    prefix node assume the sibling chains agree on the shared prefix
+    tokens (the verify is exact regardless; a disagreeing chain just
+    wastes its shared-prefix nodes)."""
+    tree_ancestor_bits(parents)          # validates shape/ordering
+    parents = tuple(int(p) for p in parents)
+    t = len(parents) + 1
+    depth = [0] * t
+    children: List[List[int]] = [[] for _ in range(t)]
+    for k, p in enumerate(parents):
+        depth[k + 1] = depth[p] + 1
+        children[p].append(k + 1)
+    # Chain indices follow depth-first (first-child) traversal so the
+    # root's primary spine is always chain 0 — the drafter's best
+    # candidate rides the deepest path no matter how nodes are
+    # numbered, and a chain topology degenerates to ngram_propose.
+    chain_of: dict = {}
+    stack = [0]
+    while stack:
+        i = stack.pop()
+        if not children[i] and i > 0:
+            chain_of[i] = len(chain_of)
+        stack.extend(reversed(children[i]))
+    n_leaves = len(chain_of)
+
+    def first_leaf(i):
+        while children[i]:
+            i = children[i][0]
+        return i
+
+    leaf_of = tuple(chain_of[first_leaf(i)] for i in range(t))
+    return tuple(depth), leaf_of, n_leaves, max(depth)
+
+
+def tree_fill_from_chains(parents, chains) -> List[int]:
+    """Map per-chain candidate lists onto the tree's draft nodes:
+    node ``k + 1`` (depth ``d``, chain ``c`` per
+    :func:`tree_chain_layout`) takes ``chains[c][d - 1]``. Returns the
+    ``gamma`` draft tokens in node order — the ``toks[:, 1:]`` row a
+    tree verify window consumes."""
+    depth, leaf_of, n_leaves, max_depth = tree_chain_layout(parents)
+    if len(chains) < n_leaves:
+        raise ValueError(
+            f"tree has {n_leaves} chains but only {len(chains)} "
+            "candidate lists were drafted")
+    return [int(chains[leaf_of[k + 1]][depth[k + 1] - 1])
+            for k in range(len(parents))]
 
 
 def build_draft_loop(draft_step, *, gamma, do_sample, temperature=1.0,
@@ -233,6 +355,143 @@ def accept_from_filtered(f, toks, dq, key, *, gamma, do_sample):
     return out, accept, picked
 
 
+def accept_tree_from_filtered(f, toks, parents, key, *, do_sample):
+    """Tree-window acceptance on ALREADY-FILTERED target logits: the
+    token-tree generalization of :func:`accept_from_filtered`'s linear
+    rollback — longest-accepted-root-path selection. ``f`` [S, T, V]
+    holds the target's filtered logits at every window node (node 0 =
+    the committed root token), ``toks`` [S, T] the window tokens
+    (``toks[:, 0]`` the root), ``parents`` the static topology.
+
+    Walks the tree from the root one depth at a time. Greedy: advance
+    to the child whose draft token equals the current node's target
+    argmax (at most one, for deduped drafts; ties break to the lowest
+    node id). Sampled: SEQUENTIAL SIBLING rejection sampling — visit
+    the current node's children in node order, accepting child ``i``
+    w.p. ``min(1, p(x_i) / (1 - sum of rejected siblings' p))`` (the
+    divide-free test ``u_i * (1 - rej_mass) < p(x_i)``; each node owns
+    one pre-drawn uniform, visited at most once), and when every child
+    is rejected the bonus token samples from ``p`` with the rejected
+    sibling tokens zeroed and renormalized — the multi-candidate
+    residual rule that keeps the emitted distribution exactly the
+    target's (Leviathan-style; a single-child chain reduces to the
+    linear one-hot rule). A slot whose path reaches a leaf (or accepts
+    the full depth) gets its bonus from the leaf's full distribution.
+
+    Returns ``(out [S, T], accept [S, T-1], picked_logp [S, T],
+    path [S, T], n_acc [S])``. ``out``/``accept`` keep the LINEAR
+    layout contract (``accept`` is prefix-true with ``n_acc`` leading
+    Trues; the host emits ``out[s, :n_acc + 1]``), so
+    ``leading_accepts`` / ``commit_window`` and every engine commit
+    path work unchanged. ``path[s, j]`` names the accepted window node
+    at depth ``j`` (``path[s, 0] = 0``; ``path[s, j] >= j``), the
+    permutation ``ops.paged_cache.permute_window`` compacts the K/V
+    window with; ``n_acc`` the accepted draft count."""
+    s, t, v = f.shape
+    parents = tuple(int(p) for p in parents)
+    if len(parents) != t - 1:
+        raise ValueError(
+            f"spec tree has {len(parents) + 1} nodes but the verify "
+            f"window carries {t} rows")
+    par = jnp.asarray((-1,) + parents, jnp.int32)           # [T]
+    toks = toks.astype(jnp.int32)
+    iota_t = jnp.arange(t, dtype=jnp.int32)
+    logp = jax.nn.log_softmax(f, axis=-1)
+
+    cur = jnp.zeros((s,), jnp.int32)                # node at depth d-1
+    alive = jnp.ones((s,), bool)
+    n_acc = jnp.zeros((s,), jnp.int32)
+    path = jnp.zeros((s, t), jnp.int32)
+    bonus = jnp.zeros((s,), jnp.int32)
+
+    if not do_sample:
+        gt = jnp.argmax(f, axis=-1).astype(jnp.int32)       # [S, T]
+        for d in range(1, t):
+            tgt = jnp.take_along_axis(gt, cur[:, None], axis=1)[:, 0]
+            m = (par[None, :] == cur[:, None]) \
+                & (toks == tgt[:, None]) & alive[:, None]   # [S, T]
+            step = m.any(axis=1)
+            nxt = jnp.argmax(m, axis=1).astype(jnp.int32)
+            cur = jnp.where(step, nxt, cur)
+            path = path.at[:, d].set(cur)
+            n_acc = n_acc + step.astype(jnp.int32)
+            alive = step
+        bonus = jnp.take_along_axis(gt, cur[:, None], axis=1)[:, 0]
+    else:
+        p = jax.nn.softmax(f, axis=-1)                      # [S, T, V]
+        keys = jax.random.split(key, t + 1)
+        # one uniform per node: each node is visited at most once (it
+        # has exactly one parent), so the draws stay independent
+        u = jax.random.uniform(keys[0], (s, t))
+        for d in range(1, t):
+            p_cur = jnp.take_along_axis(
+                p, cur[:, None, None], axis=1)[:, 0]        # [S, V]
+            acc_d = jnp.zeros((s,), bool)
+            chosen = cur
+            rej_mass = jnp.zeros((s,), jnp.float32)
+            rej_nodes = jnp.zeros((s, t), bool)
+            for i in range(1, t):
+                cand = alive & (par[i] == cur) & ~acc_d
+                ti = toks[:, i]
+                # a duplicate of an already-rejected sibling token has
+                # zero residual mass left — force pi to 0 so it can
+                # neither re-accept nor re-subtract
+                dup = ((toks == ti[:, None]) & rej_nodes).any(axis=1)
+                pi = jnp.where(
+                    dup, 0.0,
+                    jnp.take_along_axis(p_cur, ti[:, None],
+                                        axis=1)[:, 0])
+                acc_i = cand & (u[:, i] * (1.0 - rej_mass) < pi)
+                chosen = jnp.where(acc_i, jnp.int32(i), chosen)
+                acc_d = acc_d | acc_i
+                newly_rej = cand & ~acc_i
+                rej_mass = rej_mass + jnp.where(newly_rej, pi, 0.0)
+                rej_nodes = rej_nodes.at[:, i].set(newly_rej)
+            # slots stopping at this depth: bonus from the residual
+            # (p with the rejected siblings zeroed, renormalized; the
+            # degenerate all-mass-rejected residual falls back to p —
+            # the linear rule's guard)
+            hit = (rej_nodes[:, :, None]
+                   & (toks[:, :, None] == jax.lax.broadcasted_iota(
+                       jnp.int32, (s, t, v), 2))).any(axis=1)
+            res = jnp.where(hit, 0.0, p_cur)
+            rs = jnp.sum(res, axis=-1, keepdims=True)
+            res = jnp.where(rs > 0.0, res / jnp.maximum(rs, 1e-37),
+                            p_cur)
+            btok = jax.random.categorical(
+                keys[d], jnp.log(jnp.maximum(res, 1e-37))
+                + jnp.where(res > 0.0, 0.0, -jnp.inf)).astype(jnp.int32)
+            stopping = alive & ~acc_d
+            bonus = jnp.where(stopping, btok, bonus)
+            cur = jnp.where(acc_d, chosen, cur)
+            path = path.at[:, d].set(cur)
+            n_acc = n_acc + acc_d.astype(jnp.int32)
+            alive = alive & acc_d
+        # full-depth paths never stopped: bonus from the final node's
+        # complete distribution (no sibling was rejected there)
+        p_fin = jnp.take_along_axis(p, cur[:, None, None],
+                                    axis=1)[:, 0]
+        btok = jax.random.categorical(
+            keys[t], jnp.log(jnp.maximum(p_fin, 1e-37))
+            + jnp.where(p_fin > 0.0, 0.0, -jnp.inf)).astype(jnp.int32)
+        bonus = jnp.where(alive, btok, bonus)
+
+    # assemble the LINEAR-contract outputs: out[s, j] continues the
+    # sequence after j accepted drafts — the depth-(j+1) path token
+    # while j < n_acc, the bonus token at/after the stop
+    child_tok = jnp.take_along_axis(toks, path, axis=1)     # [S, T]
+    nxt_tok = jnp.concatenate(
+        [child_tok[:, 1:], bonus[:, None]], axis=1)
+    out = jnp.where(iota_t[None, :] < n_acc[:, None], nxt_tok,
+                    bonus[:, None])
+    accept = iota_t[None, :t - 1] < n_acc[:, None]
+    # out[s, j] was selected from node path[s, j]'s distribution
+    sel = jnp.take_along_axis(logp, path[:, :, None], axis=1)
+    picked = jnp.take_along_axis(sel, out[:, :, None],
+                                 axis=-1)[..., 0]
+    return out, accept, picked, path, n_acc
+
+
 def build_verify_step(model_step, *, gamma, do_sample, temperature=1.0,
                       top_k=0, top_p=1.0, onehot_draft=True,
                       gather_logits=None, slot_params=False):
@@ -329,6 +588,86 @@ def build_verify_step(model_step, *, gamma, do_sample, temperature=1.0,
     return verify
 
 
+def build_tree_verify_step(model_step, *, parents, do_sample,
+                           temperature=1.0, top_k=0, top_p=1.0,
+                           gather_logits=None, slot_params=False):
+    """Tree-topology twin of :func:`build_verify_step`: ONE target
+    forward over the window ``toks = [cur, node_1..node_gamma]``
+    (tree node order), masked by ancestor path instead of the linear
+    in-window bound — the ``spec_tree_scope`` entered around the model
+    step arms the paged-attention dispatchers without touching any
+    model signature. Acceptance is
+    :func:`accept_tree_from_filtered`'s longest-accepted-root-path
+    walk, and the accepted nodes' K/V — scattered across the window —
+    are compacted onto the linear tail positions in-executable
+    (``ops.paged_cache.permute_window``), so the cache the caller's
+    ``lens += n_acc + 1`` commit exposes is exactly a sequential
+    decode's.
+
+    Drafters here are always one-hot (n-gram top-k chains or Medusa
+    heads propose concrete tokens), so there is no ``dq`` operand.
+    Signatures mirror ``build_verify_step``'s one-hot forms:
+    ``verify(params, pools, tables, lens, toks[, samp][, key])`` ->
+    ``(out [S, T], accept [S, T-1], logp [S, T], pools)`` — the
+    linear-contract shapes, so ``commit_window`` and generate()'s
+    score accounting work unchanged. A chain ``parents`` makes the
+    greedy form token-exact with ``build_verify_step``'s."""
+    from . import _filter_logits
+    from ..ops.paged_cache import permute_window
+    from ..ops.pallas.paged_attention import spec_tree_scope
+    parents = tuple(int(p) for p in parents)
+    tree_ancestor_bits(parents)          # validate before tracing
+
+    def _target(params, pools, tables, lens, toks, samp):
+        with spec_tree_scope(parents):
+            logits, pools = model_step(params, toks, pools, None,
+                                       block_tables=tables,
+                                       cache_lens=lens)
+        if gather_logits is not None:
+            logits = gather_logits(logits)
+        if slot_params:
+            t_, k_, p_ = samp[:, 0], samp[:, 1], samp[:, 2]
+        else:
+            t_, k_, p_ = temperature, top_k, top_p
+        f = _filter_logits(logits, do_sample=do_sample,
+                           temperature=t_, top_k=k_,
+                           top_p=p_)                    # [S, T, V]
+        return f, pools
+
+    def _finish(f, pools, tables, lens, toks, key):
+        out, accept, picked, path, n_acc = accept_tree_from_filtered(
+            f, toks, parents, key, do_sample=do_sample)
+        lens32 = lens.astype(jnp.int32)
+        pools = [permute_window(kp, vp, tables, lens32, path,
+                                n_acc + 1) for kp, vp in pools]
+        return out, accept, picked, pools
+
+    if not do_sample:
+        if slot_params:
+            def verify(params, pools, tables, lens, toks, samp):
+                f, pools = _target(params, pools, tables, lens, toks,
+                                   samp)
+                return _finish(f, pools, tables, lens, toks, None)
+        else:
+            def verify(params, pools, tables, lens, toks):
+                f, pools = _target(params, pools, tables, lens, toks,
+                                   None)
+                return _finish(f, pools, tables, lens, toks, None)
+        return verify
+
+    if slot_params:
+        def verify(params, pools, tables, lens, toks, samp, key):
+            f, pools = _target(params, pools, tables, lens, toks,
+                               samp)
+            return _finish(f, pools, tables, lens, toks, key)
+    else:
+        def verify(params, pools, tables, lens, toks, key):
+            f, pools = _target(params, pools, tables, lens, toks,
+                               None)
+            return _finish(f, pools, tables, lens, toks, key)
+    return verify
+
+
 def leading_accepts(accept_row) -> int:
     """Number of leading True in one slot's accept vector (the
     accepted draft count; the step then emits that many + 1 tokens)."""
@@ -383,7 +722,7 @@ class SpecGenerator:
     def __init__(self, model, binder, buffers, b, prompt_len, max_new,
                  gamma, *, do_sample, temperature, top_k, top_p, eos,
                  pad, block_size, draft_model=None, ngram_max=3,
-                 kv_cache_dtype=None):
+                 kv_cache_dtype=None, spec_tree=None):
         from ..ops import paged_cache as _pc
         from . import _select_token
         # kwarg forwarded only when set — pre-quantization duck-typed
@@ -397,6 +736,26 @@ class SpecGenerator:
         self.ngram_max = int(ngram_max)
         self.prompt_len = prompt_len
         self._draft_model = draft_model
+        # tree topology (None = linear chain). The kill switch resolves
+        # HERE, so a disabled tree builds the linear executables
+        # bit-for-bit (the config value never reaches a trace).
+        if spec_tree is not None and not spec_tree_enabled():
+            spec_tree = None
+        if spec_tree is not None:
+            spec_tree = tuple(int(p) for p in spec_tree)
+            if len(spec_tree) != int(gamma):
+                raise ValueError(
+                    f"spec_tree has {len(spec_tree)} draft nodes but "
+                    f"num_speculative_tokens={int(gamma)}")
+            if draft_model is not None:
+                raise ValueError(
+                    "spec_tree drafts via n-gram top-k chains (or the "
+                    "serving engine's draft heads); a separate "
+                    "draft_model only produces linear chains — drop "
+                    "one of the two")
+            (self._tree_depth, self._tree_leaf_of, self._tree_chains,
+             self._tree_max_depth) = tree_chain_layout(spec_tree)
+        self.spec_tree = spec_tree
 
         # +gamma headroom: the last verify window may overhang the
         # final emitted token by up to gamma speculated positions
@@ -424,12 +783,20 @@ class SpecGenerator:
             return tok, logp, pools
 
         self._prefill = jax.jit(prefill)
-        self._verify = jax.jit(
-            build_verify_step(
-                model_step, gamma=gamma, do_sample=do_sample,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                onehot_draft=draft_model is None),
-            donate_argnums=(1,))
+        if self.spec_tree is not None:
+            self._verify = jax.jit(
+                build_tree_verify_step(
+                    model_step, parents=self.spec_tree,
+                    do_sample=do_sample, temperature=temperature,
+                    top_k=top_k, top_p=top_p),
+                donate_argnums=(1,))
+        else:
+            self._verify = jax.jit(
+                build_verify_step(
+                    model_step, gamma=gamma, do_sample=do_sample,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    onehot_draft=draft_model is None),
+                donate_argnums=(1,))
 
         if draft_model is not None:
             from ..jit import _LayerBinder
@@ -481,7 +848,17 @@ class SpecGenerator:
             toks = np.empty((b, g + 1), np.int32)
             toks[:, 0] = cur
             dq = None
-            if self._draft_model is None:
+            if self.spec_tree is not None:
+                for r in range(b):
+                    if done[r]:
+                        toks[r, 1:] = self.pad
+                        continue
+                    chains = ngram_propose_topk(
+                        hist[r], self._tree_max_depth,
+                        self._tree_chains, self.ngram_max)
+                    toks[r, 1:] = tree_fill_from_chains(
+                        self.spec_tree, chains)
+            elif self._draft_model is None:
                 for r in range(b):
                     toks[r, 1:] = ngram_propose(hist[r], g,
                                                 self.ngram_max) \

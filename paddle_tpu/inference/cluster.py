@@ -222,7 +222,7 @@ class EngineCluster:
     def __init__(self, model, config: Optional[ClusterConfig] = None,
                  serving_config: Optional[ServingConfig] = None,
                  stream_callback: Optional[Callable] = None,
-                 draft_model=None):
+                 draft_model=None, spec_heads=None):
         ccfg = config or ClusterConfig()
         scfg = serving_config or ServingConfig()
         if not cluster_enabled():       # PADDLE_TPU_CLUSTER=0
@@ -231,11 +231,18 @@ class EngineCluster:
         self.serving_config = scfg
         self._disagg = ccfg.prefill_replicas > 0
         if self._disagg and draft_model is not None:
+            # the SEPARATE-model case only: head-drafted tree
+            # speculation (drafter="heads" + spec_tree) serves
+            # disaggregated fine — the draft heads ride the target
+            # params on every replica and re-draft from the imported
+            # target pool, so nothing extra travels in the handoff
             raise NotImplementedError(
-                "disaggregated mode cannot serve a draft model yet: "
-                "the draft pool's prompt K/V is not part of the "
-                "prefill->decode transfer payload (the target pool "
-                "is) — use n-gram speculation or colocated replicas")
+                "disaggregated mode cannot serve a SEPARATE draft "
+                "model: the draft pool's prompt K/V is not part of "
+                "the prefill->decode transfer payload (the target "
+                "pool is) — use n-gram speculation, draft-head tree "
+                "speculation (drafter='heads' + spec_tree), or "
+                "colocated replicas")
         self._stream = stream_callback
         self._engines: List[ServingEngine] = []
         self._decode_idx: List[int] = []
@@ -257,7 +264,7 @@ class EngineCluster:
             self._engines.append(ServingEngine(
                 model, _dc_replace(scfg, **dkw),
                 stream_callback=self._make_cb(idx),
-                draft_model=draft_model))
+                draft_model=draft_model, spec_heads=spec_heads))
             self._decode_idx.append(idx)
         for _ in range(ccfg.prefill_replicas):
             idx = len(self._engines)
@@ -266,10 +273,16 @@ class EngineCluster:
             # its history is the prompt + first token, both in the
             # handoff), and the transfer width is gamma-independent
             # (_mb_xfer) so the payloads still shape-match
+            # speculation (linear OR tree) is a decode feature, so the
+            # prefill tier also drops spec_tree and the heads drafter
+            # alongside gamma — a decode replica's head re-draft needs
+            # only the imported target pool + handoff history
             self._engines.append(ServingEngine(
                 model, _dc_replace(scfg, role="prefill",
                                    retain_results=True,
-                                   num_speculative_tokens=0),
+                                   num_speculative_tokens=0,
+                                   spec_tree=None,
+                                   drafter="ngram"),
                 stream_callback=self._make_cb(idx)))
             self._prefill_idx.append(idx)
         self._router = Router(_pc.model_fingerprint(model),

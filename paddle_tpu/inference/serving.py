@@ -271,7 +271,27 @@ class ServingConfig:
     # verify them in one multi-token forward (0 = off)
     num_speculative_tokens: int = 0
     drafter: str = "ngram"              # ngram | model (pass draft_model)
+    #                                     | heads (tree draft heads)
     spec_ngram_max: int = 3             # longest prompt-lookup n-gram
+    # tree-structured speculation (docs/OPS.md "Tree speculation"):
+    # the speculated window becomes a token TREE instead of a linear
+    # chain. The tuple gives each non-root node's parent index —
+    # node k+1's parent is spec_tree[k], 0 = the root (this tick's
+    # committed token); len(spec_tree) must equal
+    # num_speculative_tokens, so the verify node budget t_q = gamma+1
+    # is unchanged and the ONE ragged executable keeps zero
+    # steady-state recompiles. Topology is static per engine; a chain
+    # tree (0, 1, 2, ...) is bit-for-bit the linear path. Drafting:
+    # drafter="ngram" fills the tree's root-to-leaf chains with the
+    # top-k prompt-lookup continuations (zero extra weights);
+    # drafter="heads" adds Medusa-style draft-head projections over
+    # the target's final hidden state (weights ride WITH the target
+    # params, so head-drafted trees serve on disaggregated clusters
+    # where separate draft models cannot). Kill switch
+    # PADDLE_TPU_SPEC_TREE=0 restores the linear speculative engine
+    # bit-for-bit (heads engines fall back to the linear ngram
+    # drafter). None = linear speculation, exactly as before.
+    spec_tree: Optional[tuple] = None
     # chunked prefill: ONE fixed-chunk AOT executable processes the
     # prompt suffix in ceil(n / prefill_chunk) steps (multi-query paged
     # attention, T = chunk). False (or PADDLE_TPU_CHUNKED_PREFILL=0)
@@ -545,7 +565,7 @@ class ServingEngine:
 
     def __init__(self, model, config: Optional[ServingConfig] = None,
                  stream_callback: Optional[Callable] = None,
-                 draft_model=None):
+                 draft_model=None, spec_heads=None):
         from ..generation import GenerationMixin, _select_token
         from ..generation import speculative as _spec
         if not isinstance(model, GenerationMixin):
@@ -573,9 +593,45 @@ class ServingEngine:
                 "draft_model requires num_speculative_tokens > 0 and "
                 "drafter='model' "
                 f"(got gamma={gamma}, drafter={cfg.drafter!r})")
+        # -- tree-structured speculation: validate BEFORE the kill
+        # switches (misconfiguration must raise regardless of env),
+        # resolve AFTER them (a killed tree is exactly the linear
+        # engine, heads downgrading to the ngram drafter)
+        spec_tree = getattr(cfg, "spec_tree", None)
+        if spec_tree is not None:
+            spec_tree = tuple(int(p) for p in spec_tree)
+            if len(spec_tree) != gamma or gamma == 0:
+                raise ValueError(
+                    f"spec_tree has {len(spec_tree)} non-root nodes; "
+                    "must equal num_speculative_tokens="
+                    f"{gamma} (> 0)")
+            _pa.tree_ancestor_bits(spec_tree)   # topology/depth check
+            if cfg.drafter == "model":
+                raise ValueError(
+                    "spec_tree drafts via drafter='ngram' (top-k "
+                    "prompt lookup) or drafter='heads' (draft-head "
+                    "projections); a separate draft_model proposes "
+                    "one chain, not a tree")
+        if cfg.drafter == "heads" and spec_tree is None:
+            raise ValueError(
+                "drafter='heads' requires spec_tree (the draft heads "
+                "fill a token tree)")
         if not _spec.speculative_enabled():  # PADDLE_TPU_SPECULATIVE=0
             gamma = 0
             draft_model = None
+            spec_tree = None
+        if spec_tree is not None and not _spec.spec_tree_enabled():
+            spec_tree = None                 # PADDLE_TPU_SPEC_TREE=0
+        drafter = str(cfg.drafter)
+        if drafter == "heads" and spec_tree is None:
+            drafter = "ngram"   # killed tree -> the linear ngram path
+        self._spec_tree = spec_tree
+        self._drafter = drafter
+        if spec_tree is not None:
+            # static per-engine layout: node depths, the leaf (chain)
+            # each node feeds, chain count, max depth (= head count)
+            (self._tree_depth, self._tree_leaf_of, self._tree_chains,
+             self._tree_max_depth) = _spec.tree_chain_layout(spec_tree)
         self._role = str(getattr(cfg, "role", "both") or "both")
         if self._role == "prefill" and gamma:
             raise NotImplementedError(
@@ -583,9 +639,9 @@ class ServingEngine:
                 "decoding (num_speculative_tokens > 0) has nothing to "
                 "verify there — put the draft on the decode replicas")
         if gamma:
-            if cfg.drafter not in ("ngram", "model"):
+            if cfg.drafter not in ("ngram", "model", "heads"):
                 raise ValueError(f"drafter {cfg.drafter!r}; "
-                                 "supported: ngram, model")
+                                 "supported: ngram, model, heads")
             if cfg.drafter == "model" and draft_model is None:
                 raise ValueError(
                     "drafter='model' requires a draft_model")
@@ -658,6 +714,58 @@ class ServingEngine:
             if self._mesh is not None else binder.param_arrays()
         self._model_step = model._build_model_step(
             binder, binder.buffer_arrays())
+        # -- Medusa-style draft heads (drafter="heads") ---------------
+        # one [hidden, vocab] projection per tree depth over the
+        # target's final hidden state; node k+1 (depth d, sibling rank
+        # j under its parent) takes the (j+1)-th top token of head
+        # d-1's logits. The head weights ride WITH the target params —
+        # never a separate model — which is what lifts the disagg
+        # draft-spec exclusion for head-drafted trees.
+        self._heads = None
+        self._model_step_h = None
+        self._slot_props = {}    # slot -> cached next-tick proposal [g]
+        if self._spec_tree is not None and self._drafter == "heads":
+            import inspect
+            if "return_hidden" not in inspect.signature(
+                    type(model).forward).parameters:
+                raise NotImplementedError(
+                    f"{type(model).__name__} does not expose "
+                    "forward(return_hidden=...) — draft heads need "
+                    "the target's final hidden state")
+            hdim = int(cfgm.hidden_size)
+            vocab = int(cfgm.vocab_size)
+            n_heads = self._tree_max_depth
+            sib, cnt = [], {}
+            for p in self._spec_tree:
+                r = cnt.get(p, 0)
+                cnt[p] = r + 1
+                sib.append(r)
+            self._tree_sib = tuple(sib)
+            self._tree_kmax = max(sib) + 1
+            if spec_heads is not None:
+                ws = [np.asarray(w, np.float32) for w in spec_heads]
+                if len(ws) != n_heads or any(
+                        w.shape != (hdim, vocab) for w in ws):
+                    raise ValueError(
+                        f"spec_heads must be {n_heads} arrays of "
+                        f"shape ({hdim}, {vocab}) (one per tree "
+                        "depth)")
+            else:
+                # deterministic random calibration: every engine (and
+                # every cluster replica) derives the SAME weights from
+                # the fixed seed, so head-drafted trees stay
+                # token-exact across colocated and disaggregated
+                # deployments with zero weight shipping
+                ws = [np.random.default_rng(0x5EED + d)
+                      .standard_normal((hdim, vocab))
+                      .astype(np.float32) * 0.02
+                      for d in range(n_heads)]
+            self._heads = self._dev(np.stack(ws))
+            self._model_step_h = model._build_model_step(
+                binder, binder.buffer_arrays(), want_hidden=True)
+        elif spec_heads is not None and cfg.drafter != "heads":
+            raise ValueError(
+                "spec_heads requires drafter='heads' (and spec_tree)")
         do_sample = cfg.decode_strategy == "sampling"
         self._do_sample = do_sample
         self._select_token = _select_token
@@ -726,6 +834,11 @@ class ServingEngine:
         # -- ragged mixed-batch layout --------------------------------
         self._ragged = bool(getattr(cfg, "ragged_batch", True)) and \
             os.environ.get("PADDLE_TPU_RAGGED_BATCH", "1") != "0"
+        if self._spec_tree is not None and not self._ragged:
+            raise NotImplementedError(
+                "spec_tree requires the ragged engine (ragged_batch="
+                "True without PADDLE_TPU_RAGGED_BATCH=0); to disable "
+                "tree speculation itself use PADDLE_TPU_SPEC_TREE=0")
         if self._chunked:
             want = cfg.ragged_prefill_rows
             self._prefill_rows = max(1, min(
@@ -1068,6 +1181,17 @@ class ServingEngine:
         self._d_itl = LatencyDigest()
         self._d_queue = LatencyDigest()
         self._d_e2e = LatencyDigest()
+        # tokens emitted per slot verify window, as a P² digest —
+        # unconditional (a non-speculative engine just reports a
+        # zeroed summary) so stats()['spec_accept_len'] and the
+        # serving_spec_accept_len gauge are always present
+        self._d_accept = LatencyDigest()
+        self._m_accept = monitor.gauge(
+            "serving_spec_accept_len",
+            "accepted-length quantiles per slot verify window (P2 "
+            "digest; tokens emitted = accepted drafts + bonus — tree "
+            "and linear speculation both observe; empty on "
+            "non-speculative engines)", labels=("q",))
         self._submit_t = {}     # rid -> submit monotonic (live reqs)
         self._last_emit = {}    # rid -> last token-emit monotonic
         self._m_lat = {
@@ -1526,6 +1650,26 @@ class ServingEngine:
                 occupancy=round(len(active) / cfg.num_slots, 3))
         return emitted
 
+    def _tree_draft(self, i) -> np.ndarray:
+        """One slot's gamma-node tree proposal for this tick, in node
+        order. drafter='heads': the verify executable computed it LAST
+        tick from the accepted path's final hidden state (cached per
+        slot); a slot with no cached proposal (fresh prefill, disagg
+        import, post-preemption resume) falls back to the ngram-topk
+        chains — the SAME rule on every engine, which keeps colocated
+        and disaggregated head drafting token-exact. drafter='ngram':
+        always the top-k prompt-lookup chains."""
+        from ..generation import speculative as _spec
+        if self._heads is not None:
+            props = self._slot_props.get(i)
+            if props is not None:
+                return props
+        chains = _spec.ngram_propose_topk(
+            self._slots[i].history, self._tree_max_depth,
+            self._tree_chains, self._ngram_max)
+        return np.asarray(_spec.tree_fill_from_chains(
+            self._spec_tree, chains), np.int32)
+
     def _commit_verify_window(self, i, out_row, accept_row, emitted):
         """Commit one slot's verified speculative window — the SHARED
         host-side half of acceptance (legacy ``_step_spec`` and the
@@ -1557,6 +1701,7 @@ class ServingEngine:
         self._n_spec_accepted += n_used
         self._n_spec_verifies += 1
         self._n_spec_emitted += len(kept)
+        self._d_accept.observe(float(len(kept)))
         self._m_spec_len.observe(len(kept))
         self._m_spec_proposed.inc(g)
         self._m_spec_accepted.inc(n_used)
@@ -1692,6 +1837,9 @@ class ServingEngine:
             else:
                 props, self._dpools = outs
             toks[:, 1:] = np.asarray(props)
+        elif g and self._spec_tree is not None:
+            for i in active:
+                toks[i, 1:] = self._tree_draft(i)
         elif g:
             for i in active:
                 toks[i, 1:] = _spec.ngram_propose(
@@ -1715,12 +1863,21 @@ class ServingEngine:
         # per-row triple (ids, slot, position) and the per-slot quad
         # (base length, q_lens, row_starts, last_rows)
         rows_pack = np.stack([ids, row_slot, row_pos]).astype(np.int32)
-        slots_pack = np.stack([base, q_lens, row_starts,
-                               last_rows]).astype(np.int32)
+        srows = [base, q_lens, row_starts, last_rows]
+        if self._spec_tree is not None:
+            # 5th per-slot row: which slots verify a TREE window this
+            # tick (prefill rows keep the linear causal mask)
+            tree_flags = np.zeros(n_slots, np.int64)
+            for i in active:
+                tree_flags[i] = 1
+            srows.append(tree_flags)
+        slots_pack = np.stack(srows).astype(np.int32)
         args = [self._params, self._pools, self._tables_dev,
                 self._dev(rows_pack), self._dev(slots_pack)]
         if g:
             args.append(self._dev(toks))
+            if self._heads is not None:
+                args.append(self._heads)
             if self._do_sample and dq is not None:
                 args.append(dq)
         args.append(self._samp_operand())
@@ -1769,11 +1926,23 @@ class ServingEngine:
             tok_arr = np.asarray(outs[0])       # prefill first tokens
             out = np.asarray(outs[1])
             accept = np.asarray(outs[2])
-            self._pools = outs[3]
+            if self._heads is not None:
+                props_next = np.asarray(outs[3])
+                self._pools = outs[4]
+            else:
+                self._pools = outs[3]
             t_sync = time.monotonic()
             for i in active:
                 acc_lens[i] = self._commit_verify_window(
                     i, out[i], accept[i], emitted)
+            if self._heads is not None:
+                # cache the heads' next-tick tree proposal for every
+                # slot that survived the commit (retired/preempted
+                # slots dropped theirs); fresh slots without a cached
+                # proposal draft via the ngram-topk fallback next tick
+                for i in active:
+                    if self._slots[i] is not None:
+                        self._slot_props[i] = props_next[i]
             if self._n_spec_proposed:
                 self._m_spec_rate.set(
                     self._n_spec_accepted / self._n_spec_proposed)
@@ -1969,6 +2138,15 @@ class ServingEngine:
             "itl_ms": self._d_itl.summary(),
             "queue_wait_ms": self._d_queue.summary(),
             "e2e_ms": self._d_e2e.summary(),
+            # tree-speculation keys: ALWAYS present (zeroed digest /
+            # 0 nodes on linear-spec and non-speculative engines) so
+            # dashboards never KeyError across a mixed or
+            # PADDLE_TPU_SPEC_TREE=0 rolled-back fleet.
+            # spec_accept_len is the P² digest of tokens emitted per
+            # slot verify window (accepted + bonus)
+            "spec_accept_len": self._d_accept.summary(),
+            "spec_tree_nodes": (len(self._spec_tree) + 1)
+            if self._spec_tree is not None else 0,
         }
         if self._gamma:
             out.update({
@@ -2779,6 +2957,7 @@ class ServingEngine:
         row) — resume is token-exact by construction on either
         path."""
         slot = self._slots[i]
+        self._slot_props.pop(i, None)
         samp_row = self._slot_samp[i].copy()
         # a mid-prefill slot is "pending" ONLY when it carries no
         # continuation: a previously-preempted request re-prefilling
@@ -3367,10 +3546,21 @@ class ServingEngine:
             }
         tick = "verify" if self._gamma else "decode"
         t = per.get(tick, {})
+        # speculative token credit: the verify window's FLOPs/bytes
+        # are charged ONCE per tick (the executable cost above) but
+        # the tick emits accepted+1 tokens — the mean accepted length
+        # is the divisor that turns per-tick roofline numbers into
+        # per-TOKEN cost (tree speculation raises it at the same
+        # verify node budget)
+        acc = (self._n_spec_emitted / self._n_spec_verifies
+               if self._n_spec_verifies else 0.0)
         return {"cpu_proxy": self._cpu_proxy,
                 "tick_executable": tick,
                 "step_mfu": t.get("mfu", 0.0),
                 "step_hbm_bw_util": t.get("hbm_bw_util", 0.0),
+                "verify_tokens_credited_per_tick": round(acc, 4),
+                "verify_node_budget": (self._gamma + 1)
+                if self._gamma else 1,
                 "peak_flops_per_s": self._peak_flops,
                 "peak_hbm_bytes_per_s": self._peak_hbm_bw,
                 "ridge_flops_per_byte": round(self._ridge, 4),
@@ -3415,6 +3605,8 @@ class ServingEngine:
             g = self._m_lat[key]
             for q, v in dig.quantiles().items():
                 g.labels(q=q).set(round(v, 3))
+        for q, v in self._d_accept.quantiles().items():
+            self._m_accept.labels(q=q).set(round(v, 3))
 
     def _prefill_bucketed(self, i, req, n_real) -> int:
         """Legacy bucketed prefill (``PADDLE_TPU_CHUNKED_PREFILL=0`` /
@@ -3514,6 +3706,7 @@ class ServingEngine:
 
     def _retire(self, i):
         slot = self._slots[i]
+        self._slot_props.pop(i, None)
         now = time.monotonic()
         t0 = self._submit_t.pop(slot.rid, None)
         if t0 is not None:
@@ -3753,6 +3946,8 @@ class ServingEngine:
         g = self._gamma
         r = self._rows
         do_sample = self._do_sample
+        tree = self._spec_tree
+        heads_on = self._heads is not None
 
         def ragged(params, pools, tables, rows_pack, slots_pack, *rest):
             ids, row_slot, row_pos = (rows_pack[0], rows_pack[1],
@@ -3760,17 +3955,30 @@ class ServingEngine:
             base, q_lens, row_starts, last_rows = (
                 slots_pack[0], slots_pack[1], slots_pack[2],
                 slots_pack[3])
+            tree_rows = slots_pack[4] if tree is not None else None
             nwin = jnp.arange(g + 1, dtype=jnp.int32)
             win = jnp.arange(self._wmax, dtype=jnp.int32)
             meta = (q_lens, row_starts, row_slot, row_pos, nwin, win)
             # pad rows park at the overflow position — exclude them
             # from the MoE routing telemetry (they'd read as
             # hot-expert skew on lightly loaded ticks)
-            with _moe.serving_rows_mask(row_pos < self._overflow):
-                logits, pools = self._model_step(
+            step = self._model_step_h if heads_on else self._model_step
+            with contextlib.ExitStack() as ctx:
+                if tree is not None:
+                    # the ancestor mask rides the ambient scope — the
+                    # kernels read the static topology at trace time
+                    # and tree_rows as a per-slot operand; prefill
+                    # rows (tree_rows == 0) keep the linear mask
+                    ctx.enter_context(
+                        _pa.spec_tree_scope(tree, tree_rows))
+                ctx.enter_context(
+                    _moe.serving_rows_mask(row_pos < self._overflow))
+                logits, pools = step(
                     params, ids[None, :], pools, None,
                     block_tables=tables, cache_lens=base,
                     ragged_meta=meta)
+            if heads_on:
+                logits, hid = logits
             lg = logits[0]                          # [R, V(/tp)]
             if not g:
                 samp, key = rest
@@ -3781,7 +3989,11 @@ class ServingEngine:
                 tok, _ = self._select_rows(rows, sel, samp)
                 return tok, pools
             toks = rest[0]
-            dq = rest[1] if len(rest) == 4 else None
+            if tree is not None:
+                heads = rest[1] if heads_on else None
+                dq = None
+            else:
+                dq = rest[1] if len(rest) == 4 else None
             samp = rest[-2]
             key = rest[-1]
             # one take + ONE gather covers the per-slot continuation
@@ -3802,9 +4014,41 @@ class ServingEngine:
             f = _filter_logits(rows[:, 1:, :], do_sample=do_sample,
                                temperature=samp[:, 0],
                                top_k=samp[:, 1], top_p=samp[:, 2])
-            out, accept, _logp = _spec.accept_from_filtered(
-                f, toks, dq, acc_key, gamma=g, do_sample=do_sample)
-            return first_tok, out, accept, pools
+            if tree is None:
+                out, accept, _logp = _spec.accept_from_filtered(
+                    f, toks, dq, acc_key, gamma=g, do_sample=do_sample)
+                return first_tok, out, accept, pools
+            out, accept, _logp, path, n_acc = \
+                _spec.accept_tree_from_filtered(
+                    f, toks, tree, acc_key, do_sample=do_sample)
+            # compact the accepted root path in place: position
+            # base+j must hold node path[j]'s K/V before the next
+            # tick appends at base + n_acc + 1. Non-verifying slots
+            # (prefill rows, idle) keep n_keep = 0 — their moves all
+            # null-route, so a mid-prefill cache is never touched.
+            n_keep = jnp.where(tree_rows > 0, n_acc + 1, 0)
+            pools = [
+                _pc.permute_window(kp, vp, tables, base, path, n_keep)
+                for (kp, vp) in pools]
+            if not heads_on:
+                return first_tok, out, accept, pools
+            # next tick's tree proposal from the draft heads, drafted
+            # off the accepted path's FINAL hidden row (the row whose
+            # LM-head logits produced the bonus token): head d-1
+            # predicts the token at depth d, node k+1 taking its
+            # sibling-rank-th top entry
+            fin = jnp.take_along_axis(path, n_acc[:, None],
+                                      axis=1)[:, 0]
+            hrow = row_starts.astype(jnp.int32) + fin
+            h_fin = jnp.take(hid[0], jnp.clip(hrow, 0, r - 1),
+                             axis=0).astype(jnp.float32)
+            head_lg = jnp.einsum("sh,dhv->dsv", h_fin, heads)
+            _, tidx = jax.lax.top_k(head_lg, self._tree_kmax)
+            props = jnp.stack(
+                [tidx[self._tree_depth[k + 1] - 1][:,
+                      self._tree_sib[k]] for k in range(g)],
+                axis=1).astype(jnp.int32)
+            return first_tok, out, accept, props, pools
 
         jitted = jax.jit(ragged, donate_argnums=(1,))
         name = "verify" if g else "decode"

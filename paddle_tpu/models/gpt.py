@@ -342,7 +342,8 @@ class GPTForCausalLM(Layer, GenerationMixin):
         ]
 
     def forward(self, input_ids, labels=None, caches=None, offset=None,
-                block_tables=None, cache_lens=None, ragged_meta=None):
+                block_tables=None, cache_lens=None, ragged_meta=None,
+                return_hidden=False):
         from ..ops.linalg import matmul
         if caches is not None:
             h, new_caches = self.gpt(input_ids, caches=caches,
@@ -352,6 +353,8 @@ class GPTForCausalLM(Layer, GenerationMixin):
                                      ragged_meta=ragged_meta)
             logits = matmul(h, self.gpt.embeddings.weight,
                             transpose_y=True)
+            if return_hidden:
+                return (logits, h), new_caches
             return logits, new_caches
         h = self.gpt(input_ids)
         logits = matmul(h, self.gpt.embeddings.weight, transpose_y=True)

@@ -688,7 +688,8 @@ class LlamaForCausalLM(Layer, GenerationMixin):
 
     def forward(self, input_ids, labels=None, attention_mask=None,
                 position_ids=None, caches=None, offset=None,
-                block_tables=None, cache_lens=None, ragged_meta=None):
+                block_tables=None, cache_lens=None, ragged_meta=None,
+                return_hidden=False):
         if caches is not None:
             h, new_caches = self.llama(input_ids, attention_mask,
                                        position_ids, caches=caches,
@@ -696,6 +697,8 @@ class LlamaForCausalLM(Layer, GenerationMixin):
                                        block_tables=block_tables,
                                        cache_lens=cache_lens,
                                        ragged_meta=ragged_meta)
+            if return_hidden:
+                return (self._head_and_loss(h, None), h), new_caches
             return self._head_and_loss(h, None), new_caches
         h = self.llama(input_ids, attention_mask, position_ids)
         return self._head_and_loss(h, labels)

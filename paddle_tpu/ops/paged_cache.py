@@ -604,6 +604,57 @@ def write_rows(k_pool, v_pool, block_tables, row_slot, row_pos,
     return _store(k_pool, bi, off, k_new), _store(v_pool, bi, off, v_new)
 
 
+def permute_window(k_pool, v_pool, block_tables, cache_lens, perm,
+                   n_keep):
+    """Tree-acceptance K/V compaction: after a tree-speculative verify
+    tick, slot ``s``'s accepted root path lives at SCATTERED window
+    positions ``cache_lens[s] + perm[s, j]`` — move each onto the
+    linear tail position ``cache_lens[s] + j`` (``j < n_keep[s]``) so
+    the cache looks exactly as if the accepted tokens had been decoded
+    sequentially (the invariant every later read, rollback and prefix
+    reuse depends on).
+
+    ``perm``: [S, T] int32 window-node indices, a root path in tree
+    node order so ``perm[s, j] >= j``; ``n_keep``: [S] int32 positions
+    to keep (0 skips the slot entirely). Pure gather-then-scatter —
+    the gather reads the ORIGINAL pool, so overlapping moves can't
+    clobber each other; positions past ``n_keep`` (and slots with
+    ``n_keep == 0``) scatter into the null block, and their gathers
+    read whatever block the clamp lands on (discarded by
+    construction). Quantized pools move data AND scales — a moved row
+    must dequantize to the identical values its source held. Returns
+    the updated ``(k_pool, v_pool)``."""
+    bs = k_pool.shape[1]
+    mb = block_tables.shape[1]
+    lens = cache_lens.astype(jnp.int32)
+    tables = block_tables.astype(jnp.int32)
+    t = perm.shape[1]
+    j = jnp.arange(t, dtype=jnp.int32)[None, :]                # [1, T]
+    keep = j < jnp.asarray(n_keep, jnp.int32).reshape(-1, 1)   # [S, T]
+    src = lens[:, None] + perm.astype(jnp.int32)               # [S, T]
+    dst = lens[:, None] + j
+
+    def addr(pos, valid):
+        blk = pos // bs
+        bi = jnp.take_along_axis(tables, jnp.minimum(blk, mb - 1),
+                                 axis=1)
+        bi = jnp.where(valid & (pos >= 0) & (blk < mb), bi,
+                       NULL_BLOCK)
+        return bi, pos % bs
+
+    sbi, soff = addr(src, keep)
+    dbi, doff = addr(dst, keep)
+
+    def mv(pool):
+        if isinstance(pool, QuantKV):
+            return QuantKV(
+                pool.data.at[dbi, doff].set(pool.data[sbi, soff]),
+                pool.scale.at[dbi, doff].set(pool.scale[sbi, soff]))
+        return pool.at[dbi, doff].set(pool[sbi, soff])
+
+    return mv(k_pool), mv(v_pool)
+
+
 def ragged_row_meta(q_lens, base_lens, total_rows, overflow_pos):
     """Host-side row layout of ONE ragged mixed-batch step: slot ``s``
     contributes ``q_lens[s]`` consecutive rows (0 = inactive this tick)

@@ -88,9 +88,72 @@ __all__ = ["paged_decode_attention", "pallas_paged_attention",
            "sharded_paged_attention_step",
            "sharded_ragged_attention_step", "kernel_fallback_counts",
            "tp_shard_degree", "serving_tp_scope",
-           "serving_tp_active"]
+           "serving_tp_active", "tree_ancestor_bits",
+           "spec_tree_scope"]
 
 NEG_INF = np.float32(-1e30)
+
+
+def tree_ancestor_bits(parents) -> tuple:
+    """Per-node inclusive ancestor bitmasks for a speculative token
+    tree. ``parents`` names node ``k + 1``'s parent (``parents[k] in
+    [0, k]`` — nodes are numbered in topological order, node 0 the
+    committed root); the chain topology is ``tuple(range(gamma))``.
+    Bit ``j - 1`` of ``bits[t]`` is set iff window node ``j >= 1`` is
+    an ancestor of node ``t`` OR ``t`` itself — exactly the columns
+    window row ``t`` may attend to beyond the committed prefix (the
+    prefix plus root ride the ``rel < 0`` term of the mask). A chain
+    instantiates ``bits[t] = (1 << t) - 1``, which makes the tree mask
+    boolean-identical to the linear causal bound ``cols < lens + t``
+    at every mask site — the bitwise-parity pin."""
+    parents = tuple(int(p) for p in parents)
+    if len(parents) > 31:
+        raise ValueError(
+            f"spec tree supports at most 31 draft nodes (int32 "
+            f"ancestor bitmask), got {len(parents)}")
+    bits = [0]
+    for k, p in enumerate(parents):
+        if not 0 <= p <= k:
+            raise ValueError(
+                f"spec_tree[{k}] = {p}: node {k + 1}'s parent must be "
+                f"an earlier node (0..{k})")
+        bits.append(bits[p] | (1 << k))
+    return tuple(bits)
+
+
+_SPEC_TREE = threading.local()    # thread-scoped like serving_tp_scope
+_AMBIENT = object()               # "read the ambient scope" sentinel
+
+
+@contextlib.contextmanager
+def spec_tree_scope(tree_anc, tree_slots=None):
+    """Arm the token-tree verify mask for the duration of one trace.
+    The serving engine / ``SpecGenerator`` enter this while tracing a
+    tree-speculative executable; the attention step wrappers below
+    read it at dispatch time, so MODEL forwards stay untouched (their
+    ``ragged_meta`` tuple keeps its fixed 6-slot shape). ``tree_anc``
+    is the static parent tuple (``tree_ancestor_bits`` validates it);
+    ``tree_slots`` an optional traced [S] int32 flag vector naming
+    which slots carry a tree window this tick (``None`` = all). The
+    flag is thread-local so a tree compile on one thread never arms a
+    concurrent trace on another. NOTE: the tensor-parallel wrapper
+    reads the scope OUTSIDE ``shard_map`` and forwards ``tree_slots``
+    as an explicit replicated operand — a traced array must never be
+    closed over inside a manual region."""
+    prev = getattr(_SPEC_TREE, "ctx", None)
+    _SPEC_TREE.ctx = (tuple(int(p) for p in tree_anc)
+                      if tree_anc is not None else None, tree_slots)
+    try:
+        yield
+    finally:
+        _SPEC_TREE.ctx = prev
+
+
+def _tree_ctx():
+    """(tree_anc, tree_slots) of the innermost ``spec_tree_scope``,
+    or ``(None, None)`` outside one."""
+    ctx = getattr(_SPEC_TREE, "ctx", None)
+    return (None, None) if ctx is None else ctx
 
 _FORCE_INTERPRET = False  # tests flip this to run the kernel on CPU
 
@@ -133,13 +196,19 @@ def _dequant_tile(k_ref, sc_ref):
 
 def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
                    scale, block_size, n_blocks, t_q=1, rep=None,
-                   quantized=False):
+                   quantized=False, tree_bits=None):
     """Shared body for single-token decode (``t_q=1``) and the
     speculative multi-query verify window (``t_q=gamma+1``): the
     ``t_q * rep`` softmax rows carry a per-row causal bound — row
     ``r`` belongs to window token ``t = r // rep`` and may see cache
     positions ``< lens_ref[s] + t`` (``lens_ref`` counts positions
     visible to window token 0, that token itself included).
+    ``tree_bits`` (static per-node ancestor bitmasks,
+    ``tree_ancestor_bits``) swaps that linear bound for the token-tree
+    mask: window row ``t`` sees the committed prefix + root
+    (``rel < 0``) plus exactly its own ancestor chain inside the
+    window. A chain tree's bits reproduce the linear bound
+    boolean-for-boolean, so this is the SAME kernel body either way.
     ``quantized`` pools ride two extra per-(position, head) scale
     operands; each K/V block tile dequantizes in VMEM right after its
     DMA — the HBM stream stays int8."""
@@ -176,10 +245,26 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
             jnp.int32, sc.shape, 1)
         if t_q == 1:
             bound = ctx
-        else:   # causal within the window: row r is window token r//rep
+            sc = jnp.where(cols < bound, sc, NEG_INF)
+        elif tree_bits is None:
+            # causal within the window: row r is window token r//rep
             bound = ctx + jax.lax.broadcasted_iota(
                 jnp.int32, sc.shape, 0) // rep
-        sc = jnp.where(cols < bound, sc, NEG_INF)
+            sc = jnp.where(cols < bound, sc, NEG_INF)
+        else:
+            # token-tree verify window: window node j sits at cache
+            # position lens-1+j, so rel = cols - ctx names the window
+            # node (rel < 0 = committed prefix + root); row t keeps a
+            # column iff that node is on its own ancestor path
+            node = jax.lax.broadcasted_iota(
+                jnp.int32, sc.shape, 0) // rep
+            bits = jnp.zeros(sc.shape, jnp.int32)
+            for i, b in enumerate(tree_bits):
+                bits = jnp.where(node == i, np.int32(b), bits)
+            rel = cols - ctx
+            ok = (rel < 0) | (
+                ((bits >> jnp.clip(rel, 0, 31)) & 1) > 0)
+            sc = jnp.where(ok, sc, NEG_INF)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
@@ -200,17 +285,27 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
         o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
 
 
-def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, q_ref,
-                   k_ref, v_ref, *rest, scale, block_size, n_blocks,
-                   quantized=False):
+def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, *args,
+                   scale, block_size, n_blocks, quantized=False,
+                   tree_bits=None):
     """Ragged mixed-batch body: grid ``(slot, window_row, kv_head,
     block)``. Each live grid row is window token ``t`` of slot ``s``
     (the q/out BlockSpec chased ``row_starts[s] + t`` into the packed
     buffer); its causal bound is the verify variant's ``lens + t``
     (``lens_ref`` counts positions visible to the slot's FIRST window
     token, itself included). Dead rows (``t >= q_lens[s]``) read/write
-    the trailing scratch row and skip all FLOPs. ``quantized``: same
-    extra scale operands + in-VMEM dequant as ``_decode_kernel``."""
+    the trailing scratch row and skip all FLOPs. ``tree_bits`` (static
+    ancestor bitmasks) adds a FIFTH scalar-prefetch operand
+    ``tree_ref`` [S]: slots flagged ``> 0`` carry a token-tree verify
+    window and mask columns by ancestor path instead of the linear
+    bound — unflagged slots (prefill chunks and their narrow trickle
+    rows) keep the linear mask untouched. ``quantized``: same extra
+    scale operands + in-VMEM dequant as ``_decode_kernel``."""
+    if tree_bits is not None:
+        tree_ref, q_ref, k_ref, v_ref, *rest = args
+    else:
+        tree_ref = None
+        q_ref, k_ref, v_ref, *rest = args
     if quantized:
         ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -241,7 +336,23 @@ def _ragged_kernel(qlens_ref, starts_ref, tables_ref, lens_ref, q_ref,
             preferred_element_type=jnp.float32) * scale
         cols = j * block_size + jax.lax.broadcasted_iota(
             jnp.int32, sc.shape, 1)
-        sc = jnp.where(cols < ctx, sc, NEG_INF)
+        if tree_bits is None:
+            sc = jnp.where(cols < ctx, sc, NEG_INF)
+        else:
+            # tree slots: rel = cols - lens names the window node this
+            # column holds (rel < 0 = committed prefix + root); row t
+            # keeps it iff it is on t's ancestor path. Every tree
+            # column satisfies cols < ctx, so the outer block-skip
+            # guard above stays a strict superset.
+            bits = jnp.int32(0)
+            for i, b in enumerate(tree_bits):
+                bits = jnp.where(t == i, np.int32(b), bits)
+            rel = cols - lens_ref[s]
+            ok_tree = (rel < 0) | (
+                ((bits >> jnp.clip(rel, 0, 31)) & 1) > 0)
+            is_tree = (tree_ref[s] > 0) & (t < len(tree_bits))
+            sc = jnp.where(
+                jnp.where(is_tree, ok_tree, cols < ctx), sc, NEG_INF)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
@@ -339,12 +450,15 @@ try:  # pallas/tpu lowering may be absent on this jax build
 
     def pallas_paged_verify_attention(q, k_pool, v_pool, block_tables,
                                       context_lens, sm_scale=None,
-                                      interpret=None):
+                                      interpret=None, tree_anc=None):
         """Multi-query (speculative verify) variant. q: [S, T, H, D]
         (T = gamma + 1 window tokens per slot, already written to the
         pool); context_lens: [S] int32 — positions visible to window
         token 0, itself included (token ``t`` sees ``context_lens + t``
-        positions). Returns [S, T, H, D]."""
+        positions). ``tree_anc`` (static parent tuple, ``len = T-1``)
+        masks every slot's window by ancestor path instead of the
+        linear in-window bound (``tree_ancestor_bits``). Returns
+        [S, T, H, D]."""
         s, t, h, d = q.shape
         nb, bs, hkv, _ = k_pool.shape
         kd, vd, scales, quant = _unpack_pools(k_pool, v_pool)
@@ -352,13 +466,20 @@ try:  # pallas/tpu lowering may be absent on this jax build
         rep = h // hkv
         scale = np.float32(sm_scale if sm_scale is not None
                            else 1.0 / math.sqrt(d))
+        tree_bits = None
+        if tree_anc is not None:
+            tree_bits = tree_ancestor_bits(tree_anc)
+            if len(tree_bits) != t:
+                raise ValueError(
+                    f"spec tree has {len(tree_bits)} nodes but the "
+                    f"verify window carries {t} rows")
         # rows grouped kv-head-major: [S, hkv, T*rep, D] so one K/V
         # block DMA feeds every window token of the kv group
         q4 = q.reshape(s, t, hkv, rep, d).transpose(0, 2, 1, 3, 4) \
             .reshape(s, hkv, t * rep, d)
         kernel = functools.partial(
             _decode_kernel, scale=scale, block_size=bs, n_blocks=mb,
-            t_q=t, rep=rep, quantized=quant)
+            t_q=t, rep=rep, quantized=quant, tree_bits=tree_bits)
 
         def kv_block(si, g, j, tables, lens):
             return (tables[si, j], 0, g, 0)
@@ -402,7 +523,8 @@ try:  # pallas/tpu lowering may be absent on this jax build
     def pallas_ragged_paged_attention(q, k_pool, v_pool, block_tables,
                                       context_lens, q_lens, row_starts,
                                       row_slot=None, w_max=None,
-                                      sm_scale=None, interpret=None):
+                                      sm_scale=None, interpret=None,
+                                      tree_anc=None, tree_slots=None):
         """Ragged mixed-batch variant. q: [R, H, D] — ONE packed row
         buffer holding every live query row of a serving tick, slot
         ``s`` owning rows ``row_starts[s] .. row_starts[s] +
@@ -411,9 +533,12 @@ try:  # pallas/tpu lowering may be absent on this jax build
         ``context_lens[s] + t``). ``w_max`` is the static per-slot
         row-count ceiling (the grid's window dimension). ``row_slot``
         is accepted for fallback-signature parity and unused here.
-        Returns [R, H, D]; rows past a slot's ``q_lens`` are never
-        read or written (dead grid rows target a trailing scratch
-        row)."""
+        ``tree_anc`` (static parent tuple) + ``tree_slots`` ([S] int32
+        flags, ``None`` = every slot) mask the flagged slots' verify
+        windows by ancestor path — unflagged slots (prefill chunks and
+        their trickle rows) keep the linear bound. Returns [R, H, D];
+        rows past a slot's ``q_lens`` are never read or written (dead
+        grid rows target a trailing scratch row)."""
         r, h, d = q.shape
         nb, bs, hkv, _ = k_pool.shape
         kd, vd, scales, quant = _unpack_pools(k_pool, v_pool)
@@ -422,6 +547,13 @@ try:  # pallas/tpu lowering may be absent on this jax build
         rep = h // hkv
         scale = np.float32(sm_scale if sm_scale is not None
                            else 1.0 / math.sqrt(d))
+        tree_bits = None
+        tree_args = []
+        if tree_anc is not None:
+            tree_bits = tree_ancestor_bits(tree_anc)
+            if tree_slots is None:
+                tree_slots = jnp.ones((s,), jnp.int32)
+            tree_args = [tree_slots.astype(jnp.int32)]
         # trailing scratch row r: dead grid rows park their (skipped)
         # reads and (zero) writes there so live packed rows are never
         # clobbered
@@ -430,20 +562,21 @@ try:  # pallas/tpu lowering may be absent on this jax build
              jnp.zeros((1, hkv, rep, d), q.dtype)], axis=0)
         kernel = functools.partial(
             _ragged_kernel, scale=scale, block_size=bs, n_blocks=mb,
-            quantized=quant)
+            quantized=quant, tree_bits=tree_bits)
 
-        def q_map(si, t, g, j, qlens, starts, tables, lens):
+        # *rest tolerates both prefetch arities (4 linear, 5 tree)
+        def q_map(si, t, g, j, qlens, starts, *rest):
             return (jnp.where(t < qlens[si], starts[si] + t, r),
                     g, 0, 0)
 
-        def kv_block(si, t, g, j, qlens, starts, tables, lens):
+        def kv_block(si, t, g, j, qlens, starts, tables, *rest):
             return (tables[si, j], 0, g, 0)
 
-        def sc_block(si, t, g, j, qlens, starts, tables, lens):
+        def sc_block(si, t, g, j, qlens, starts, tables, *rest):
             return (tables[si, j], 0, g)
 
         grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=4 + len(tree_args),
             grid=(s, w, hkv, mb),
             in_specs=[
                 pl.BlockSpec((1, 1, rep, d), q_map),
@@ -471,7 +604,7 @@ try:  # pallas/tpu lowering may be absent on this jax build
             interpret=_interpret() if interpret is None else interpret,
         )(q_lens.astype(jnp.int32), row_starts.astype(jnp.int32),
           block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-          q4, kd, vd, *scales)
+          *tree_args, q4, kd, vd, *scales)
         return out[:r].reshape(r, h, d)
 
     _kernel_import_error = None
@@ -517,12 +650,17 @@ def _xla_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
 
 
 def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
-                      sm_scale=None):
+                      sm_scale=None, tree_anc=None, tree_rows=None):
     """Multi-query gather fallback (speculative verify window): same
     dtype recipe as ``_xla_paged_attention`` with a per-window-token
     causal bound, so the verify forward is the numerics twin of T
     sequential single-token decode steps — greedy acceptance stays
-    token-exact on CPU."""
+    token-exact on CPU. ``tree_anc`` (static parent tuple) swaps the
+    linear bound for the ancestor-path tree mask, op-for-op the
+    kernels' recipe; ``tree_rows`` ([S] flags, ``None`` = all) selects
+    which slots carry a tree window (the others keep the linear
+    bound — a chain tree's mask IS the linear bound, so parity pins
+    hold either way)."""
     s, t, h, d = q.shape
     hkv = k_pool.shape[2]
     rep = h // hkv
@@ -540,8 +678,23 @@ def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
         preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(k.shape[1], dtype=jnp.int32)
     bound = lens[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-    bias = jnp.where(pos[None, None, :] < bound[:, :, None],
-                     0.0, -1e9)                  # [S, T, L]
+    allow = pos[None, None, :] < bound[:, :, None]   # [S, T, L]
+    if tree_anc is not None:
+        bits = tree_ancestor_bits(tree_anc)
+        if len(bits) != t:
+            raise ValueError(
+                f"spec tree has {len(bits)} nodes but the verify "
+                f"window carries {t} rows")
+        bits_a = jnp.asarray(bits, jnp.int32)        # [T]
+        rel = pos[None, None, :] - lens[:, None, None]
+        bit = (bits_a[None, :, None] >> jnp.clip(rel, 0, 31)) & 1
+        allow_tree = (rel < 0) | (bit > 0)
+        if tree_rows is None:
+            allow = allow_tree
+        else:
+            tr = tree_rows.astype(jnp.int32) > 0
+            allow = jnp.where(tr[:, None, None], allow_tree, allow)
+    bias = jnp.where(allow, 0.0, -1e9)               # [S, T, L]
     scores = scores + bias[:, None, :, None, :]
     w = jax.nn.softmax(scores, axis=-1).astype(ad)
     out = jnp.einsum("sgtrl,slgd->stgrd", w, v.astype(ad))
@@ -550,7 +703,7 @@ def _xla_paged_verify(q, k_pool, v_pool, block_tables, context_lens,
 
 def _xla_ragged_paged(q, k_pool, v_pool, block_tables, context_lens,
                       q_lens, row_starts, row_slot, w_narrow, w_max,
-                      sm_scale=None):
+                      sm_scale=None, tree_anc=None, tree_slots=None):
     """Ragged gather fallback in TWO lanes, both pure
     ``_xla_paged_verify`` calls so every live row stays BITWISE the
     sequential per-width fallback's output (softmax rows are
@@ -569,7 +722,12 @@ def _xla_ragged_paged(q, k_pool, v_pool, block_tables, context_lens,
     Attention FLOPs therefore scale with ``S * w_narrow + w_max`` —
     the live row count — instead of the ``S * w_max`` a naively padded
     layout would pay on every decode-only tick. Pad/dead rows produce
-    garbage the caller discards."""
+    garbage the caller discards.
+
+    ``tree_anc`` + ``tree_slots`` route the flagged slots' narrow-lane
+    windows through the ancestor-path tree mask (``w_narrow`` must
+    equal the tree's node count); the wide lane — always a prefill
+    chunk, never a verify window — stays linear."""
     r, h, d = q.shape
     s = block_tables.shape[0]
     wn = int(w_narrow)
@@ -587,8 +745,12 @@ def _xla_ragged_paged(q, k_pool, v_pool, block_tables, context_lens,
     q_pad = q_pad.at[jnp.where(nar, slot, s),
                      jnp.where(nar, jnp.minimum(local, wn - 1),
                                0)].set(q)
+    tree_rows = None
+    if tree_anc is not None and tree_slots is not None:
+        tree_rows = tree_slots
     out_n = _xla_paged_verify(q_pad[:s], k_pool, v_pool, block_tables,
-                              context_lens, sm_scale=sm_scale)
+                              context_lens, sm_scale=sm_scale,
+                              tree_anc=tree_anc, tree_rows=tree_rows)
     out = out_n[jnp.clip(slot, 0, s - 1),
                 jnp.clip(local, 0, wn - 1)]                    # [R,H,D]
     if w <= wn:
@@ -718,7 +880,8 @@ def paged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables,
                            context_lens, q_lens, row_starts, row_slot,
-                           narrow_iota, win_iota, sm_scale=None):
+                           narrow_iota, win_iota, sm_scale=None,
+                           tree_anc=None, tree_slots=None):
     """Ragged mixed-batch paged attention over ONE packed row buffer;
     q: [R, H, D] (every live query row of a serving tick, partitioned
     by per-slot ``q_lens``/``row_starts``; ``row_slot[r]`` names row
@@ -728,8 +891,10 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables,
     call: ``w_narrow`` (= gamma+1, the decode/verify width every slot
     may use) and ``w_max`` (the chunk ceiling — AT MOST ONE slot per
     call may carry more than ``w_narrow`` rows; the serving scheduler
-    guarantees it). Routes to the ragged Pallas grid on TPU, the
-    two-lane verify fallback elsewhere."""
+    guarantees it). ``tree_anc``/``tree_slots`` (see
+    ``spec_tree_scope``) mask the flagged slots' windows by ancestor
+    path. Routes to the ragged Pallas grid on TPU, the two-lane
+    verify fallback elsewhere."""
     import types
     wn = int(narrow_iota.shape[0])
     w = int(win_iota.shape[0])
@@ -749,24 +914,38 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables,
     if use_kernel:
         return pallas_ragged_paged_attention(
             q, k_pool, v_pool, block_tables, context_lens, q_lens,
-            row_starts, row_slot=row_slot, w_max=w, sm_scale=sm_scale)
+            row_starts, row_slot=row_slot, w_max=w, sm_scale=sm_scale,
+            tree_anc=tree_anc, tree_slots=tree_slots)
     return _xla_ragged_paged(q, k_pool, v_pool, block_tables,
                              context_lens, q_lens, row_starts,
-                             row_slot, wn, w, sm_scale=sm_scale)
+                             row_slot, wn, w, sm_scale=sm_scale,
+                             tree_anc=tree_anc, tree_slots=tree_slots)
 
 
 def ragged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
                           cache_lens, q_lens, row_starts, row_slot,
                           row_pos, narrow_iota, win_iota,
-                          sm_scale=None):
+                          sm_scale=None, tree_anc=_AMBIENT,
+                          tree_slots=_AMBIENT):
     """Write + attend for the ragged mixed-batch serving step: scatter
     this tick's per-row K/V ([R, H_kv, D]) into the pool at
     ``(row_slot, row_pos)`` (pad rows null-route) and attend each
     packed query row against its slot's length-bounded block list —
     decode, speculative verify and chunked prefill in ONE launch.
     ``cache_lens[s]`` is the slot's valid length BEFORE this tick's
-    first row. Also the per-shard body of the tensor-parallel wrapper
-    below. Returns ``(out [R, H, D], k_pool, v_pool)``."""
+    first row. ``tree_anc``/``tree_slots`` default to the ambient
+    ``spec_tree_scope`` (how the tree reaches here THROUGH an
+    untouched model forward); pass explicit values (``None`` = force
+    linear) to override — the TP wrapper below does, because the
+    traced flag vector must enter its manual region as an operand.
+    Also the per-shard body of that wrapper. Returns
+    ``(out [R, H, D], k_pool, v_pool)``."""
+    if tree_anc is _AMBIENT or tree_slots is _AMBIENT:
+        amb_anc, amb_slots = _tree_ctx()
+        if tree_anc is _AMBIENT:
+            tree_anc = amb_anc
+        if tree_slots is _AMBIENT:
+            tree_slots = amb_slots if tree_anc is not None else None
     from ..paged_cache import write_rows
     lens = cache_lens.astype(jnp.int32)
     kp2, vp2 = write_rows(k_pool, v_pool, block_tables, row_slot,
@@ -774,7 +953,8 @@ def ragged_attention_step(qh, kh, vh, k_pool, v_pool, block_tables,
     out = ragged_paged_attention(qh, kp2, vp2, block_tables, lens + 1,
                                  q_lens, row_starts, row_slot,
                                  narrow_iota, win_iota,
-                                 sm_scale=sm_scale)
+                                 sm_scale=sm_scale, tree_anc=tree_anc,
+                                 tree_slots=tree_slots)
     return out, kp2, vp2
 
 
@@ -810,12 +990,38 @@ def sharded_ragged_attention_step(qh, kh, vh, k_pool, v_pool,
     heads = P(None, "mp", None)           # [R, H, D] head split
     kspec, vspec = _pool_pspec(k_pool), _pool_pspec(v_pool)
     rows = P(None)
+    # the ambient spec-tree scope resolves OUT HERE: tree_slots is a
+    # traced array and must enter the manual region as a replicated
+    # operand, never a closure; the static parent tuple closes over
+    tree_anc, tree_slots = _tree_ctx()
+    if tree_anc is not None and tree_slots is None:
+        tree_slots = jnp.ones((block_tables.shape[0],), jnp.int32)
+
+    if tree_anc is not None:
+        def local(q, k, v, kp, vp, tables, lens, ql, rs, sl, pos,
+                  nwin, win, ts):
+            return ragged_attention_step(q, k, v, kp, vp, tables,
+                                         lens, ql, rs, sl, pos, nwin,
+                                         win, sm_scale=sm_scale,
+                                         tree_anc=tree_anc,
+                                         tree_slots=ts)
+
+        f = shard_map_compat(
+            local, mesh,
+            in_specs=(heads, heads, heads, kspec, vspec,
+                      P(None, None), rows, rows, rows, rows, rows,
+                      rows, rows, rows),
+            out_specs=(heads, kspec, vspec))
+        return f(qh, kh, vh, k_pool, v_pool, block_tables, cache_lens,
+                 q_lens, row_starts, row_slot, row_pos, narrow_iota,
+                 win_iota, tree_slots)
 
     def local(q, k, v, kp, vp, tables, lens, ql, rs, sl, pos, nwin,
               win):
         return ragged_attention_step(q, k, v, kp, vp, tables, lens,
                                      ql, rs, sl, pos, nwin, win,
-                                     sm_scale=sm_scale)
+                                     sm_scale=sm_scale, tree_anc=None,
+                                     tree_slots=None)
 
     f = shard_map_compat(
         local, mesh,
@@ -931,12 +1137,26 @@ def sharded_paged_attention_step(qh, kh, vh, k_pool, v_pool,
 
 
 def paged_verify_attention(q, k_pool, v_pool, block_tables,
-                           context_lens, sm_scale=None):
+                           context_lens, sm_scale=None,
+                           tree_anc=_AMBIENT):
     """Multi-query ragged paged attention for the speculative verify
     window; q: [S, T, H, D] (T = gamma + 1 tokens per slot, causal
     within the window). ``context_lens[s]`` = positions visible to the
-    slot's FIRST window token, itself included. Routes to the Pallas
-    kernel on TPU, the gather fallback elsewhere."""
+    slot's FIRST window token, itself included. ``tree_anc`` defaults
+    to the ambient ``spec_tree_scope`` (every slot's window becomes a
+    token tree — ``SpecGenerator``'s tree verify arms this through
+    the untouched model forward); the tree never applies to chunked
+    prefill because the scope is only entered around verify traces.
+    Routes to the Pallas kernel on TPU, the gather fallback
+    elsewhere."""
+    if tree_anc is _AMBIENT:
+        tree_anc = _tree_ctx()[0]
+    # a T-row window can only carry a (T-1)-draft tree; the ambient
+    # scope may legitimately cover other widths' traces (prefill
+    # chunks ride the ragged exec, not this one) — mismatches mean
+    # "not a verify window", so the linear bound stands
+    if tree_anc is not None and len(tree_anc) + 1 != q.shape[1]:
+        tree_anc = None
     import types
     # shape-only stand-in for one window token so the shared
     # eligibility predicate applies without building a traced slice
@@ -956,6 +1176,7 @@ def paged_verify_attention(q, k_pool, v_pool, block_tables,
     if use_kernel:
         return pallas_paged_verify_attention(
             q, k_pool, v_pool, block_tables, context_lens,
-            sm_scale=sm_scale)
+            sm_scale=sm_scale, tree_anc=tree_anc)
     return _xla_paged_verify(q, k_pool, v_pool, block_tables,
-                             context_lens, sm_scale=sm_scale)
+                             context_lens, sm_scale=sm_scale,
+                             tree_anc=tree_anc)
