@@ -948,6 +948,121 @@ def _goodput_bench():
     return out
 
 
+def _health_bench():
+    """Fleet health engine (the ISSUE-17 observability bar): two arms
+    on a small serving model. The HEALTHY arm serves a steady workload
+    under generous SLO budgets and pins the false-positive rate — no
+    alert may fire and the health score must stay 1.0. The OVERLOAD
+    arm pins sensitivity — an impossible SLO budget with short burn
+    windows must trip the ``slo_fast_burn`` page within the run, and
+    the auto-captured incident bundle (manifest + stats + journal)
+    must be loadable back from a scratch ``PADDLE_TPU_INCIDENT_DIR``.
+    Absolute latencies are backend-dependent (``cpu_proxy``); the
+    detector arithmetic and the bundle format are not."""
+    import gc
+    import tempfile
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_HEALTH_VOCAB", 8000)),
+        hidden_size=int(os.environ.get("BENCH_HEALTH_HIDDEN", 512)),
+        intermediate_size=int(os.environ.get("BENCH_HEALTH_FFN",
+                                             1408)),
+        num_hidden_layers=int(os.environ.get("BENCH_HEALTH_LAYERS",
+                                             4)),
+        num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=1024, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    new = int(os.environ.get("BENCH_HEALTH_NEW", 16))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size, (p,))
+               for p in (32, 48, 64, 40, 56, 24, 64, 32)]
+    base = dict(num_slots=4, block_size=16, max_model_len=256,
+                max_new_tokens=new)
+
+    # 1) healthy arm: generous budgets (first-wave TTFT includes the
+    # compile on a cold engine) — the pin is ZERO alerts ever fired
+    eng = ServingEngine(model, ServingConfig(
+        **base, health_slo_ttft_ms=600000.0,
+        health_slo_itl_ms=600000.0))
+    for _ in range(2):      # second wave runs post-compile steady state
+        eng.serve([p.copy() for p in prompts], max_new_tokens=new)
+    st_ok = eng.stats()
+    h_ok = eng.health()
+    eng.shutdown()
+    assert st_ok["alerts_fired_total"] == 0, (
+        "healthy arm fired alerts", h_ok)
+    assert st_ok["health_score"] == 1.0, st_ok["health_score"]
+
+    # 2) overload arm: an SLO no backend can meet + short burn windows
+    # so the page trips inside the run; incidents land in a scratch dir
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_bench_incident_")
+    prev = os.environ.get("PADDLE_TPU_INCIDENT_DIR")
+    os.environ["PADDLE_TPU_INCIDENT_DIR"] = tmp
+    try:
+        eng2 = ServingEngine(model, ServingConfig(
+            **base, health_slo_ttft_ms=1e-3, health_slo_itl_ms=1e-3,
+            health_burn_fast_s=0.5, health_burn_slow_s=2.0,
+            health_burn_min_requests=2))
+        for _ in range(2):
+            eng2.serve([p.copy() for p in prompts],
+                       max_new_tokens=new)
+        h = eng2.health()
+        st_bad = eng2.stats()
+        eng2.shutdown()
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_INCIDENT_DIR", None)
+        else:
+            os.environ["PADDLE_TPU_INCIDENT_DIR"] = prev
+    fired = sorted({e["alert"] for e in h["journal"]
+                    if e["state"] == "firing"})
+    assert "slo_fast_burn" in fired, fired
+    assert st_bad["incidents_captured"] >= 1, st_bad
+    bundles = sorted(d for d in os.listdir(tmp)
+                     if not d.startswith(".tmp-"))
+    assert bundles, "overload arm captured no incident bundle"
+    bdir = os.path.join(tmp, bundles[0])
+    with open(os.path.join(bdir, "manifest.json")) as f:
+        manifest = json.load(f)
+    with open(os.path.join(bdir, "stats.json")) as f:
+        bstats = json.load(f)
+    assert manifest["alert"] in fired, manifest
+    assert "health_score" in bstats and "roofline" in bstats
+
+    out = {
+        "healthy": {
+            "health_score": st_ok["health_score"],
+            "alerts_fired_total": st_ok["alerts_fired_total"],
+            "nonfinite_logits_ticks":
+                st_ok["nonfinite_logits_ticks"],
+        },
+        "overload": {
+            "alerts_fired_total": st_bad["alerts_fired_total"],
+            "alerts_fired": fired,
+            "burn_rate_fast": round(h["burn_rate"]["fast"], 3),
+            "incidents_captured": st_bad["incidents_captured"],
+            "incident_bundle": bundles[0],
+            "bundle_files": sorted(os.listdir(bdir)),
+        },
+        # trajectory keys: alerts fired under overload (sensitivity)
+        # and whether the bundle round-tripped (capture path health)
+        "health_alerts_fired": st_bad["alerts_fired_total"],
+        "health_incident_captured": bool(bundles),
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model, eng, eng2
+    gc.collect()
+    return out
+
+
 def _preempt_bench():
     """FIFO vs preemptive scheduling under mixed-priority overload
     (the ISSUE-14 bar): the same closed-loop workload — a few LONG
@@ -2302,6 +2417,10 @@ def main():
         flashmask = _flashmask_bench()
     except Exception as exc:
         flashmask = {"error": repr(exc)}
+    try:
+        health = _health_bench()
+    except Exception as exc:
+        health = {"error": repr(exc)}
 
     detail = {"large": large, "base": base,
               "remat_regime": remat_regime, "deep": deep,
@@ -2324,6 +2443,7 @@ def main():
               "fusion": fusion,
               "preempt": preempt,
               "flashmask": flashmask,
+              "health": health,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
               "telemetry": large.get("telemetry")
@@ -2343,8 +2463,8 @@ def main():
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
                          "roofline", "cluster", "fusion", "preempt",
-                         "flashmask", "moe_profile", "moe_fused",
-                         "moe_serving")
+                         "flashmask", "health", "moe_profile",
+                         "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
              if isinstance(decode, dict) else None,
@@ -2480,7 +2600,13 @@ def main():
              if isinstance(preempt, dict) else None,
              "kv_blocks_spilled":
              preempt.get("kv_blocks_spilled")
-             if isinstance(preempt, dict) else None},
+             if isinstance(preempt, dict) else None,
+             "health_alerts_fired":
+             health.get("health_alerts_fired")
+             if isinstance(health, dict) else None,
+             "health_incident_captured":
+             health.get("health_incident_captured")
+             if isinstance(health, dict) else None},
     }
     # trajectory contract (ISSUE 11/12 CI satellites): the goodput SLO
     # and cluster keys must be present in every round's summary — fail
@@ -2493,7 +2619,8 @@ def main():
               "kernels_per_tick_ratio", "preempt_goodput_delta",
               "preempt_ttft_p99_ms", "kv_blocks_spilled",
               "step_mfu", "hbm_bw_util", "roofline_cpu_proxy",
-              "spec_tree_accept_len", "spec_tree_tokens_per_sec"):
+              "spec_tree_accept_len", "spec_tree_tokens_per_sec",
+              "health_alerts_fired", "health_incident_captured"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

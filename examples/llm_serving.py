@@ -564,6 +564,58 @@ def main(argv=None):
     print(f"tree spec (branching corpus, sampled, 5-node budget): "
           f"accepted len {al_tree:.2f} vs linear {al_lin:.2f} "
           f"(+{al_tree - al_lin:.2f} tokens per verify window)")
+
+    # ---- 15. fleet health engine: alerts + incident capture ---------
+    # Healthy arm: generous SLOs (first-wave TTFT includes the compile
+    # on CPU) — the false-positive pin: a clean serve fires NOTHING.
+    scfg15 = dict(num_slots=3, block_size=16, max_model_len=128,
+                  max_new_tokens=16)
+    eng_ok = ServingEngine(branchy, ServingConfig(
+        health_slo_ttft_ms=600000.0, health_slo_itl_ms=600000.0,
+        **scfg15))
+    eng_ok.serve([p.copy() for p in mprompts])
+    st_ok = eng_ok.stats()
+    h_ok = eng_ok.health()
+    eng_ok.shutdown()
+    assert st_ok["health_score"] == 1.0 and st_ok["alerts_firing"] == 0
+    assert st_ok["alerts_fired_total"] == 0
+    assert h_ok["alerts_firing"] == []
+    print(f"health (steady state): score "
+          f"{st_ok['health_score']:.2f}, alerts fired "
+          f"{st_ok['alerts_fired_total']} (false-positive pin holds)")
+
+    # Overload arm: an unmeetable SLO burns the error budget at ~100x
+    # in both burn windows — the fast-burn alert pages and an incident
+    # bundle (manifest + stats + journal) lands on disk, atomically.
+    with tempfile.TemporaryDirectory() as inc_dir:
+        os.environ["PADDLE_TPU_INCIDENT_DIR"] = inc_dir
+        try:
+            eng_bad = ServingEngine(branchy, ServingConfig(
+                health_slo_ttft_ms=1e-3, health_slo_itl_ms=1e-3,
+                health_burn_fast_s=0.5, health_burn_slow_s=2.0,
+                health_burn_min_requests=2, **scfg15))
+            eng_bad.serve([p.copy() for p in mprompts])
+            st_bad = eng_bad.stats()
+            h_bad = eng_bad.health()
+            eng_bad.shutdown()
+        finally:
+            del os.environ["PADDLE_TPU_INCIDENT_DIR"]
+        assert st_bad["alerts_fired_total"] > 0
+        fired15 = {e["alert"] for e in h_bad["journal"]}
+        assert "slo_fast_burn" in fired15, fired15
+        bundles = sorted(d for d in os.listdir(inc_dir)
+                         if d.startswith("incident-"))
+        assert bundles, "overload fired but captured no incident"
+        import json as _json
+        man = _json.load(open(os.path.join(
+            inc_dir, bundles[0], "manifest.json")))
+        snap = _json.load(open(os.path.join(
+            inc_dir, bundles[0], "stats.json")))
+        assert man["alert"] in fired15 and "roofline" in snap
+        print(f"health (overload): burn fast "
+              f"{h_bad['burn_rate']['fast']:.0f}x budget, fired "
+              f"{sorted(fired15)}, incident bundle "
+              f"{bundles[0]} (manifest+stats+journal loadable)")
     return n_ok / 12.0, losses
 
 

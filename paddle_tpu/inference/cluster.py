@@ -92,6 +92,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import monitor
+from ..monitor import health as _health
 from ..monitor import tracing as _tracing
 from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
@@ -344,6 +345,15 @@ class EngineCluster:
             "serving_router_queue_depth",
             "per-replica queued + active depth at the router's last "
             "scoring pass", labels=("replica",))
+        # -- fleet health engine (ISSUE 17) ---------------------------
+        # the cluster's own watchdog sweep + incident sink: a replica
+        # whose tick blows its deadline feeds the existing
+        # fail_replica drain, and the cluster-level incident bundle
+        # (merged trace, full fleet stats) captures the scene first.
+        # Off exactly when the replicas' health engines are off.
+        self._health_on = self._engines[0]._health is not None
+        self._incident = (_health.IncidentCapture()
+                          if self._health_on else None)
 
     # -- public API ---------------------------------------------------
 
@@ -496,6 +506,8 @@ class EngineCluster:
             if eng.num_queued or eng.num_active:
                 self._safe_step(i)
         self._collect_done()
+        if self._health_on:
+            self._watchdog_sweep()
         if self._trace is not None:
             self._trace.emit(
                 "cluster tick", tid=0, t0=t0,
@@ -570,6 +582,59 @@ class EngineCluster:
                     f"request {g} shed during the failure drain; "
                     "terminating with the tokens already streamed")
                 self._finish(g)
+
+    def _watchdog_sweep(self):
+        """Per-tick stuck-replica check: a replica whose watchdog
+        trips gets the scene captured (cluster-level incident bundle:
+        merged trace + full fleet stats) and is then drained through
+        the existing ``fail_replica`` path — a wedged replica degrades
+        the fleet instead of freezing it."""
+        for i in list(self._live()):
+            eng = self._engines[i]
+            try:
+                stuck = eng.watchdog_stuck()
+            except Exception:       # pragma: no cover - defensive
+                stuck = True
+            if not stuck:
+                continue
+            warnings.warn(
+                f"replica {i} failed its stuck-tick watchdog "
+                "deadline; draining it through fail_replica()")
+            if self._incident is not None:
+                h = eng.health()
+                try:
+                    self._incident.maybe_capture(
+                        "stuck_tick", "page", stats_cb=self.stats,
+                        trace_cb=self.export_trace,
+                        journal=(h or {}).get("journal", []))
+                except Exception:
+                    pass            # capture never takes the fleet down
+            self.fail_replica(i)
+
+    def health(self) -> Optional[dict]:
+        """Fleet health roll-up: the minimum replica score, the union
+        of firing alerts, the failed set, and every replica's own
+        snapshot. None when the health engine is off."""
+        if not self._health_on:
+            return None
+        reps = []
+        for i, eng in enumerate(self._engines):
+            if i in self._failed:
+                reps.append(None)
+                continue
+            try:
+                reps.append(eng.health())
+            except Exception:       # pragma: no cover - torn down
+                reps.append(None)
+        live = [r for r in reps if r is not None]
+        return {
+            "health_score": min((r["health_score"] for r in live),
+                                default=0.0),
+            "alerts_firing": sorted(
+                {a for r in live for a in r["alerts_firing"]}),
+            "failed_replicas": sorted(self._failed),
+            "replicas": reps,
+        }
 
     def owner_of(self, request_id: int) -> Optional[Tuple[int, int]]:
         """Current ``(replica_index, local_rid)`` of a LIVE request,
@@ -672,21 +737,48 @@ class EngineCluster:
         """Cluster-aggregate snapshot: per-replica ``stats()`` dicts
         under ``replicas`` plus rolled-up routing / transfer /
         throughput / latency keys (the client-side view across the
-        whole cluster — the goodput harness's denominators)."""
-        reps = [e.stats() for e in self._engines]
+        whole cluster — the goodput harness's denominators). Failed or
+        torn-down replicas are SKIPPED in the roll-ups (annotated in
+        ``failed_replicas``, None in ``replicas``) instead of raising
+        — the fleet snapshot must survive its own casualties."""
+        reps_all: List[Optional[dict]] = []
+        skipped = set(self._failed)
+        for i, e in enumerate(self._engines):
+            if i in self._failed:
+                reps_all.append(None)
+                continue
+            try:
+                reps_all.append(e.stats())
+            except Exception:       # torn down mid-snapshot
+                skipped.add(i)
+                reps_all.append(None)
+        live_idx = [i for i, r in enumerate(reps_all) if r is not None]
+        reps = [reps_all[i] for i in live_idx]
         # headline roofline roll-up: the busiest replica's numbers as
         # a PAIR from that ONE replica — a per-metric max could
         # combine an MFU and a bandwidth figure no single replica
         # exhibits, which is useless for bound classification
-        busy = max(range(len(reps)), key=lambda i: (
-            reps[i]["roofline"]["step_mfu"],
-            reps[i]["roofline"]["step_hbm_bw_util"]))
+        if reps:
+            busy = max(range(len(reps)), key=lambda i: (
+                reps[i]["roofline"]["step_mfu"],
+                reps[i]["roofline"]["step_hbm_bw_util"]))
+            roofline = {
+                "cpu_proxy": any(r["roofline"]["cpu_proxy"]
+                                 for r in reps),
+                "busiest_replica": live_idx[busy],
+                "step_mfu": reps[busy]["roofline"]["step_mfu"],
+                "step_hbm_bw_util":
+                    reps[busy]["roofline"]["step_hbm_bw_util"],
+            }
+        else:                       # every replica down
+            roofline = {"cpu_proxy": False, "busiest_replica": None,
+                        "step_mfu": 0.0, "step_hbm_bw_util": 0.0}
         return {
             "num_replicas": len(self._decode_idx),
             "prefill_replicas": len(self._prefill_idx),
             "disaggregated": self._disagg,
             "cluster_enabled": cluster_enabled(),
-            "failed_replicas": sorted(self._failed),
+            "failed_replicas": sorted(skipped),
             "active": self.num_active,
             "queued": self.num_queued,
             "pending_handoffs": len(self._pending),
@@ -723,15 +815,22 @@ class EngineCluster:
                  if self._trace is not None else 0)
                 + sum(r["trace_events_dropped"] for r in reps),
             "profile_captures": self._prof.captures,
-            "roofline": {
-                "cpu_proxy": any(r["roofline"]["cpu_proxy"]
-                                 for r in reps),
-                "busiest_replica": busy,
-                "step_mfu": reps[busy]["roofline"]["step_mfu"],
-                "step_hbm_bw_util":
-                    reps[busy]["roofline"]["step_hbm_bw_util"],
-            },
-            "replicas": reps,
+            # fleet health (ISSUE 17): ALWAYS present — min score over
+            # live replicas, sums for the counters; a killed fleet
+            # reports 1.0 / zeros
+            "health_score": min((r["health_score"] for r in reps),
+                                default=0.0 if skipped else 1.0),
+            "alerts_firing": sum(r["alerts_firing"] for r in reps),
+            "alerts_fired_total":
+                sum(r["alerts_fired_total"] for r in reps),
+            "incidents_captured":
+                sum(r["incidents_captured"] for r in reps)
+                + (self._incident.captured
+                   if self._incident is not None else 0),
+            "nonfinite_logits_ticks":
+                sum(r["nonfinite_logits_ticks"] for r in reps),
+            "roofline": roofline,
+            "replicas": reps_all,
         }
 
     def shutdown(self, check_leaks: bool = True) -> bool:

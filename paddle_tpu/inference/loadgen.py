@@ -260,17 +260,20 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
                        offered_qps=offered, mode=mode)
     if record_path is not None:
         report["record_path"] = write_records(records.values(),
-                                              record_path)
+                                              record_path, slo=slo)
     return report
 
 
-def write_records(records, path: str) -> str:
+def write_records(records, path: str, slo: Optional[SLO] = None) -> str:
     """One NDJSON row per request (ISSUE 15 satellite): submit /
     first-token / last-token timestamps (``time.monotonic()``
     seconds — the SAME clock base the span tracer exports, whose
     Chrome ``ts`` is monotonic microseconds, so rows join against a
     merged trace by rid + time), priority, routed replica and
-    outcome. Returns ``path``."""
+    outcome. With ``slo``, each row also carries ``slo_met``
+    (ISSUE 17 satellite: TTFT+TPOT vs the configured SLO — the
+    health engine's burn-rate inputs, validatable offline against
+    the recorded trace). Returns ``path``."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
@@ -295,6 +298,8 @@ def write_records(records, path: str) -> str:
                 "outcome": "completed" if r.completed
                 else "no_tokens",
             }
+            if slo is not None:
+                row["slo_met"] = bool(r.meets(slo))
             f.write(json.dumps(row) + "\n")
     return path
 

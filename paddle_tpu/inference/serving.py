@@ -215,6 +215,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import monitor
 from ..distributed import moe as _moe
+from ..monitor import health as _health
 from ..monitor import tracing as _tracing
 from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
@@ -423,6 +424,34 @@ class ServingConfig:
     # engines keep the unfused projections (an opaque pallas_call
     # cannot be partitioned).
     fused_decode: bool = True
+    # fleet health engine (monitor/health.py): SLO burn-rate monitors,
+    # anomaly detectors, a stuck-tick watchdog, and incident
+    # auto-capture over signals the engine already produces. Pure host
+    # code: under PADDLE_TPU_HEALTH=0 (beats an explicit True) the
+    # monitor is never constructed and tokens + executables_compiled
+    # stay bit-for-bit identical.
+    health: bool = True
+    # per-request SLO for burn-rate attainment (ms); a request misses
+    # its SLO when TTFT exceeds health_slo_ttft_ms or any inter-token
+    # latency exceeds health_slo_itl_ms.
+    health_slo_ttft_ms: float = 2000.0
+    health_slo_itl_ms: float = 500.0
+    # SLO target (error budget = 1 - target) and SRE fast/slow burn
+    # windows: the fast alert pages only when BOTH windows burn faster
+    # than health_burn_threshold x budget and the fast window holds at
+    # least health_burn_min_requests retirements.
+    health_slo_target: float = 0.99
+    health_burn_fast_s: float = 5.0
+    health_burn_slow_s: float = 60.0
+    health_burn_threshold: float = 2.0
+    health_burn_min_requests: int = 8
+    # stuck-tick watchdog deadline: max(floor, mult x step-time EMA).
+    health_watchdog_mult: float = 50.0
+    health_watchdog_floor_s: float = 5.0
+    # arm a ProfilerWindow for this many ticks when an alert fires
+    # (0 = off; needs PADDLE_TPU_PROFILE_DIR or an explicit path to
+    # land anywhere).
+    health_profile_ticks: int = 0
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -447,6 +476,22 @@ class ServingConfig:
             raise ValueError(
                 f"shed_queue_depth must be >= 1 (or None), got "
                 f"{self.shed_queue_depth!r}")
+        if not 0.0 < self.health_slo_target < 1.0:
+            raise ValueError(
+                f"health_slo_target must be in (0, 1), got "
+                f"{self.health_slo_target!r}")
+        if not 0.0 < self.health_burn_fast_s < self.health_burn_slow_s:
+            raise ValueError(
+                f"need 0 < health_burn_fast_s < health_burn_slow_s, got "
+                f"{self.health_burn_fast_s!r}, {self.health_burn_slow_s!r}")
+        if self.health_watchdog_floor_s <= 0:
+            raise ValueError(
+                f"health_watchdog_floor_s must be > 0, got "
+                f"{self.health_watchdog_floor_s!r}")
+        if self.health_watchdog_mult < 1.0:
+            raise ValueError(
+                f"health_watchdog_mult must be >= 1, got "
+                f"{self.health_watchdog_mult!r}")
 
 
 def _num_experts(cfg) -> int:
@@ -1228,6 +1273,64 @@ class ServingEngine:
                 "serving_spec_acceptance_rate",
                 "accepted / proposed draft tokens (cumulative)")
 
+        # -- fleet health engine (monitor/health.py) ------------------
+        # Gauges register UNCONDITIONALLY (the always-present metrics
+        # contract); the monitor itself only exists when the kill
+        # switch is up. Under PADDLE_TPU_HEALTH=0 every health hook is
+        # a no-op and the compiled executables are bit-identical (the
+        # nonfinite probe output is always computed; only the HOST
+        # fetch is gated).
+        self._health_on = (bool(getattr(cfg, "health", True))
+                           and os.environ.get("PADDLE_TPU_HEALTH", "1")
+                           != "0")
+        self._m_health = monitor.gauge(
+            "serving_health_score",
+            "engine health in [0,1]: 1 - severity penalties of firing "
+            "alerts (page 0.5, warn 0.15)")
+        self._m_burn = monitor.gauge(
+            "serving_slo_burn_rate",
+            "fast-window SLO burn rate (violation fraction / error "
+            "budget; 1.0 = budget consumed exactly on schedule)")
+        self._m_alerts = monitor.gauge(
+            "serving_alerts_firing", "number of currently-firing alerts")
+        self._m_health.set(1.0)
+        self._nonfinite_ticks = 0
+        self._nf_last = False
+        self._slo_ok: Dict[int, bool] = {}
+        self._h_slo_ttft = float(cfg.health_slo_ttft_ms)
+        self._h_slo_itl = float(cfg.health_slo_itl_ms)
+        if self._health_on:
+            profile_cb = None
+            if int(cfg.health_profile_ticks) > 0:
+                n_prof = int(cfg.health_profile_ticks)
+
+                def profile_cb(n=n_prof):
+                    try:
+                        self.profile(n)
+                    except Exception:
+                        pass
+            self._health = _health.HealthMonitor(
+                slo_target=cfg.health_slo_target,
+                burn_fast_s=cfg.health_burn_fast_s,
+                burn_slow_s=cfg.health_burn_slow_s,
+                burn_threshold=cfg.health_burn_threshold,
+                burn_min_requests=cfg.health_burn_min_requests,
+                watchdog_mult=cfg.health_watchdog_mult,
+                watchdog_floor_s=cfg.health_watchdog_floor_s,
+                stats_cb=self.stats,
+                trace_cb=self._health_trace,
+                profile_cb=profile_cb,
+                incident=_health.IncidentCapture())
+        else:
+            self._health = None
+
+    def _health_trace(self):
+        """Chrome-trace dict for incident bundles (None w/o a tracer)."""
+        if self._trace is None:
+            return None
+        return {"traceEvents": list(self._trace.chrome_events()),
+                "displayTimeUnit": "ms"}
+
     # -- public API ---------------------------------------------------
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
@@ -1385,6 +1488,7 @@ class ServingEngine:
             self._submit_t.pop(rid, None)
             self._d_e2e.observe(
                 1000.0 * (time.monotonic() - req.submit_time))
+        self._slo_ok.pop(rid, None)
         self._last_emit.pop(rid, None)
         toks = self._results.pop(rid, None)
         if self.config.retain_results and (
@@ -1401,6 +1505,7 @@ class ServingEngine:
         t0 = self._submit_t.pop(slot.rid, None)
         if t0 is not None:
             self._d_e2e.observe(1000.0 * (now - t0))
+        self._slo_ok.pop(slot.rid, None)    # cancels don't burn budget
         self._last_emit.pop(slot.rid, None)
         if self._trace is not None:
             self._trace.emit(
@@ -1474,8 +1579,44 @@ class ServingEngine:
         profiling window (``profile(n_ticks)``) brackets the tick —
         the capture starts before the first armed tick and stops
         after the last, bounding the profile to exactly N ticks."""
+        if self._health is None:
+            with self._prof.tick():
+                return self._step_dispatch()
+        t0 = time.monotonic()
+        c0 = self._n_exec_compiled
         with self._prof.tick():
-            return self._step_dispatch()
+            out = self._step_dispatch()
+        self._health_tick(t0, time.monotonic(), c0)
+        return out
+
+    def _health_tick(self, t0: float, t1: float, c0: int) -> None:
+        """Feed one tick's signals to the health monitor (host only)."""
+        h = self._health
+        nf = self._nf_last
+        self._nf_last = False
+        if nf:
+            self._nonfinite_ticks += 1
+        ema = self._step_time.get(
+            "verify" if self._gamma else "decode", 0.0)
+        try:
+            fallbacks = sum(_pa.kernel_fallback_counts().values())
+        except Exception:
+            fallbacks = 0
+        h.on_tick(
+            tick_s=t1 - t0,
+            queued=len(self._queue),
+            step_ema_s=ema,
+            fallbacks=fallbacks,
+            compiles=self._n_exec_compiled,
+            spec_emitted=self._n_spec_emitted,
+            spec_verifies=self._n_spec_verifies,
+            preemptions=self._n_preempt,
+            completed=self._n_completed,
+            nonfinite=nf,
+            compiled=self._n_exec_compiled > c0)
+        self._m_health.set(h.score())
+        self._m_burn.set(h._last_burn.get("fast", 0.0))
+        self._m_alerts.set(float(len(h.firing())))
 
     def _step_dispatch(self) -> List[tuple]:
         if self._ragged:
@@ -1909,7 +2050,9 @@ class ServingEngine:
         acc_lens = {}
         if not g:
             tok_arr = np.asarray(outs[0])
-            self._pools = outs[1]
+            if self._health is not None:        # host fetch gated on
+                self._nf_last = bool(outs[1])   # the kill switch only
+            self._pools = outs[2]
             t_sync = time.monotonic()
             for i in active:
                 slot = self._slots[i]
@@ -1926,11 +2069,12 @@ class ServingEngine:
             tok_arr = np.asarray(outs[0])       # prefill first tokens
             out = np.asarray(outs[1])
             accept = np.asarray(outs[2])
+            k = 4 if self._heads is not None else 3
             if self._heads is not None:
                 props_next = np.asarray(outs[3])
-                self._pools = outs[4]
-            else:
-                self._pools = outs[3]
+            if self._health is not None:        # gated host fetch
+                self._nf_last = bool(outs[k])
+            self._pools = outs[k + 1]
             t_sync = time.monotonic()
             for i in active:
                 acc_lens[i] = self._commit_verify_window(
@@ -2147,6 +2291,21 @@ class ServingEngine:
             "spec_accept_len": self._d_accept.summary(),
             "spec_tree_nodes": (len(self._spec_tree) + 1)
             if self._spec_tree is not None else 0,
+            # fleet-health keys: ALWAYS present (1.0 score / zeros
+            # under the PADDLE_TPU_HEALTH=0 kill switch) so
+            # dashboards never KeyError across a mixed or rolled-back
+            # fleet. alerts_firing is a COUNT here; the named set
+            # lives in engine.health()["alerts_firing"].
+            "health_score": self._health.score()
+            if self._health is not None else 1.0,
+            "alerts_firing": len(self._health.firing())
+            if self._health is not None else 0,
+            "alerts_fired_total": self._health.fired_total
+            if self._health is not None else 0,
+            "incidents_captured": self._health._incident.captured
+            if self._health is not None
+            and self._health._incident is not None else 0,
+            "nonfinite_logits_ticks": self._nonfinite_ticks,
         }
         if self._gamma:
             out.update({
@@ -2160,6 +2319,25 @@ class ServingEngine:
                     if self._n_spec_verifies else 0.0,
             })
         return out
+
+    def health(self) -> Optional[dict]:
+        """Health snapshot: score, firing alerts, burn rates, per-alert
+        state, and the recent transition journal. None when the health
+        engine is off (``health=False`` or ``PADDLE_TPU_HEALTH=0``)."""
+        if self._health is None:
+            return None
+        return self._health.snapshot()
+
+    def watchdog_stuck(self) -> bool:
+        """Stuck-tick watchdog probe (the cluster sweep calls this
+        between ticks): True when this engine's last completed
+        non-compile tick blew the deadline ``max(floor, mult x
+        step-EMA)``. Always False when the health engine is off."""
+        if self._health is None:
+            return False
+        ema = self._step_time.get(
+            "verify" if self._gamma else "decode", 0.0)
+        return self._health.watchdog_check(ema)
 
     def shutdown(self, check_leaks: bool = True) -> bool:
         """Engine teardown hook (tests / graceful ops restarts):
@@ -2682,9 +2860,15 @@ class ServingEngine:
         if prev is None:                # this request's FIRST token
             t0 = self._submit_t.get(rid)
             if t0 is not None:
-                self._d_ttft.observe(1000.0 * (now - t0))
+                ttft_ms = 1000.0 * (now - t0)
+                self._d_ttft.observe(ttft_ms)
+                if self._health is not None:
+                    self._slo_ok[rid] = ttft_ms <= self._h_slo_ttft
         else:
-            self._d_itl.observe(1000.0 * (now - prev))
+            itl_ms = 1000.0 * (now - prev)
+            self._d_itl.observe(itl_ms)
+            if self._health is not None and itl_ms > self._h_slo_itl:
+                self._slo_ok[rid] = False
         self._last_emit[rid] = now
         self._results[rid].append(tok)
         self._m_tokens.inc()
@@ -3711,6 +3895,13 @@ class ServingEngine:
         t0 = self._submit_t.pop(slot.rid, None)
         if t0 is not None:
             self._d_e2e.observe(1000.0 * (now - t0))
+        if self._health is not None:
+            # burn-rate intake: a retirement that never hit a latency
+            # violation counts as SLO-met (requests retired before the
+            # first token never entered _slo_ok)
+            self._health.on_request(self._slo_ok.pop(slot.rid, True))
+        else:
+            self._slo_ok.pop(slot.rid, None)
         self._last_emit.pop(slot.rid, None)
         if self._trace is not None:
             # the request's whole residency on this slot, admission to
@@ -3985,9 +4176,15 @@ class ServingEngine:
                 rows = jnp.take(lg, last_rows.astype(jnp.int32),
                                 axis=0)
                 rows = self._gather_logits(rows)    # the ONE collective
+                # health probe: one any(~isfinite) reduction over the
+                # rows already gathered for sampling — a scalar OUTPUT
+                # of the same executable, never a new one. Always
+                # computed (executable stays bit-identical under
+                # PADDLE_TPU_HEALTH=0); only the host fetch is gated.
+                nf = jnp.any(~jnp.isfinite(rows))
                 _, sel = jax.random.split(key)
                 tok, _ = self._select_rows(rows, sel, samp)
-                return tok, pools
+                return tok, nf, pools
             toks = rest[0]
             if tree is not None:
                 heads = rest[1] if heads_on else None
@@ -4005,6 +4202,7 @@ class ServingEngine:
             rows = jnp.take(lg, jnp.clip(take, 0, r - 1).reshape(-1),
                             axis=0)
             rows = self._gather_logits(rows)
+            nf = jnp.any(~jnp.isfinite(rows))   # health probe (see g=0)
             rows = rows.reshape(toks.shape[0], g + 2, -1)
             sel_key, acc_key = jax.random.split(key)
             first_tok, _ = self._select_rows(rows[:, 0, :], sel_key,
@@ -4017,7 +4215,7 @@ class ServingEngine:
             if tree is None:
                 out, accept, _logp = _spec.accept_from_filtered(
                     f, toks, dq, acc_key, gamma=g, do_sample=do_sample)
-                return first_tok, out, accept, pools
+                return first_tok, out, accept, nf, pools
             out, accept, _logp, path, n_acc = \
                 _spec.accept_tree_from_filtered(
                     f, toks, tree, acc_key, do_sample=do_sample)
@@ -4031,7 +4229,7 @@ class ServingEngine:
                 _pc.permute_window(kp, vp, tables, base, path, n_keep)
                 for (kp, vp) in pools]
             if not heads_on:
-                return first_tok, out, accept, pools
+                return first_tok, out, accept, nf, pools
             # next tick's tree proposal from the draft heads, drafted
             # off the accepted path's FINAL hidden row (the row whose
             # LM-head logits produced the bonus token): head d-1
@@ -4048,7 +4246,7 @@ class ServingEngine:
                 [tidx[self._tree_depth[k + 1] - 1][:,
                       self._tree_sib[k]] for k in range(g)],
                 axis=1).astype(jnp.int32)
-            return first_tok, out, accept, props, pools
+            return first_tok, out, accept, props, nf, pools
 
         jitted = jax.jit(ragged, donate_argnums=(1,))
         name = "verify" if g else "decode"

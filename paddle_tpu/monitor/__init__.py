@@ -42,6 +42,9 @@ from .accounting import (analytic_mfu, collective_census,
 from .digest import LatencyDigest, P2Quantile
 from .tracing import (ProfilerWindow, Tracer, next_flow_id,
                       tracing_enabled)
+from .health import (ALERT_SEVERITY, BurnRateMonitor, CollapseDetector,
+                     EwmaSpikeDetector, HealthMonitor, IncidentCapture,
+                     RatioDetector, StormDetector, TrendDetector)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Info", "Registry",
@@ -55,6 +58,9 @@ __all__ = [
     "step_report", "step_reports", "sample_device_memory",
     "analytic_mfu", "device_peak_flops", "device_peak_hbm_bw",
     "executable_cost",
+    "ALERT_SEVERITY", "BurnRateMonitor", "CollapseDetector",
+    "EwmaSpikeDetector", "HealthMonitor", "IncidentCapture",
+    "RatioDetector", "StormDetector", "TrendDetector",
 ]
 
 

@@ -100,3 +100,20 @@ def test_every_always_present_stats_key_is_documented():
     assert not missing, (
         "always-present stats() keys undocumented — add them to the "
         f"docs/OPS.md stats tables: {missing}")
+
+
+def test_every_alert_name_is_documented():
+    """ISSUE 17 satellite: every alert in the health engine's registry
+    must appear as a backticked literal in docs/OPS.md — an alert a
+    pager can fire must be explained where the operator will look it
+    up. (In-process: ALERT_SEVERITY is a module-level constant, no
+    registry pollution to guard against.)"""
+    from paddle_tpu.monitor.health import ALERT_SEVERITY
+    assert len(ALERT_SEVERITY) >= 10
+    assert set(ALERT_SEVERITY.values()) <= {"page", "warn"}
+    with open(os.path.join(_ROOT, "docs", "OPS.md")) as f:
+        ops = f.read()
+    missing = sorted(a for a in ALERT_SEVERITY if f"`{a}`" not in ops)
+    assert not missing, (
+        "alerts can fire but are undocumented — add them to the "
+        f"docs/OPS.md fleet-health section: {missing}")
