@@ -2264,6 +2264,136 @@ def _moe_fused_bench():
     }
 
 
+def _lora_bench():
+    """Batched multi-LoRA serving (the ISSUE-18 bar): a mixed-tenant
+    workload — requests round-robined over N adapters — served as ONE
+    mixed-adapter ragged batch (per-slot adapter ids, grouped delta
+    matmuls) vs SEQUENTIAL per-adapter serving (each tenant's requests
+    drained alone, the one-adapter-at-a-time deployment batching
+    replaces). Both arms run identical requests on the same engine
+    shape; the batched arm's win is slot occupancy — cross-tenant rows
+    share every tick. Off-TPU the absolute tok/s is a structure proxy
+    (``cpu_proxy``), but batched >= sequential holds on CPU too
+    because the per-tick launch overhead amortizes across tenants.
+    Also pinned: ZERO steady-state recompiles while adapters churn
+    through a resident window SMALLER than the tenant count (LRU
+    spills to the host tier and back, values swap at fixed shapes),
+    and the resident/swap trajectory the stats() keys report."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_LORA_VOCAB", 8000)),
+        hidden_size=int(os.environ.get("BENCH_LORA_HIDDEN", 1024)),
+        intermediate_size=int(os.environ.get("BENCH_LORA_FFN", 2816)),
+        num_hidden_layers=int(os.environ.get("BENCH_LORA_LAYERS", 4)),
+        num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=512, dtype="bfloat16")
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_LORA_SLOTS", 8))
+    new = int(os.environ.get("BENCH_LORA_NEW", 32))
+    n_adapters = int(os.environ.get("BENCH_LORA_ADAPTERS", 4))
+    n_req = int(os.environ.get("BENCH_LORA_REQS", 16))
+    rank = int(os.environ.get("BENCH_LORA_RANK", 16))
+    plens = [32, 64, 96, 48]
+    rng = np.random.RandomState(0)
+    # identical requests for both arms: (prompt, adapter) pairs,
+    # tenants round-robined so the batched arm always mixes adapters
+    reqs = [(rng.randint(1, cfg.vocab_size, (plens[i % len(plens)],)),
+             1 + i % n_adapters) for i in range(n_req)]
+
+    def weights(seed):
+        r = np.random.RandomState(seed)
+        h = cfg.hidden_size
+        kv = h * cfg.num_key_value_heads // cfg.num_attention_heads
+        return {n: (r.normal(0, 0.02, (h, rank)).astype(np.float32),
+                    r.normal(0, 0.02, (rank, kv if n in
+                             ("k_proj", "v_proj") else h))
+                    .astype(np.float32))
+                for n in ("q_proj", "k_proj", "v_proj", "o_proj")}
+
+    def mk_engine(max_adapters):
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=32, max_model_len=256,
+            max_new_tokens=new, prefill_chunk=64,
+            lora_rank=rank, max_adapters=max_adapters))
+        for aid in range(1, n_adapters + 1):
+            eng.load_adapter(aid, weights(100 + aid))
+        # warmup: compile the ONE tick executable off the clock
+        eng.submit(rng.randint(1, cfg.vocab_size, (16,)), 4,
+                   adapter_id=1)
+        eng.run()
+        return eng
+
+    def measure(eng, groups):
+        """Serve ``groups`` (list of request lists, drained one group
+        at a time) and return tok/s + compile/residency accounting."""
+        st0 = eng.stats()
+        tokens0, comp0 = st0["tokens_total"], st0[
+            "executables_compiled"]
+        resident_traj = [st0["lora_adapters_resident"]]
+        t0 = time.perf_counter()
+        for group in groups:
+            for prompt, aid in group:
+                eng.submit(prompt.copy(), new, adapter_id=aid)
+            eng.run()
+            resident_traj.append(
+                eng.stats()["lora_adapters_resident"])
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "wall_s": round(wall, 3),
+            "executables_compiled": st["executables_compiled"],
+            "recompiles_measured":
+                st["executables_compiled"] - comp0,
+            "lora_adapters_resident": st["lora_adapters_resident"],
+            "lora_adapter_swaps": st["lora_adapter_swaps"],
+            "lora_host_tier_bytes": st["lora_host_tier_bytes"],
+            "adapters_resident_trajectory": resident_traj,
+        }
+
+    # batched arm: every tenant in flight at once, one ragged batch
+    eng = mk_engine(max_adapters=n_adapters)
+    batched = measure(eng, [reqs])
+    eng.shutdown()
+    # sequential arm: one tenant at a time (same engine shape), the
+    # per-adapter deployment the batched path replaces
+    eng = mk_engine(max_adapters=n_adapters)
+    by_tenant = [[r for r in reqs if r[1] == aid]
+                 for aid in range(1, n_adapters + 1)]
+    sequential = measure(eng, by_tenant)
+    eng.shutdown()
+    # churn arm: resident window SMALLER than the tenant count — LRU
+    # spill/reload on a live engine, still zero recompiles
+    eng = mk_engine(max_adapters=max(2, n_adapters // 2))
+    churn = measure(eng, by_tenant)
+    eng.shutdown()
+    out = {
+        "batched": batched,
+        "sequential": sequential,
+        "churn_small_window": churn,
+        "batched_speedup": round(
+            batched["aggregate_tokens_per_sec"]
+            / max(sequential["aggregate_tokens_per_sec"], 1e-9), 3),
+        "churn_recompiles": churn["recompiles_measured"],
+        "num_adapters": n_adapters, "rank": rank,
+        "num_slots": slots, "requests": n_req,
+        "cpu_proxy": jax.default_backend() != "tpu",
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", 10))
     base = _train_config(
@@ -2421,6 +2551,10 @@ def main():
         health = _health_bench()
     except Exception as exc:
         health = {"error": repr(exc)}
+    try:
+        lora = _lora_bench()
+    except Exception as exc:
+        lora = {"error": repr(exc)}
 
     detail = {"large": large, "base": base,
               "remat_regime": remat_regime, "deep": deep,
@@ -2444,6 +2578,7 @@ def main():
               "preempt": preempt,
               "flashmask": flashmask,
               "health": health,
+              "lora": lora,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
               "telemetry": large.get("telemetry")
@@ -2463,7 +2598,7 @@ def main():
                          "serving_prefix", "serving_tp",
                          "serving_ragged", "kv_quant", "goodput",
                          "roofline", "cluster", "fusion", "preempt",
-                         "flashmask", "health", "moe_profile",
+                         "flashmask", "health", "lora", "moe_profile",
                          "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
@@ -2606,7 +2741,19 @@ def main():
              if isinstance(health, dict) else None,
              "health_incident_captured":
              health.get("health_incident_captured")
-             if isinstance(health, dict) else None},
+             if isinstance(health, dict) else None,
+             "lora_tokens_per_sec":
+             lora.get("batched", {}).get("aggregate_tokens_per_sec")
+             if isinstance(lora, dict) else None,
+             "lora_batched_speedup":
+             lora.get("batched_speedup")
+             if isinstance(lora, dict) else None,
+             "lora_adapters_resident":
+             lora.get("batched", {}).get("lora_adapters_resident")
+             if isinstance(lora, dict) else None,
+             "lora_churn_recompiles":
+             lora.get("churn_recompiles")
+             if isinstance(lora, dict) else None},
     }
     # trajectory contract (ISSUE 11/12 CI satellites): the goodput SLO
     # and cluster keys must be present in every round's summary — fail
@@ -2620,7 +2767,9 @@ def main():
               "preempt_ttft_p99_ms", "kv_blocks_spilled",
               "step_mfu", "hbm_bw_util", "roofline_cpu_proxy",
               "spec_tree_accept_len", "spec_tree_tokens_per_sec",
-              "health_alerts_fired", "health_incident_captured"):
+              "health_alerts_fired", "health_incident_captured",
+              "lora_tokens_per_sec", "lora_batched_speedup",
+              "lora_adapters_resident", "lora_churn_recompiles"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

@@ -16,6 +16,7 @@ from ...framework.core import Tensor
 from ...nn import functional as F
 from ...nn.initializer import Constant, Normal, XavierNormal
 from ...nn.layer.layers import Layer
+from ...ops import lora as _lora
 from ..shard_utils import annotate_param, constraint, mesh_axis_size
 
 __all__ = ["ColumnParallelLinear", "RowParallelLinear",
@@ -54,7 +55,7 @@ class ColumnParallelLinear(Layer):
             y = constraint(y, *([None] * (y.ndim)))  # replicated
         else:
             y = constraint(y, *([None] * (y.ndim - 1) + ["mp"]))
-        return y
+        return _lora.apply(self, x, y)
 
 
 class RowParallelLinear(Layer):
@@ -90,7 +91,7 @@ class RowParallelLinear(Layer):
         y = constraint(y, *([None] * y.ndim))  # forces the mp reduce
         if self.bias is not None:
             y = y + self.bias
-        return y
+        return _lora.apply(self, x, y)
 
 
 class VocabParallelEmbedding(Layer):

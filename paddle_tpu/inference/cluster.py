@@ -175,7 +175,7 @@ class Router:
 
     def route(self, prompt,
               engines: Dict[int, ServingEngine],
-              priority: int = 0
+              priority: int = 0, adapter_id: Optional[int] = None
               ) -> Tuple[int, int, Dict[int, int]]:
         """Pick a replica for ``prompt`` among ``engines`` (index ->
         engine). Returns ``(index, overlap_blocks, depths)`` where
@@ -184,7 +184,12 @@ class Router:
         scheduler: work below ``priority`` is discounted (it can be
         preempted or bypassed, so it barely delays this arrival),
         which steers high-priority traffic toward replicas whose load
-        is preemptible rather than merely toward short queues."""
+        is preemptible rather than merely toward short queues.
+        ``adapter_id`` adds ADAPTER affinity below prefix affinity:
+        among equal prefix overlaps, a replica whose device stacks
+        already hold the adapter wins (seating there skips an LRU
+        swap) — prefix overlap still dominates, because re-prefilling
+        a lost prefix costs more than one adapter row upload."""
         if not engines:
             raise ValueError("route() needs at least one candidate")
         ids = np.asarray(prompt, np.int32).reshape(-1)
@@ -194,10 +199,15 @@ class Router:
         depths = {}
         for idx, eng in engines.items():
             ov = eng.published_overlap(hashes)
+            res = 0
+            if adapter_id is not None:
+                res = int(eng.adapter_resident(adapter_id))
             depth = eng.queue_depth(priority)
             depths[idx] = depth
-            key = (ov, -depth, -idx)    # longest run, then least
-            if best is None or key > best[0]:   # loaded, then lowest i
+            # longest run, then adapter-resident, then least loaded,
+            # then lowest index
+            key = (ov, res, -depth, -idx)
+            if best is None or key > best[0]:
                 best = (key, idx, ov)
         return best[1], best[2], depths
 
@@ -381,7 +391,7 @@ class EngineCluster:
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
                top_k=None, top_p=None, priority=0,
-               max_queue_wait_ms=None) -> int:
+               max_queue_wait_ms=None, adapter_id=None) -> int:
         """Route one request to a replica (prefill tier when
         disaggregated) and queue it there; returns the CLUSTER-global
         request id tokens stream under.
@@ -394,7 +404,13 @@ class EngineCluster:
         tiebreak, orders admission on the owning replica, may preempt
         strictly-lower work there, rides the disaggregated handoff,
         and survives a failure-drain requeue. ``max_queue_wait_ms``
-        bounds the replica-side queue wait (outcome="timeout")."""
+        bounds the replica-side queue wait (outcome="timeout").
+        ``adapter_id`` serves the request under a LoRA adapter
+        registered via :meth:`load_adapter` — it weights the router's
+        tiebreak toward replicas already holding the adapter
+        resident, rides the disaggregated KV handoff (the prefill
+        tier computes the prompt's KV under the adapter), and
+        survives a failure-drain requeue like the sampling knobs."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         if self._disagg:
             # mirror engine.submit()'s pool-fit rejection for the
@@ -427,7 +443,8 @@ class EngineCluster:
         samp = {k: v for k, v in (("temperature", temperature),
                                   ("top_k", top_k), ("top_p", top_p),
                                   ("max_queue_wait_ms",
-                                   max_queue_wait_ms))
+                                   max_queue_wait_ms),
+                                  ("adapter_id", adapter_id))
                 if v is not None}
         if int(priority):
             samp["priority"] = int(priority)
@@ -438,6 +455,21 @@ class EngineCluster:
         self._tokens[rid] = []
         self._submit_t[rid] = time.monotonic()
         return rid
+
+    def load_adapter(self, adapter_id, weights) -> int:
+        """Register LoRA adapter ``adapter_id`` on EVERY live replica
+        (prefill tier included — disaggregated prompts must prefill
+        under the adapter's deltas). Broadcasting the registry is what
+        makes the router's adapter-affinity a soft optimization: any
+        replica can serve any tenant, residency just decides who does
+        it without an LRU swap."""
+        aid = None
+        for i in self._live():
+            aid = self._engines[i].load_adapter(adapter_id, weights)
+        if aid is None:
+            raise RuntimeError(
+                "no live replicas to register the adapter on")
+        return aid
 
     def cancel(self, request_id: int) -> bool:
         """Cancel a request anywhere in its cluster lifetime: queued
@@ -796,6 +828,16 @@ class EngineCluster:
                 sum(r["kv_blocks_restored"] for r in reps),
             "host_tier_bytes":
                 sum(r["host_tier_bytes"] for r in reps),
+            # multi-LoRA roll-ups: ALWAYS present (False/0 on
+            # base-model fleets) — sums over live replicas, matching
+            # the host-tier pattern above
+            "lora_enabled": any(r["lora_enabled"] for r in reps),
+            "lora_adapters_resident":
+                sum(r["lora_adapters_resident"] for r in reps),
+            "lora_adapter_swaps":
+                sum(r["lora_adapter_swaps"] for r in reps),
+            "lora_host_tier_bytes":
+                sum(r["lora_host_tier_bytes"] for r in reps),
             "prefix_tokens_reused":
                 sum(r["prefix_tokens_reused"] for r in reps),
             "tokens_total": sum(r["tokens_total"] for r in reps),
@@ -902,7 +944,8 @@ class EngineCluster:
             idx, overlap, depths = next(iter(cands)), 0, {}
         else:
             idx, overlap, depths = self._router.route(
-                prompt, cands, priority=int(samp.get("priority", 0)))
+                prompt, cands, priority=int(samp.get("priority", 0)),
+                adapter_id=samp.get("adapter_id"))
         # submit FIRST: a validation rejection must not skew the
         # router counters (the hit rate is an acceptance metric)
         lrid = self._engines[idx].submit(prompt, max_new_tokens,

@@ -62,6 +62,9 @@ class RequestRecord:
     token_t: List[float] = field(default_factory=list)
     priority: int = 0                   # scheduling class (preemptive
     #                                     engines; 0 = default class)
+    # LoRA adapter the request was served under (submit(adapter_id=));
+    # None = base model (ISSUE 18 satellite)
+    adapter: Optional[int] = None
     # replica index the cluster router placed the request on (from
     # EngineCluster.owner_of at submit time); None for a plain engine
     replica: Optional[int] = None
@@ -145,6 +148,7 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
              max_new_tokens: Optional[int] = None,
              slo: Optional[SLO] = None, arrival: str = "poisson",
              priorities: Optional[Sequence[int]] = None,
+             adapter_ids: Optional[Sequence[Optional[int]]] = None,
              record_path: Optional[str] = None,
              seed: int = 0) -> dict:
     """Serve ``prompts`` through ``engine`` — a ``ServingEngine`` OR
@@ -166,6 +170,14 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     the report gains a ``by_priority`` breakdown (per-class goodput /
     TTFT / TPOT, each class its own SLO denominator).
 
+    ``adapter_ids`` (one Optional[int] per prompt, ISSUE 18
+    satellite) forwards each request's LoRA adapter to
+    ``submit(adapter_id=)`` — the mixed-tenant multi-adapter
+    workloads batched LoRA serving is measured on — and the report
+    gains a ``by_adapter`` breakdown (per-adapter goodput / TTFT /
+    TPOT; the base model appears under key ``"base"``). NDJSON rows
+    carry the adapter in an ``adapter`` field.
+
     ``record_path`` (ISSUE 15 satellite) additionally writes ONE
     NDJSON row per request (:func:`write_records`: submit /
     first-token / last-token monotonic timestamps, priority, outcome,
@@ -184,6 +196,10 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
         raise ValueError(
             f"priorities ({len(priorities)}) must match prompts "
             f"({len(prompts)})")
+    if adapter_ids is not None and len(adapter_ids) != len(prompts):
+        raise ValueError(
+            f"adapter_ids ({len(adapter_ids)}) must match prompts "
+            f"({len(prompts)})")
     slo = slo or SLO()
     n = len(prompts)
     records: Dict[int, RequestRecord] = {}
@@ -193,12 +209,16 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     def _submit(idx, arrival_s):
         kw = {} if priorities is None \
             else {"priority": int(priorities[idx])}
+        aid = None if adapter_ids is None else adapter_ids[idx]
+        if aid is not None:
+            kw["adapter_id"] = int(aid)
         rid = engine.submit(prompts[idx], max_new_tokens, **kw)
         owner = owner_of(rid) if owner_of is not None else None
         records[rid] = RequestRecord(
             rid, float(arrival_s), time.monotonic(),
             priority=0 if priorities is None
             else int(priorities[idx]),
+            adapter=None if aid is None else int(aid),
             replica=owner[0] if owner is not None else None)
         return rid
 
@@ -281,6 +301,7 @@ def write_records(records, path: str, slo: Optional[SLO] = None) -> str:
             row = {
                 "rid": r.rid,
                 "priority": r.priority,
+                "adapter": r.adapter,
                 "replica": r.replica,
                 "arrival_s": round(float(r.arrival_s), 6),
                 "submit_t_s": r.submit_t,
@@ -332,7 +353,21 @@ def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,
             rep = summarize(sub, slo, wall_s, offered_qps=None,
                             mode=mode)
             rep.pop("by_priority", None)
+            rep.pop("by_adapter", None)
             by_priority[str(p)] = rep
+    # per-adapter sub-reports (ISSUE 18 satellite): only when the
+    # workload actually mixed tenants; base-model requests key "base"
+    by_adapter = None
+    tenants = {r.adapter for r in records}
+    if (tenants - {None}) and len(tenants) > 1:
+        by_adapter = {}
+        for a in sorted(tenants, key=lambda a: (a is None, a)):
+            sub = [r for r in records if r.adapter == a]
+            rep = summarize(sub, slo, wall_s, offered_qps=None,
+                            mode=mode)
+            rep.pop("by_priority", None)
+            rep.pop("by_adapter", None)
+            by_adapter["base" if a is None else str(a)] = rep
     return {
         "mode": mode,
         "requests": len(records),
@@ -352,4 +387,6 @@ def summarize(records: List[RequestRecord], slo: SLO, wall_s: float,
         "wall_s": round(wall_s, 3),
         **({"by_priority": by_priority}
            if by_priority is not None else {}),
+        **({"by_adapter": by_adapter}
+           if by_adapter is not None else {}),
     }

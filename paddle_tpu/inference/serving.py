@@ -218,6 +218,7 @@ from ..distributed import moe as _moe
 from ..monitor import health as _health
 from ..monitor import tracing as _tracing
 from ..monitor.digest import LatencyDigest
+from ..ops import lora as _lora
 from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
@@ -452,6 +453,34 @@ class ServingConfig:
     # (0 = off; needs PADDLE_TPU_PROFILE_DIR or an explicit path to
     # land anywhere).
     health_profile_ticks: int = 0
+    # -- batched multi-LoRA serving (docs/OPS.md "Multi-LoRA
+    # serving"): lora_rank > 0 arms the adapter machinery —
+    # engine.load_adapter() registers per-tenant A/B delta weights in
+    # a host-DRAM registry, submit(adapter_id=) tags requests, and
+    # every decode tick applies the per-slot deltas as ONE
+    # mixed-adapter ragged grouped matmul inside the single existing
+    # tick executable (adapter churn swaps stack VALUES at a fixed
+    # shape — zero steady-state recompiles). Requires the ragged
+    # engine. Kill switch PADDLE_TPU_LORA=0 restores the base engine
+    # bit-for-bit (no extra operand, no tagged module, no extra
+    # per-slot row).
+    lora_rank: int = 0
+    # device-resident adapter budget: this many adapters stay loaded
+    # in the stacked device image at once (plus the always-present
+    # null adapter); the rest of the registry spills to host DRAM and
+    # LRU-swaps in on demand (refcounts pin adapters serving in-flight
+    # requests, so eviction mid-request is impossible).
+    max_adapters: int = 8
+    # LoRA scale numerator: delta = (x @ A @ B) * lora_alpha /
+    # lora_rank. None = lora_rank (scale 1.0).
+    lora_alpha: Optional[float] = None
+    # which projections carry deltas: "attn" = q/k/v/o (qkv/out on
+    # GPT), "all" adds the MLP projections (gate/up/down, linear1/2)
+    lora_targets: str = "attn"
+    # int8-quantize the resident adapter stacks (per-matrix absmax
+    # scales, dequantized in-trace — the PR 10 KV-pool recipe applied
+    # to the delta weights; ~4x adapters per resident byte)
+    lora_quant: bool = False
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -492,6 +521,24 @@ class ServingConfig:
             raise ValueError(
                 f"health_watchdog_mult must be >= 1, got "
                 f"{self.health_watchdog_mult!r}")
+        lr = self.lora_rank
+        if not isinstance(lr, int) or isinstance(lr, bool) or lr < 0:
+            raise ValueError(
+                f"lora_rank must be an int >= 0, got {lr!r}")
+        if lr > 0:
+            if int(self.max_adapters) < 1:
+                raise ValueError(
+                    f"max_adapters must be >= 1, got "
+                    f"{self.max_adapters!r}")
+            if self.lora_targets not in ("attn", "all"):
+                raise ValueError(
+                    f"lora_targets must be 'attn' or 'all', got "
+                    f"{self.lora_targets!r}")
+            if self.lora_alpha is not None \
+                    and float(self.lora_alpha) <= 0.0:
+                raise ValueError(
+                    f"lora_alpha must be > 0 (or None), got "
+                    f"{self.lora_alpha!r}")
 
 
 def _num_experts(cfg) -> int:
@@ -522,6 +569,12 @@ class ServingRequest:
     priority: int = 0
     # queue-wait budget (ms): still queued past it -> outcome="timeout"
     max_queue_wait_ms: Optional[float] = None
+    # multi-LoRA tenant: which registered adapter's delta weights this
+    # request decodes under (None = base model). Validated at submit;
+    # pinned (refcounted) in the AdapterPool while the request holds a
+    # slot, carried across preemption spill/resume and disaggregated
+    # handoffs.
+    adapter_id: Optional[int] = None
     # preemption carry-over (None for fresh requests): the victim's
     # continuation state — {"cache_len", "last_token", "n_emitted",
     # "history", "worst_blocks", "n_blocks", "nbytes", "key"} — plus
@@ -562,13 +615,18 @@ class PrefilledRequest:
     # merged trace draws the handoff as an arrow between the two
     # replicas' request spans. None when tracing is disabled.
     flow_id: Optional[int] = None
+    # multi-LoRA tenant id: the prefill tier computed this payload's
+    # KV UNDER the adapter's deltas, so the decode replica MUST seat
+    # the slot under the same adapter (load_adapter() is broadcast
+    # cluster-wide, so the id resolves on both sides)
+    adapter_id: Optional[int] = None
 
 
 class _Slot:
     __slots__ = ("rid", "blocks", "worst_blocks", "cache_len",
                  "last_token", "n_emitted", "max_new", "history",
                  "prompt", "pend_pos", "pend_row", "admit_t",
-                 "handoff", "priority", "resume")
+                 "handoff", "priority", "resume", "adapter_id")
 
     def __init__(self, rid, blocks, worst_blocks, cache_len, last_token,
                  max_new, history=None, prompt=None, pend_pos=None):
@@ -577,6 +635,7 @@ class _Slot:
         self.priority = 0       # scheduling class (preemptive sched)
         self.resume = None      # (last_token, n_emitted) to restore
         #                         when a recompute re-prefill completes
+        self.adapter_id = None  # pinned LoRA adapter (None = base)
         self.rid = rid
         self.blocks = blocks            # allocated block ids (ordered)
         self.worst_blocks = worst_blocks
@@ -1022,6 +1081,44 @@ class ServingEngine:
         self._n_spec_accepted = 0
         self._n_spec_verifies = 0       # per-slot verify windows
         self._n_spec_emitted = 0
+        # -- batched multi-LoRA serving -------------------------------
+        # resolved ONCE at construction: config (lora_rank > 0) AND
+        # the PADDLE_TPU_LORA env kill switch (0 beating an explicit
+        # rank — the base engine returns bit-for-bit: no module is
+        # tagged, the tick executable takes no extra operand and the
+        # slots pack carries no extra row, so the jaxpr is identical)
+        lora_rank = int(getattr(cfg, "lora_rank", 0) or 0)
+        self._lora_on = lora_rank > 0 and _lora.lora_enabled()
+        self._lora_pool: Optional[_lora.AdapterPool] = None
+        self._lora_dev = None           # device image of the stacks
+        self._lora_dev_version = -1     # pool.version the image holds
+        self._lora_swaps_seen = 0       # counter-delta bookkeeping
+        # per-slot RESIDENT STACK ROW (not adapter id; 0 = the null
+        # all-zero adapter) — rides the slots pack as one more int32
+        # row next to the sampling tensor, so adapter churn is a VALUE
+        # change at a fixed shape: zero steady-state recompiles
+        self._slot_adapter = np.zeros(cfg.num_slots, np.int64)
+        if self._lora_on:
+            if not self._ragged or not self._chunked:
+                raise NotImplementedError(
+                    "multi-LoRA serving requires the ragged engine "
+                    "with chunked prefill (ragged_batch=True and "
+                    "chunked_prefill on, without their env kill "
+                    "switches) — prompt rows must ride the ragged "
+                    "tick so adapter deltas reach the prefill KV; to "
+                    "disable LoRA itself use PADDLE_TPU_LORA=0")
+            specs = _lora.tag_modules(model, str(getattr(
+                cfg, "lora_targets", "attn")))
+            if not specs:
+                raise NotImplementedError(
+                    "no LoRA-taggable projection layers found on this "
+                    "model (expected q/k/v/o | qkv/out projections "
+                    "named per Llama/GPT idiom)")
+            self._lora_pool = _lora.AdapterPool(
+                specs, lora_rank,
+                alpha=getattr(cfg, "lora_alpha", None),
+                max_resident=int(getattr(cfg, "max_adapters", 8)),
+                quant=bool(getattr(cfg, "lora_quant", False)))
 
         # -- telemetry ------------------------------------------------
         self._m_occupancy = monitor.gauge(
@@ -1094,6 +1191,22 @@ class ServingEngine:
             "serving_host_tier_bytes",
             "bytes resident in the host-DRAM KV tier (spilled block "
             "payloads awaiting restore or LRU eviction)")
+        # -- multi-LoRA telemetry (registered unconditionally so
+        # stats()/JSONL always carry the keys — non-LoRA and
+        # PADDLE_TPU_LORA=0 engines report zeros, dashboards never
+        # KeyError across a mixed or rolled-back fleet)
+        self._m_lora_resident = monitor.gauge(
+            "serving_lora_adapters_resident",
+            "LoRA adapters resident in the device stacks (excludes "
+            "the always-present null adapter)")
+        self._m_lora_swaps = monitor.counter(
+            "serving_lora_adapter_swaps",
+            "adapter loads that evicted an unpinned resident adapter "
+            "to make room (LRU churn against the max_adapters budget)")
+        self._m_lora_host = monitor.gauge(
+            "serving_lora_host_tier_bytes",
+            "bytes of registered adapters NOT currently resident on "
+            "device (host-DRAM registry tier awaiting an LRU swap-in)")
         monitor.info(
             "serving_tp_degree",
             "tensor-parallel degree of the most recent engine").set(
@@ -1333,9 +1446,37 @@ class ServingEngine:
 
     # -- public API ---------------------------------------------------
 
+    def load_adapter(self, adapter_id, weights) -> int:
+        """Register (or hot-reload) LoRA adapter ``adapter_id`` from a
+        ``{module_name: (A, B)}`` dict — names either fully qualified
+        (``model.layers.0.self_attn.q_proj``) or bare leaf names
+        (``q_proj``, broadcast to every matching layer); ``A`` is
+        ``[d_in, rank]``, ``B`` ``[rank, d_out]``. The weights land in
+        the host-DRAM registry immediately and are device-loaded
+        lazily on first acquire (LRU within the ``max_adapters``
+        resident budget). Safe mid-serving: re-registering a RESIDENT
+        id rewrites its stack row in place (requests already pinned to
+        it pick up the new weights next tick — stack VALUES change,
+        never shapes, so nothing recompiles)."""
+        if self._lora_pool is None:
+            raise ValueError(
+                "load_adapter requires a LoRA-serving engine "
+                "(ServingConfig(lora_rank=...) and PADDLE_TPU_LORA "
+                "not 0)")
+        aid = self._lora_pool.register(adapter_id, weights)
+        self._sync_lora_metrics()
+        return aid
+
+    def adapter_resident(self, adapter_id) -> bool:
+        """True when the adapter currently occupies a device stack row
+        (the router's adapter-affinity probe — residency means a
+        submit against it seats without an LRU swap)."""
+        return self._lora_pool is not None \
+            and self._lora_pool.resident(adapter_id)
+
     def submit(self, prompt, max_new_tokens=None, temperature=None,
                top_k=None, top_p=None, priority=0,
-               max_queue_wait_ms=None) -> int:
+               max_queue_wait_ms=None, adapter_id=None) -> int:
         """Queue one request; returns its request id. Tokens stream to
         ``stream_callback`` as ``step()``/``run()`` produce them.
         ``temperature``/``top_k``/``top_p`` override the engine's
@@ -1353,7 +1494,11 @@ class ServingEngine:
         every request that touched the front door, not only the
         admitted survivors; queue-depth shedding
         (``ServingConfig.shed_queue_depth``) refuses with
-        :class:`QueueShedError` and an outcome="shed" observation."""
+        :class:`QueueShedError` and an outcome="shed" observation.
+        ``adapter_id`` decodes the request under a LoRA adapter
+        previously registered via :meth:`load_adapter` (None = base
+        model); unknown ids are rejected at this front door, never
+        mid-flight."""
         t0 = time.monotonic()
         if self._shed_depth is not None \
                 and len(self._queue) >= int(self._shed_depth):
@@ -1416,6 +1561,19 @@ class ServingEngine:
                 raise ValueError(
                     f"max_queue_wait_ms must be > 0 (or None), got "
                     f"{max_queue_wait_ms}")
+            if adapter_id is not None:
+                if self._lora_pool is None:
+                    raise ValueError(
+                        "adapter_id requires a LoRA-serving engine "
+                        "(ServingConfig(lora_rank=...) and "
+                        "PADDLE_TPU_LORA not 0); this engine serves "
+                        "the base model only")
+                adapter_id = int(adapter_id)
+                if not self._lora_pool.known(adapter_id):
+                    raise ValueError(
+                        f"unknown adapter_id {adapter_id}: register "
+                        "it with load_adapter() before submitting "
+                        "against it")
         except ValueError:
             wait = 1000.0 * (time.monotonic() - t0)
             self._m_queue_wait.labels(outcome="rejected").observe(wait)
@@ -1433,7 +1591,8 @@ class ServingEngine:
             top_p=None if top_p is None else float(top_p),
             priority=int(priority),
             max_queue_wait_ms=None if max_queue_wait_ms is None
-            else float(max_queue_wait_ms))
+            else float(max_queue_wait_ms),
+            adapter_id=adapter_id)
         self._queue.append(req)
         self._submit_t[rid] = req.submit_time
         if self._trace is not None:
@@ -1522,6 +1681,7 @@ class ServingEngine:
         self._tables_dev = None
         self._slots[i] = None
         self._set_slot_samp(i)
+        self._lora_release_slot(i, slot)
         toks = self._results.pop(slot.rid, [])
         if self.config.retain_results:
             self._done[slot.rid] = np.asarray(toks, np.int64)
@@ -2012,9 +2172,19 @@ class ServingEngine:
             for i in active:
                 tree_flags[i] = 1
             srows.append(tree_flags)
+        if self._lora_on:
+            # per-slot adapter row (RESIDENT stack rows, 0 = the null
+            # adapter) rides the slots pack next to the sampling
+            # tensor — churn changes VALUES at a fixed shape, so no
+            # adapter mix ever recompiles the tick
+            srows.append(self._slot_adapter)
         slots_pack = np.stack(srows).astype(np.int32)
         args = [self._params, self._pools, self._tables_dev,
                 self._dev(rows_pack), self._dev(slots_pack)]
+        if self._lora_on:
+            # the stacked A/B weights are a runtime OPERAND (cached on
+            # device until the pool version moves), same reasoning
+            args.append(self._lora_operand())
         if g:
             args.append(self._dev(toks))
             if self._heads is not None:
@@ -2241,6 +2411,16 @@ class ServingEngine:
             "requests_shed": self._n_shed,
             "requests_timed_out": self._n_timeout,
             "requests_cancelled": self._n_cancelled,
+            # multi-LoRA keys: ALWAYS present (False/0 on base-model
+            # or PADDLE_TPU_LORA=0 engines) so dashboards never
+            # KeyError across a mixed or rolled-back fleet
+            "lora_enabled": self._lora_on,
+            "lora_adapters_resident": self._lora_pool.n_resident
+            if self._lora_pool is not None else 0,
+            "lora_adapter_swaps": self._lora_pool.swaps
+            if self._lora_pool is not None else 0,
+            "lora_host_tier_bytes": self._lora_pool.host_tier_bytes
+            if self._lora_pool is not None else 0,
             "tp_degree": self._tp,
             # always present (0 / full pool when single-device), so a
             # tp_degree>1 request downgraded by the PADDLE_TPU_SERVE_TP=0
@@ -2426,7 +2606,7 @@ class ServingEngine:
                 n_blocks=len(slot.blocks), payload=payload,
                 temperature=float(samp[0]), top_k=float(samp[1]),
                 top_p=float(samp[2]), priority=int(slot.priority),
-                flow_id=fid))
+                flow_id=fid, adapter_id=slot.adapter_id))
             self._release_handoff(i)
         self._handoff_ready = []
         return out
@@ -2467,7 +2647,27 @@ class ServingEngine:
         worst = self._worst_for(n_real, max_new)
         if self._alloc.free_blocks - self._reserved < worst:
             return None
+        aid = getattr(prefilled, "adapter_id", None)
+        lrow = 0
+        if aid is not None:
+            # the payload's KV was computed under this adapter — the
+            # decode replica must seat it under the SAME deltas
+            if self._lora_pool is None:
+                raise ValueError(
+                    "prefilled handoff carries adapter_id "
+                    f"{int(aid)} but this engine serves the base "
+                    "model only (lora_rank=0 / PADDLE_TPU_LORA=0)")
+            if not self._lora_pool.known(int(aid)):
+                raise ValueError(
+                    f"prefilled handoff carries unknown adapter_id "
+                    f"{int(aid)}: load_adapter() it on the decode "
+                    "replica (the cluster broadcasts registrations)")
+            lrow = self._lora_pool.acquire(int(aid))
+            if lrow is None:
+                return None     # every row pinned; cluster retries
+            self._sync_lora_metrics()
         i = free[0]
+        self._slot_adapter[i] = lrow
         blocks = self._alloc.alloc(init)
         self._reserved += worst - len(blocks)
         ids = np.zeros(self._mb_xfer, np.int32)
@@ -2496,6 +2696,7 @@ class ServingEngine:
             prompt=prompt, pend_pos=None)
         self._slots[i].priority = int(getattr(prefilled, "priority",
                                               0) or 0)
+        self._slots[i].adapter_id = None if aid is None else int(aid)
         self._set_slot_samp(i, prefilled)
         self._m_occupancy.set(self.num_active)
         if self._trace is not None:
@@ -2546,6 +2747,7 @@ class ServingEngine:
         self._tables_dev = None
         self._slots[i] = None
         self._set_slot_samp(i)
+        self._lora_release_slot(i, slot)
         self._results.pop(slot.rid, None)
         self._m_occupancy.set(self.num_active)
 
@@ -2903,6 +3105,42 @@ class ServingEngine:
             self._samp_dev = self._dev(self._slot_samp)
         return self._samp_dev
 
+    def _lora_operand(self):
+        """Device image of the stacked adapter weights, re-uploaded
+        only when the pool version moved (register/LRU load rewrote a
+        stack row — the ``_samp_dev`` invalidation pattern). Runtime
+        OPERAND, never a closure capture: baking the stacks into the
+        trace would turn every adapter churn into a recompile."""
+        pool = self._lora_pool
+        if self._lora_dev is None \
+                or self._lora_dev_version != pool.version:
+            self._lora_dev = jax.tree_util.tree_map(
+                self._dev, pool.operand())
+            self._lora_dev_version = pool.version
+        return self._lora_dev
+
+    def _lora_release_slot(self, i, slot):
+        """Unpin slot ``i``'s adapter when the slot empties (retire /
+        cancel / preempt / handoff-release). The adapter STAYS
+        resident — release only drops the refcount that was blocking
+        LRU eviction."""
+        self._slot_adapter[i] = 0
+        if self._lora_pool is not None \
+                and getattr(slot, "adapter_id", None) is not None:
+            self._lora_pool.release(slot.adapter_id)
+            self._sync_lora_metrics()
+
+    def _sync_lora_metrics(self):
+        pool = self._lora_pool
+        if pool is None:
+            return
+        self._m_lora_resident.set(pool.n_resident)
+        self._m_lora_host.set(pool.host_tier_bytes)
+        d = pool.swaps - self._lora_swaps_seen
+        if d > 0:
+            self._m_lora_swaps.inc(d)
+            self._lora_swaps_seen = pool.swaps
+
     def _samp_row(self, i):
         """One slot's [3] sampling row for the single-slot executables
         (chunk / bucketed prefill) — cached per admission so a long
@@ -2958,6 +3196,17 @@ class ServingEngine:
                 free = [v]
             if not self._admission_fits(req):
                 break
+            lrow = 0
+            if self._lora_pool is not None \
+                    and req.adapter_id is not None:
+                # pin the adapter's resident stack row for the life of
+                # the slot (refcount blocks LRU eviction mid-request);
+                # all rows pinned by OTHER in-flight adapters -> the
+                # request waits its turn in the queue
+                lrow = self._lora_pool.acquire(req.adapter_id)
+                if lrow is None:
+                    break
+                self._sync_lora_metrics()
             # remove by IDENTITY: a preemption above appendleft'ed the
             # victim's resume request, shifting every index right —
             # ``k`` may no longer point at ``req``
@@ -2966,10 +3215,12 @@ class ServingEngine:
                     del self._queue[k2]
                     break
             i = free[0]
+            self._slot_adapter[i] = lrow
             if req.resume is not None:
                 # a preempted request re-admits through its own seat
                 # path (swap-restore or recompute re-prefill)
                 self._seat_resume(i, req, emitted)
+                self._slots[i].adapter_id = req.adapter_id
                 continue
             n_real = int(req.prompt.size)
             worst = self._worst_for(n_real, req.max_new_tokens)
@@ -3000,6 +3251,7 @@ class ServingEngine:
                 prompt=np.asarray(req.prompt, np.int32),
                 pend_pos=cached)
             self._slots[i].priority = int(req.priority)
+            self._slots[i].adapter_id = req.adapter_id
             self._set_slot_samp(i, req)
             self._m_occupancy.set(self.num_active)
             if self._trace is not None:
@@ -3200,6 +3452,11 @@ class ServingEngine:
         self._tables_dev = None
         self._slots[i] = None
         self._set_slot_samp(i)
+        # the adapter pin drops with the slot (LRU may now evict it);
+        # re-admission re-acquires, reloading from the host registry
+        # if churn swapped it out meanwhile — the request carries the
+        # ID, never a stack-row index
+        self._lora_release_slot(i, slot)
         self._m_occupancy.set(self.num_active)
         # 5) re-enqueue at the front of its class; a DECODING victim
         # carries the exact continuation state, a mid-prefill victim
@@ -3230,7 +3487,8 @@ class ServingEngine:
             else None,
             top_k=int(samp_row[1]) if self._do_sample else None,
             top_p=float(samp_row[2]) if self._do_sample else None,
-            priority=int(slot.priority), resume=resume)
+            priority=int(slot.priority), resume=resume,
+            adapter_id=slot.adapter_id)
         req.submit_time = self._submit_t.get(slot.rid,
                                              req.submit_time)
         self._queue.appendleft(req)
@@ -3935,6 +4193,7 @@ class ServingEngine:
         self._tables_dev = None
         self._slots[i] = None
         self._set_slot_samp(i)
+        self._lora_release_slot(i, slot)
         toks = self._results.pop(slot.rid)
         if self.config.retain_results:
             self._done[slot.rid] = np.asarray(toks, np.int64)
@@ -4139,8 +4398,23 @@ class ServingEngine:
         do_sample = self._do_sample
         tree = self._spec_tree
         heads_on = self._heads is not None
+        lora_on = self._lora_on
+        # adapter row index in the slots pack: appended AFTER the tree
+        # flags (when present) by _step_ragged
+        lora_row = 5 if tree is not None else 4
+        lora_scaling = self._lora_pool.scaling if lora_on else 1.0
+        # grouped-matmul path only off-mesh: under TP the delta einsum
+        # shards on the existing GSPMD cut instead (the gmm kernel's
+        # scalar-prefetch gather is a single-device layout)
+        lora_gmm_ok = self._mesh is None
 
         def ragged(params, pools, tables, rows_pack, slots_pack, *rest):
+            if lora_on:
+                # the stacked adapter weights ride at a FIXED operand
+                # position (right after the packs) — strip them before
+                # the g/heads/dq parsing below, which indexes rest
+                # from both ends
+                lora_ops, rest = rest[0], rest[1:]
             ids, row_slot, row_pos = (rows_pack[0], rows_pack[1],
                                       rows_pack[2])
             base, q_lens, row_starts, last_rows = (
@@ -4162,6 +4436,19 @@ class ServingEngine:
                     # rows (tree_rows == 0) keep the linear mask
                     ctx.enter_context(
                         _pa.spec_tree_scope(tree, tree_rows))
+                if lora_on:
+                    # per-ROW adapter assignment: each packed query
+                    # row applies its slot's adapter (decode, verify
+                    # AND prefill rows — the prompt's KV must carry
+                    # the deltas too); pad rows gather slot 0's value
+                    # and contribute nothing downstream. The scope
+                    # arms the tagged q/k/v/o projections' ragged
+                    # grouped-matmul delta inside the SAME executable.
+                    row_adapter = jnp.take(slots_pack[lora_row],
+                                           row_slot)
+                    ctx.enter_context(_lora.serving_lora_scope(
+                        lora_ops, row_adapter, lora_scaling,
+                        gmm_ok=lora_gmm_ok))
                 ctx.enter_context(
                     _moe.serving_rows_mask(row_pos < self._overflow))
                 logits, pools = step(

@@ -515,7 +515,17 @@ class TrainStep:
         loss_fn = self.loss_fn
         opt = self.optimizer
         fwd_fn = self._layer_caller()
-        trainable = [not p.stop_gradient for _, p in binder.param_items]
+        # a param is updated only if it requires grad AND the optimizer
+        # was given it — paddle semantics: AdamW(parameters=[subset])
+        # freezes everything outside the subset
+        opt_ids = set()
+        for entry in getattr(opt, "_parameter_list", []):
+            if isinstance(entry, dict):       # param-group style
+                opt_ids.update(id(p) for p in entry.get("params", []))
+            else:
+                opt_ids.add(id(entry))
+        trainable = [not p.stop_gradient and (not opt_ids or id(p) in opt_ids)
+                     for _, p in binder.param_items]
 
         def step(param_arrays, opt_states, buffer_arrays, lr, base_key,
                  step_idx, batch):

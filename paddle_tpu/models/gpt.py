@@ -188,23 +188,41 @@ class GPTDecoderLayer(Layer):
         second MLP linear + residual add. The XLA fallback is bitwise
         this layer's unfused eval-mode ops."""
         from ..ops.pallas import decode_fused as _df
+        from ..ops import lora as _lora
         b, l, d = x.shape
         (qkv,) = _df.norm_matmul(
             x, self.ln_1.weight, self.ln_1.bias,
             [self.attn.qkv_proj.weight], [self.attn.qkv_proj.bias],
             eps=self.ln_1._epsilon, kind="ln")
+        if _lora.armed(self.attn.qkv_proj):
+            # multi-LoRA serving composes per MODULE (the Llama twin):
+            # fused prologue kept, the armed projection adds its
+            # ragged grouped-matmul delta off the recomputed norm —
+            # bitwise the unfused module path's input, so fused
+            # ON==OFF stays token-exact under adapters too
+            qkv = _lora.apply(self.attn.qkv_proj, self.ln_1(x), qkv)
         ctx, new_cache = self.attn._attend_serving(
             qkv, kv_cache, block_tables, cache_lens, ragged_meta,
             b, l, d)
-        x2 = _df.matmul_residual([ctx], self.attn.out_proj.weight,
-                                 self.attn.out_proj.bias, x)
+        if _lora.armed(self.attn.out_proj):
+            # armed epilogue: module call + residual add (the unfused
+            # ordering; eval-mode dropout is inert)
+            x2 = x + self.attn.out_proj(ctx)
+        else:
+            x2 = _df.matmul_residual([ctx], self.attn.out_proj.weight,
+                                     self.attn.out_proj.bias, x)
         (g,) = _df.norm_matmul(
             x2, self.ln_2.weight, self.ln_2.bias,
             [self.linear1.weight], [self.linear1.bias],
             eps=self.ln_2._epsilon, kind="ln")
-        out = _df.matmul_residual([g], self.linear2.weight,
-                                  self.linear2.bias, x2,
-                                  act="gelu_tanh")
+        if _lora.armed(self.linear1):
+            g = _lora.apply(self.linear1, self.ln_2(x2), g)
+        if _lora.armed(self.linear2):
+            out = x2 + self.linear2(F.gelu(g, approximate=True))
+        else:
+            out = _df.matmul_residual([g], self.linear2.weight,
+                                      self.linear2.bias, x2,
+                                      act="gelu_tanh")
         return out, new_cache
 
     def forward(self, x, kv_cache=None, offset=None, block_tables=None,

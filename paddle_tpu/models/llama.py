@@ -523,6 +523,7 @@ class LlamaDecoderLayer(Layer):
         XLA fallback is bitwise this layer's unfused ops, so CPU
         engines with fusion ON compile today's graph unchanged."""
         from ..ops.pallas import decode_fused as _df
+        from ..ops import lora as _lora
         attn = self.self_attn
         b, l, _ = hidden_states.shape
         eps = self.input_layernorm._epsilon
@@ -532,6 +533,17 @@ class LlamaDecoderLayer(Layer):
              attn.v_proj.weight],
             [attn.q_proj.bias, attn.k_proj.bias, attn.v_proj.bias],
             eps=eps, kind="rms")
+        if _lora.armed(attn.q_proj) or _lora.armed(attn.k_proj) \
+                or _lora.armed(attn.v_proj):
+            # multi-LoRA serving composes per MODULE: the fused
+            # prologue stays; the armed projections add their ragged
+            # grouped-matmul delta off the recomputed norm (bitwise
+            # the norm the unfused module path feeds them, so fused
+            # ON==OFF stays token-exact under adapters too)
+            hn = self.input_layernorm(hidden_states)
+            q = _lora.apply(attn.q_proj, hn, q)
+            k = _lora.apply(attn.k_proj, hn, k)
+            v = _lora.apply(attn.v_proj, hn, v)
         if ragged_meta is not None:
             ctx, kp2, vp2 = attn._attend_ragged(
                 q, k, v, rope_cos, rope_sin, kv_cache, block_tables,
@@ -540,15 +552,29 @@ class LlamaDecoderLayer(Layer):
             ctx, kp2, vp2 = attn._attend_paged(
                 q, k, v, rope_cos, rope_sin, kv_cache, block_tables,
                 cache_lens, b, l)
-        h = _df.matmul_residual([ctx], attn.o_proj.weight,
-                                attn.o_proj.bias, hidden_states)
+        if _lora.armed(attn.o_proj):
+            # an armed epilogue falls back to module call + residual
+            # add (the unfused ordering — module forward applies the
+            # delta), keeping the prologue fusions above
+            h = hidden_states + attn.o_proj(ctx)
+        else:
+            h = _df.matmul_residual([ctx], attn.o_proj.weight,
+                                    attn.o_proj.bias, hidden_states)
         mlp = self.mlp
         g, u = _df.norm_matmul(
             h, self.post_attention_layernorm.weight, None,
             [mlp.gate_proj.weight, mlp.up_proj.weight], [None, None],
             eps=self.post_attention_layernorm._epsilon, kind="rms")
-        out = _df.matmul_residual([g, u], mlp.down_proj.weight,
-                                  mlp.down_proj.bias, h, act="swiglu")
+        if _lora.armed(mlp.gate_proj) or _lora.armed(mlp.up_proj):
+            hn2 = self.post_attention_layernorm(h)
+            g = _lora.apply(mlp.gate_proj, hn2, g)
+            u = _lora.apply(mlp.up_proj, hn2, u)
+        if _lora.armed(mlp.down_proj):
+            out = h + mlp.down_proj(swiglu(g, u))
+        else:
+            out = _df.matmul_residual([g, u], mlp.down_proj.weight,
+                                      mlp.down_proj.bias, h,
+                                      act="swiglu")
         return out, (kp2, vp2)
 
     def forward(self, hidden_states, rope_cos, rope_sin,

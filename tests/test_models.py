@@ -78,6 +78,39 @@ def test_gpt_tiny_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_train_step_honors_optimizer_param_subset():
+    # AdamW(parameters=[subset]) must freeze everything outside the
+    # subset — the compiled TrainStep has to match eager optimizer.step()
+    # semantics, not just stop_gradient flags.
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = GPTConfig.tiny(vocab=64, hidden=32, layers=2, heads=4)
+    model = GPTForCausalLM(cfg)
+    target_names = {"gpt.h.0.attn.qkv_proj.weight",
+                    "gpt.h.1.attn.qkv_proj.weight"}
+    subset = [p for n, p in model.named_parameters() if n in target_names]
+    assert len(subset) == len(target_names)
+    before = {n: np.array(p.numpy()) for n, p in model.named_parameters()}
+
+    opt = paddle.optimizer.AdamW(1e-2, parameters=subset)
+    step = TrainStep(model, lambda out, a, k: out, opt)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 64, (4, 16)).astype(np.int64)
+    for _ in range(2):
+        step(paddle.to_tensor(data), labels=paddle.to_tensor(data))
+
+    for name, p in model.named_parameters():
+        after = p.numpy()
+        if name in target_names:
+            assert not np.array_equal(before[name], after), \
+                f"{name} was given to the optimizer but did not move"
+        else:
+            np.testing.assert_array_equal(
+                before[name], after,
+                err_msg=f"{name} moved despite not being in the "
+                        f"optimizer's parameter list")
+
+
 def test_bert_classification_trains():
     from paddle_tpu.models.bert import (BertConfig,
                                         BertForSequenceClassification)
