@@ -1,0 +1,197 @@
+"""Elastic fleet autoscaling policy (ISSUE 19): capacity that tracks
+load.
+
+``AutoscalePolicy`` is a PURE decision function over the cluster's
+existing telemetry — no engine imports, no side effects beyond its own
+hysteresis counters — so the control loop is unit-testable on
+synthetic signal streams and the cluster stays the only actor that
+spawns or drains replicas. Each cluster tick the policy sees one
+``signals`` dict:
+
+- ``replicas`` / ``slots`` / ``active`` / ``queued`` — the decode
+  tier's live size, aggregate slot capacity, resident sessions and
+  queued work (queue depth per slot is the primary pressure signal);
+- ``burn_fast`` — the worst live replica's fast SLO burn rate
+  (``HealthMonitor.burn_rates()``, the PR 17 goodput signal): traffic
+  can burn error budget while occupancy still looks moderate, so a
+  burning fleet scales up even below the occupancy trigger;
+- ``busy`` — the busiest replica's roofline utilization
+  (``max(step_mfu, step_hbm_bw_util)``): a compute-saturated fleet
+  with an empty queue is still a fleet about to queue;
+- ``prefill_replicas`` / ``prefill_slots`` / ``prefill_active`` /
+  ``prefill_queued`` — the prefill tier's pressure in disaggregated
+  mode. A shifting prompt-length mix shows up HERE first: longer
+  prompts raise prefill queue-per-slot while decode occupancy lags,
+  and ``decide_prefill`` retunes the prefill:decode ratio from that
+  skew (``mean_prompt_len`` rides along for dashboards/tests).
+
+Decisions are rate-limited twice: a trigger must hold for
+``hysteresis_ticks`` CONSECUTIVE ticks before it acts (one bursty tick
+never flaps the fleet), and any action starts a ``cooldown_ticks``
+hold-down (scale effects take ticks to show; reacting to a
+mid-transient snapshot double-scales). Scale-down additionally
+requires ALL down-triggers at once — draining a replica live-migrates
+every resident session, which is invisible to clients but not free.
+
+Kill switch ``PADDLE_TPU_AUTOSCALE=0``: the cluster never constructs a
+policy, so a configured cluster is bit-for-bit a fixed-N fleet —
+rollback is one env var, like every switch in this repo. See
+docs/OPS.md "Elastic autoscaling & live migration".
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["AutoscaleConfig", "AutoscalePolicy", "autoscale_enabled"]
+
+
+def autoscale_enabled() -> bool:
+    """False under the ``PADDLE_TPU_AUTOSCALE=0`` kill switch — the
+    cluster then ignores its ``ClusterConfig.autoscale`` policy and
+    runs as a fixed-N fleet (manual ``scale_up``/``scale_down`` keep
+    working; only the automatic control loop is inert)."""
+    return os.environ.get("PADDLE_TPU_AUTOSCALE", "1") != "0"
+
+
+@dataclass
+class AutoscaleConfig:
+    """Knobs for :class:`AutoscalePolicy`. Thresholds are per-slot
+    ratios so one config serves any replica size."""
+    # decode-tier fleet bounds (live replicas; failed ones don't count)
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # scale-up triggers (ANY fires): queued work per decode slot, slot
+    # occupancy, fast SLO burn rate (the page threshold from the
+    # health engine's burn-rate monitors), roofline busy-ness
+    up_queue_per_slot: float = 0.5
+    up_occupancy: float = 0.95
+    up_burn_fast: float = 14.0
+    up_busy: float = 0.95
+    # scale-down triggers (ALL must hold): occupancy AND queue both
+    # under their floors — a drain is client-invisible but not free
+    down_occupancy: float = 0.35
+    down_queue_per_slot: float = 0.05
+    # consecutive breaching ticks before acting / hold-down after any
+    # action (either tier)
+    hysteresis_ticks: int = 3
+    cooldown_ticks: int = 20
+    # disaggregated prefill:decode ratio retune (both 0 = never touch
+    # the prefill tier); same per-slot queue thresholds, prefill side
+    min_prefill_replicas: int = 0
+    max_prefill_replicas: int = 0
+    prefill_up_queue_per_slot: float = 0.5
+    prefill_down_queue_per_slot: float = 0.05
+
+    def __post_init__(self):
+        if not (isinstance(self.min_replicas, int)
+                and not isinstance(self.min_replicas, bool)
+                and self.min_replicas >= 1):
+            raise ValueError(
+                f"min_replicas must be an int >= 1, got "
+                f"{self.min_replicas!r}")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"max_replicas ({self.max_replicas}) < min_replicas "
+                f"({self.min_replicas})")
+        if self.min_prefill_replicas < 0 \
+                or self.max_prefill_replicas < self.min_prefill_replicas:
+            raise ValueError(
+                "prefill replica bounds must satisfy 0 <= min <= max,"
+                f" got [{self.min_prefill_replicas}, "
+                f"{self.max_prefill_replicas}]")
+        if self.hysteresis_ticks < 1 or self.cooldown_ticks < 0:
+            raise ValueError(
+                "hysteresis_ticks must be >= 1 and cooldown_ticks "
+                f">= 0, got {self.hysteresis_ticks}/"
+                f"{self.cooldown_ticks}")
+
+
+class AutoscalePolicy:
+    """Hysteresis + cooldown control loop over cluster signals. Call
+    :meth:`decide` once per cluster tick with the decode tier's
+    signals (``"up"`` / ``"down"`` / ``"hold"``), and — in
+    disaggregated mode — :meth:`decide_prefill` on ticks where the
+    decode tier held. The policy assumes the caller EXECUTES every
+    non-hold decision (the cooldown starts either way — an
+    inexecutable decision, e.g. no cold replica to drain, must not
+    retrigger every tick)."""
+
+    def __init__(self, config: AutoscaleConfig | None = None):
+        self.config = config or AutoscaleConfig()
+        self._up = 0            # consecutive up-trigger ticks
+        self._down = 0
+        self._p_up = 0
+        self._p_down = 0
+        self._cooldown = 0
+        self.decisions = {"up": 0, "down": 0, "hold": 0,
+                          "prefill_up": 0, "prefill_down": 0}
+
+    def _act(self, name):
+        self._up = self._down = self._p_up = self._p_down = 0
+        self._cooldown = self.config.cooldown_ticks
+        self.decisions[name] += 1
+        return name.split("_")[-1]
+
+    def decide(self, signals: dict) -> str:
+        """One decode-tier decision from one tick's signals."""
+        cfg = self.config
+        n = max(1, int(signals.get("replicas", 1)))
+        slots = max(1, int(signals.get("slots", 1)))
+        occ = float(signals.get("active", 0)) / slots
+        qps = float(signals.get("queued", 0)) / slots
+        burn = float(signals.get("burn_fast", 0.0))
+        busy = float(signals.get("busy", 0.0))
+        want_up = (qps >= cfg.up_queue_per_slot
+                   or occ >= cfg.up_occupancy
+                   or burn >= cfg.up_burn_fast
+                   or busy >= cfg.up_busy)
+        want_down = (occ <= cfg.down_occupancy
+                     and qps <= cfg.down_queue_per_slot)
+        self._up = self._up + 1 if want_up else 0
+        self._down = self._down + 1 if want_down else 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+        elif self._up >= cfg.hysteresis_ticks \
+                and n < cfg.max_replicas:
+            return self._act("up")
+        elif self._down >= cfg.hysteresis_ticks \
+                and n > cfg.min_replicas:
+            return self._act("down")
+        self.decisions["hold"] += 1
+        return "hold"
+
+    def decide_prefill(self, signals: dict) -> str:
+        """One prefill-tier decision (disaggregated ratio retune) —
+        call only on ticks where the decode tier held, so the fleet
+        changes at most one replica per tick."""
+        cfg = self.config
+        if cfg.max_prefill_replicas <= 0:
+            return "hold"
+        n = int(signals.get("prefill_replicas", 0))
+        slots = max(1, int(signals.get("prefill_slots", 1)))
+        occ = float(signals.get("prefill_active", 0)) / slots
+        qps = float(signals.get("prefill_queued", 0)) / slots
+        want_up = qps >= cfg.prefill_up_queue_per_slot or occ >= 1.0
+        want_down = (qps <= cfg.prefill_down_queue_per_slot
+                     and occ <= cfg.down_occupancy)
+        self._p_up = self._p_up + 1 if want_up else 0
+        self._p_down = self._p_down + 1 if want_down else 0
+        if self._cooldown > 0:
+            pass        # decide() already consumed this tick's decay
+        elif self._p_up >= cfg.hysteresis_ticks \
+                and n < cfg.max_prefill_replicas:
+            return self._act("prefill_up")
+        elif self._p_down >= cfg.hysteresis_ticks \
+                and n > cfg.min_prefill_replicas:
+            return self._act("prefill_down")
+        return "hold"
+
+    def state(self) -> dict:
+        """Introspection snapshot (stats / tests): streak counters,
+        cooldown remaining, decision tallies."""
+        return {"up_streak": self._up, "down_streak": self._down,
+                "prefill_up_streak": self._p_up,
+                "prefill_down_streak": self._p_down,
+                "cooldown_remaining": self._cooldown,
+                "decisions": dict(self.decisions)}

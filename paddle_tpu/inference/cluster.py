@@ -96,8 +96,10 @@ from ..monitor import health as _health
 from ..monitor import tracing as _tracing
 from ..monitor.digest import LatencyDigest
 from ..ops import paged_cache as _pc
-from .serving import (PrefilledRequest, QueueShedError, ServingConfig,
-                      ServingEngine)
+from .autoscale import (AutoscaleConfig, AutoscalePolicy,
+                        autoscale_enabled)
+from .serving import (MigratedSession, PrefilledRequest,
+                      QueueShedError, ServingConfig, ServingEngine)
 
 __all__ = ["ClusterConfig", "Router", "EngineCluster"]
 
@@ -119,6 +121,13 @@ class ClusterConfig:
     # admission + chunked prefill only and stream finished KV blocks
     # into the decode replicas' pools (export_blocks/import_blocks).
     prefill_replicas: int = 0
+    # elastic fleet (ISSUE 19): an AutoscaleConfig arms the control
+    # loop — each cluster tick the policy reads queue depth /
+    # occupancy / SLO burn / roofline busy-ness and drives scale_up()
+    # / scale_down() (live-migrating drains) within its replica
+    # bounds. None (default) = fixed-N fleet; the
+    # PADDLE_TPU_AUTOSCALE=0 kill switch beats an explicit config.
+    autoscale: Optional[AutoscaleConfig] = None
 
     def __post_init__(self):
         n = self.num_replicas
@@ -258,6 +267,13 @@ class EngineCluster:
         self._engines: List[ServingEngine] = []
         self._decode_idx: List[int] = []
         self._prefill_idx: List[int] = []
+        # scale_up() spawns replicas from the SAME shared config, so
+        # the construction inputs are kept (weights are shared jax
+        # arrays — a new replica costs executables + pools, not a
+        # second copy of the model)
+        self._model = model
+        self._draft_model = draft_model
+        self._spec_heads = spec_heads
         decode_role = "decode" if self._disagg else "both"
         dkw = {"role": decode_role, "retain_results": True}
         # retain_results forced on: a replica's _done dict is the
@@ -270,6 +286,7 @@ class EngineCluster:
             # ragged launch as DEAD static width — shrink it to the
             # minimum unless the caller pinned a value
             dkw["ragged_prefill_rows"] = 1
+        self._dkw = dict(dkw)
         for _ in range(ccfg.num_replicas):
             idx = len(self._engines)
             self._engines.append(ServingEngine(
@@ -310,6 +327,44 @@ class EngineCluster:
         # capacity: (src_engine_idx, PrefilledRequest)
         self._pending: List[Tuple[int, PrefilledRequest]] = []
         self._failed = set()
+        # -- elastic fleet (ISSUE 19) ---------------------------------
+        # replicas retired by scale_down(): drained empty (every
+        # session live-migrated out), removed from their tier index so
+        # the router/placement never see them, kept in _engines so
+        # trace export and index stability survive — and so scale_up()
+        # can REVIVE one with its executables already compiled (a
+        # scale cycle compiles nothing in steady state)
+        self._removed = set()
+        # live sessions in transit: (global_rid, MigratedSession) —
+        # placed onto the coldest live decode replica each tick
+        self._pending_mig: List[Tuple[int, MigratedSession]] = []
+        # adapter registry replay for replicas spawned/revived AFTER a
+        # load_adapter broadcast (weights are shared refs, not copies)
+        self._adapter_reg: Dict[int, object] = {}
+        self._n_scale_ups = 0
+        self._n_scale_downs = 0
+        self._n_migrated = 0            # sessions live-migrated
+        self._n_replica_ticks = 0       # sum over ticks of live
+        #                                 replicas (the autoscale
+        #                                 bench's capacity denominator)
+        self._d_migration = LatencyDigest()      # export->seated ms
+        self._m_replicas = monitor.gauge(
+            "serving_replicas_live",
+            "live replicas (decode + prefill tiers) in the cluster "
+            "right now — scale_up/scale_down/fail_replica move it")
+        self._m_migrated = monitor.counter(
+            "serving_sessions_migrated",
+            "live sessions moved between replicas with their KV "
+            "(scale-down drains + rebalancing), token-exact and "
+            "invisible to the client")
+        self._m_replicas.set(len(self._decode_idx)
+                             + len(self._prefill_idx))
+        self._autoscale: Optional[AutoscalePolicy] = None
+        if ccfg.autoscale is not None and autoscale_enabled():
+            self._autoscale = AutoscalePolicy(ccfg.autoscale)
+        # mean prompt length EMA — the prompt-mix signal the policy's
+        # prefill:decode retune consumes (and dashboards plot)
+        self._prompt_len_ema = 0.0
         self._tick_buf: List[tuple] = []
         self._n_routed = 0
         self._n_affinity = 0
@@ -376,7 +431,8 @@ class EngineCluster:
     @property
     def num_active(self) -> int:
         return sum(self._engines[i].num_active
-                   for i in self._live()) + len(self._pending)
+                   for i in self._live()) \
+            + len(self._pending) + len(self._pending_mig)
 
     @property
     def num_queued(self) -> int:
@@ -412,6 +468,12 @@ class EngineCluster:
         tier computes the prompt's KV under the adapter), and
         survives a failure-drain requeue like the sampling knobs."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
+        # prompt-length-mix EMA: the autoscaler's prefill:decode
+        # retune signal (longer prompts shift pressure prefill-ward)
+        n = float(ids.size)
+        self._prompt_len_ema = (
+            n if self._prompt_len_ema == 0.0
+            else 0.9 * self._prompt_len_ema + 0.1 * n)
         if self._disagg:
             # mirror engine.submit()'s pool-fit rejection for the
             # DECODE side: the prefill tier reserves only prompt
@@ -469,6 +531,10 @@ class EngineCluster:
         if aid is None:
             raise RuntimeError(
                 "no live replicas to register the adapter on")
+        # registry replay source: a replica spawned or revived AFTER
+        # this broadcast re-registers from here (shared array refs,
+        # not copies) so migrated adapter sessions land anywhere
+        self._adapter_reg[int(aid)] = weights
         return aid
 
     def cancel(self, request_id: int) -> bool:
@@ -482,6 +548,16 @@ class EngineCluster:
         ``run()``."""
         owner = self._owner.get(request_id)
         if owner is None:
+            # an in-transit migration? (exported, not yet re-seated —
+            # owner_of() is None for exactly that window)
+            for k, (g, _rec) in enumerate(self._pending_mig):
+                if g == request_id:
+                    del self._pending_mig[k]
+                    # a migrated session has streamed by definition:
+                    # surface the partial tokens like an in-flight
+                    # cancel would
+                    self._finish(g)
+                    return True
             return False
         idx, lrid = owner
         streamed = bool(self._tokens.get(request_id))
@@ -521,6 +597,12 @@ class EngineCluster:
     def _step_impl(self) -> List[tuple]:
         t0 = time.monotonic()
         self._tick_buf = []
+        # capacity denominator for goodput-per-replica-tick: one unit
+        # per LIVE replica per cluster tick (the autoscale bench's
+        # "what did this capacity cost" axis)
+        self._n_replica_ticks += sum(
+            1 for i in self._decode_idx + self._prefill_idx
+            if i not in self._failed)
         for i in list(self._prefill_idx):
             if i in self._failed:
                 continue
@@ -531,6 +613,7 @@ class EngineCluster:
                 for rec in eng.pop_prefilled():
                     self._pending.append((i, rec))
         self._place_handoffs()
+        self._place_migrations()
         for i in list(self._decode_idx):
             if i in self._failed:
                 continue
@@ -540,10 +623,13 @@ class EngineCluster:
         self._collect_done()
         if self._health_on:
             self._watchdog_sweep()
+        if self._autoscale is not None:
+            self._autoscale_tick()
         if self._trace is not None:
             self._trace.emit(
                 "cluster tick", tid=0, t0=t0,
                 args={"pending_handoffs": len(self._pending),
+                      "pending_migrations": len(self._pending_mig),
                       "emitted": len(self._tick_buf),
                       "failed": len(self._failed)})
         return self._tick_buf
@@ -614,6 +700,412 @@ class EngineCluster:
                     f"request {g} shed during the failure drain; "
                     "terminating with the tokens already streamed")
                 self._finish(g)
+        # wipe the dead replica's affinity surface: the candidate
+        # filter already hides it from the router, but its content
+        # index + host-tier published spills would otherwise linger as
+        # dead weight for the fleet's lifetime — and any path that
+        # ever probes the engine again (diagnostics, a future revival)
+        # must see overlap 0, not hashes for KV nobody serves.
+        # Best-effort: the replica may be torn down mid-call.
+        try:
+            eng.purge_published()
+        except Exception:       # pragma: no cover - torn down
+            pass
+        self._set_replica_gauge()
+
+    # -- elastic fleet (ISSUE 19) -------------------------------------
+
+    def scale_up(self, role: str = "decode", warm: bool = True) -> int:
+        """Add one replica to ``role``'s tier ("decode" / "prefill")
+        from the SAME shared construction inputs (weights are shared
+        jax arrays — a replica costs executables + pools, never a
+        second model copy) and return its index. A replica previously
+        retired by :meth:`scale_down` is REVIVED in preference to
+        building a new one: its executables are already compiled, so a
+        steady-state scale cycle compiles NOTHING. Fresh or revived,
+        the replica replays the cluster's adapter registry (so
+        migrated LoRA sessions can land on it) and — with ``warm=True``
+        — pre-builds its executables off the request path: the
+        migration export/import pair plus one throwaway 1-token
+        request driven to completion before the router ever sees the
+        replica."""
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"role must be 'decode' or 'prefill', "
+                             f"got {role!r}")
+        if role == "prefill" and not self._disagg:
+            raise ValueError(
+                "cannot scale the prefill tier of a colocated "
+                "cluster (prefill_replicas=0)")
+        tier = (self._decode_idx if role == "decode"
+                else self._prefill_idx)
+        want = (("decode" if self._disagg else "both")
+                if role == "decode" else "prefill")
+        idx = None
+        for i in sorted(self._removed):
+            if self._engines[i]._role == want:
+                idx = i
+                break
+        revived = idx is not None
+        if revived:
+            self._removed.discard(idx)
+            eng = self._engines[idx]
+        else:
+            idx = len(self._engines)
+            if role == "decode":
+                eng = ServingEngine(
+                    self._model,
+                    _dc_replace(self.serving_config, **self._dkw),
+                    stream_callback=self._make_cb(idx),
+                    draft_model=self._draft_model,
+                    spec_heads=self._spec_heads)
+            else:
+                # mirror __init__'s prefill-tier construction:
+                # speculation is a decode feature
+                eng = ServingEngine(
+                    self._model,
+                    _dc_replace(self.serving_config, role="prefill",
+                                retain_results=True,
+                                num_speculative_tokens=0,
+                                spec_tree=None, drafter="ngram"),
+                    stream_callback=self._make_cb(idx))
+            self._engines.append(eng)
+            self._hist_cap = (len(self._engines) + 1) \
+                * _tracing.trace_buffer_capacity()
+        for aid, w in self._adapter_reg.items():
+            # replay registrations the replica missed (revived
+            # replicas keep their registry; known() makes this
+            # idempotent either way)
+            if eng._lora_pool is not None \
+                    and not eng._lora_pool.known(aid):
+                eng.load_adapter(aid, w)
+        if warm and not revived:
+            # a revived replica's executables are already compiled —
+            # only a FRESH engine needs the off-path warm pass
+            try:
+                eng.warm_migration()
+            except Exception:   # pragma: no cover - defensive
+                warnings.warn(
+                    f"replica {idx} failed its migration warm-up; "
+                    "the first real transfer will compile inline")
+            # one throwaway request end-to-end: prefill + decode (or
+            # prefill + export on the prefill tier) executables build
+            # NOW, not under the first routed request. A 1-token
+            # prompt publishes nothing (cache_len < block_size), so
+            # the affinity surface stays clean.
+            lrid = eng.submit([1], 1)
+            guard = 0
+            while (eng.num_queued or eng.num_active) and guard < 64:
+                eng.step()
+                eng.pop_prefilled()     # prefill role: drop handoff
+                guard += 1
+            eng._done.pop(lrid, None)
+        # joining the tier index LAST: the router and placement loops
+        # only ever see a fully-warmed replica
+        tier.append(idx)
+        self._n_scale_ups += 1
+        self._set_replica_gauge()
+        if self._trace is not None:
+            self._trace.instant(
+                "scale up", tid=0,
+                args={"replica": idx, "role": role,
+                      "revived": revived})
+        return idx
+
+    def scale_down(self, index: Optional[int] = None) -> int:
+        """Retire one replica with a LIVE-MIGRATING drain: every
+        resident session leaves through the compiled export path and
+        re-seats on a surviving replica at its exact continuation
+        state (cache_len, last token, emit count, sampling row,
+        priority, adapter pin) — clients just see their streams
+        continue; greedy output is token-exact vs never-migrated.
+        Queued-but-unserved work re-routes as fresh submissions.
+        ``index`` defaults to the COLDEST live decode replica. The
+        replica leaves its tier index immediately (no new routes, no
+        placements), its published-prefix surface is purged (affinity
+        follows the migrated KV), and the engine object is KEPT for a
+        later :meth:`scale_up` revival — executables stay compiled.
+        Raises when ``index`` is the last live decode replica (a
+        drain needs somewhere to put the sessions)."""
+        if index is None:
+            cands = [i for i in self._decode_idx
+                     if i not in self._failed]
+            if len(cands) < 2:
+                raise RuntimeError(
+                    "scale_down needs >= 2 live decode replicas "
+                    "(the drain live-migrates onto the survivors)")
+            index = min(cands, key=lambda j:
+                        self._engines[j].num_active
+                        + self._engines[j].num_queued)
+        if index in self._failed or index in self._removed:
+            raise ValueError(
+                f"replica {index} is already failed/removed")
+        if index in self._decode_idx:
+            if sum(1 for i in self._decode_idx
+                   if i not in self._failed) < 2:
+                raise RuntimeError(
+                    "cannot drain the last live decode replica")
+            self._decode_idx.remove(index)
+        elif index in self._prefill_idx:
+            self._prefill_idx.remove(index)
+        else:
+            raise ValueError(f"no replica {index}")
+        self._removed.add(index)
+        eng = self._engines[index]
+        # already-exported handoffs first: their payloads are
+        # self-contained, they just place like any pending handoff
+        for rec in eng.pop_prefilled():
+            self._pending.append((index, rec))
+        migrations, fresh = eng.drain_sessions()
+        for rec in migrations:
+            g = self._l2g.pop((index, rec.request_id), None)
+            if g is None:       # cancelled upstream: drop
+                continue
+            # in transit: owner_of() is None until re-seated
+            self._owner.pop(g, None)
+            self._pending_mig.append((g, rec))
+        for req in fresh:
+            g = self._l2g.pop((index, req.request_id), None)
+            if g is None:
+                continue
+            try:
+                self._route_submit(g, req.prompt, req.max_new_tokens)
+            except QueueShedError:
+                warnings.warn(
+                    f"request {g} shed during the scale-down drain; "
+                    "terminating with the tokens already streamed")
+                self._finish(g)
+        # affinity follows the KV: the drained replica's index must
+        # stop scoring overlaps the survivors now serve
+        eng.purge_published()
+        self._n_scale_downs += 1
+        self._set_replica_gauge()
+        if self._trace is not None:
+            self._trace.instant(
+                "scale down", tid=0,
+                args={"replica": index,
+                      "migrations": len(migrations),
+                      "requeued": len(fresh)})
+        self._place_migrations()
+        return index
+
+    def rebalance(self, max_moves: int = 1) -> int:
+        """Cluster-level load shedding: while the hottest live decode
+        replica is >= 2 sessions deeper than the coldest, export its
+        best victim (lowest priority class, newest admit — the PR 14
+        victim policy, minus the mid-prefill preference since those
+        have nothing worth moving) and live-migrate it to the coldest
+        replica. Returns the number of sessions moved (bounded by
+        ``max_moves``). A no-op below 2 live replicas or under
+        balanced load — safe to call every tick."""
+        moved = 0
+        for _ in range(int(max_moves)):
+            live = [i for i in self._decode_idx
+                    if i not in self._failed]
+            if len(live) < 2:
+                break
+
+            def _load(j):
+                return (self._engines[j].num_active
+                        + self._engines[j].num_queued)
+
+            hot = max(live, key=_load)
+            cold = min(live, key=_load)
+            if _load(hot) - _load(cold) < 2:
+                break
+            eng = self._engines[hot]
+            cands = [i for i, s in enumerate(eng._slots)
+                     if s is not None and not s.handoff
+                     and not (s.pend_pos is not None
+                              and s.resume is None)]
+            if not cands:
+                break
+            victim = min(cands, key=lambda i: (
+                eng._slots[i].priority, -eng._slots[i].admit_t))
+            lrid = eng._slots[victim].rid
+            g = self._l2g.pop((hot, lrid), None)
+            rec = eng.export_session(victim)
+            if g is None:       # pragma: no cover - cancelled race
+                continue
+            self._owner.pop(g, None)
+            self._pending_mig.append((g, rec))
+            if self._trace is not None:
+                self._trace.instant(
+                    "rebalance", tid=0,
+                    args={"rid": g, "src": hot, "dst_hint": cold})
+            moved += 1
+        if moved:
+            self._place_migrations()
+        return moved
+
+    def _place_migrations(self):
+        """Re-seat in-transit migrated sessions, coldest live decode
+        replica first. A session that finds no capacity this tick
+        stays pending (``num_active`` counts it, so ``run()`` keeps
+        ticking); a replica that RAISES during admission is treated
+        as failed mid-migration — it drains through ``fail_replica``
+        and the session retries the next candidate, degrading to the
+        recompute path if its payload import died with the target."""
+        if not self._pending_mig:
+            return
+        still = []
+        for g, rec in self._pending_mig:
+            placed = False
+            while not placed:
+                live = [i for i in self._decode_idx
+                        if i not in self._failed]
+                if not live:
+                    warnings.warn(
+                        "no live decode replica to seat migrated "
+                        f"session {g}; terminating with the tokens "
+                        "already streamed")
+                    self._finish(g)
+                    placed = True       # terminal, not re-queued
+                    break
+                for i in sorted(live, key=lambda j:
+                                self._engines[j].num_active
+                                + self._engines[j].num_queued):
+                    try:
+                        lrid = self._engines[i].admit_migrated(rec)
+                    except Exception as exc:    # noqa: BLE001
+                        warnings.warn(
+                            f"replica {i} failed admitting migrated "
+                            f"session {g} ({exc!r}); failing it and "
+                            "retrying elsewhere")
+                        self.fail_replica(i)
+                        break       # re-derive the live set
+                    if lrid is None:
+                        continue    # no capacity there right now
+                    self._l2g[(i, lrid)] = g
+                    self._owner[g] = (i, lrid)
+                    self._hist_put((i, lrid), g)
+                    self._d_migration.observe(
+                        1000.0 * (time.monotonic() - rec.export_t))
+                    self._n_migrated += 1
+                    self._m_migrated.inc()
+                    if self._trace is not None:
+                        self._trace.instant(
+                            "migration placed", tid=0,
+                            args={"rid": g, "dst": i,
+                                  "blocks": rec.n_blocks,
+                                  "recompute": rec.payload is None})
+                    placed = True
+                    break
+                else:
+                    # every live candidate said "not right now":
+                    # park for the next tick
+                    still.append((g, rec))
+                    placed = True
+        self._pending_mig = still
+
+    def _shed_backlog(self, new_idx):
+        """After a scale-up, spread the EXISTING backlog: each
+        survivor's queued-but-unserved requests beyond the fleet's
+        fair share re-route through the router, which places them on
+        the emptiest replica — the one that just joined. Without this
+        the new capacity only absorbs future arrivals while the burst
+        that triggered it keeps queueing on the old replicas.
+        Preempted resume-carrying waiters stay put (their KV lives
+        where they queued). Colocated tiers only: a disaggregated
+        cluster's router queue lives on the prefill tier."""
+        live = [i for i in self._decode_idx if i not in self._failed]
+        if len(live) < 2:
+            return
+        total = sum(self._engines[i].num_queued for i in live)
+        fair = -(-total // len(live))               # ceil
+        for i in live:
+            if i == new_idx:
+                continue
+            eng = self._engines[i]
+            extra = eng.num_queued - fair
+            if extra <= 0:
+                continue
+            for req in eng.shed_queued(extra):
+                g = self._l2g.pop((i, req.request_id), None)
+                if g is None:
+                    continue
+                try:
+                    self._route_submit(g, req.prompt,
+                                       req.max_new_tokens)
+                except QueueShedError:
+                    warnings.warn(
+                        f"request {g} shed during the scale-up "
+                        "backlog spread; terminating with the tokens "
+                        "already streamed")
+                    self._finish(g)
+
+    def _autoscale_tick(self):
+        """One control-loop step: gather the tick's signals (queue
+        depth per slot, occupancy, worst fast SLO burn rate, busiest
+        roofline) and execute the policy's decision. At most ONE
+        replica changes per tick — decode tier first; the prefill
+        ratio retune only runs on decode-hold ticks."""
+        pol = self._autoscale
+        dec = [i for i in self._decode_idx if i not in self._failed]
+        if not dec:
+            return
+        burn = 0.0
+        busy = 0.0
+        for i in dec:
+            eng = self._engines[i]
+            if eng._health is not None:
+                burn = max(burn,
+                           eng._health.burn_rates().get("fast", 0.0))
+            r = eng._roofline()
+            busy = max(busy, r["step_mfu"], r["step_hbm_bw_util"])
+        sig = {
+            "replicas": len(dec),
+            "slots": sum(self._engines[i].config.num_slots
+                         for i in dec),
+            "active": sum(self._engines[i].num_active for i in dec)
+            + len(self._pending_mig),
+            "queued": sum(self._engines[i].num_queued for i in dec),
+            "burn_fast": burn,
+            "busy": busy,
+            "mean_prompt_len": self._prompt_len_ema,
+        }
+        d = pol.decide(sig)
+        if d == "up":
+            try:
+                idx = self.scale_up("decode")
+            except Exception as exc:    # pragma: no cover - defensive
+                warnings.warn(f"autoscale scale_up failed: {exc!r}")
+                return
+            if not self._disagg:
+                self._shed_backlog(idx)
+            return
+        if d == "down":
+            try:
+                self.scale_down()
+            except RuntimeError:
+                pass        # last live decode replica: hold instead
+            return
+        if not self._disagg:
+            return
+        pf = [i for i in self._prefill_idx if i not in self._failed]
+        sig.update({
+            "prefill_replicas": len(pf),
+            "prefill_slots": sum(self._engines[i].config.num_slots
+                                 for i in pf),
+            "prefill_active": sum(self._engines[i].num_active
+                                  for i in pf),
+            "prefill_queued": sum(self._engines[i].num_queued
+                                  for i in pf),
+        })
+        d = pol.decide_prefill(sig)
+        if d == "up":
+            try:
+                self.scale_up("prefill")
+            except Exception as exc:    # pragma: no cover - defensive
+                warnings.warn(
+                    f"autoscale prefill scale_up failed: {exc!r}")
+        elif d == "down" and pf:
+            cold = min(pf, key=lambda j:
+                       self._engines[j].num_active
+                       + self._engines[j].num_queued)
+            try:
+                self.scale_down(cold)
+            except (RuntimeError, ValueError):
+                pass
 
     def _watchdog_sweep(self):
         """Per-tick stuck-replica check: a replica whose watchdog
@@ -814,6 +1306,22 @@ class EngineCluster:
             "active": self.num_active,
             "queued": self.num_queued,
             "pending_handoffs": len(self._pending),
+            # elastic fleet (ISSUE 19): ALWAYS present — a fixed-N
+            # fleet (no policy / kill switch) reports its static size
+            # and zeros, so dashboards never KeyError across configs
+            "replicas_live": sum(
+                1 for i in self._decode_idx + self._prefill_idx
+                if i not in self._failed),
+            "removed_replicas": sorted(self._removed),
+            "scale_ups": self._n_scale_ups,
+            "scale_downs": self._n_scale_downs,
+            "sessions_migrated": self._n_migrated,
+            "pending_migrations": len(self._pending_mig),
+            "migration_ms": self._d_migration.summary(),
+            "replica_ticks": self._n_replica_ticks,
+            "mean_prompt_len": round(self._prompt_len_ema, 2),
+            "autoscale": (self._autoscale.state()
+                          if self._autoscale is not None else None),
             "router_requests": self._n_routed,
             "router_affinity_hits": self._n_affinity,
             "router_affinity_hit_rate":
@@ -889,7 +1397,12 @@ class EngineCluster:
 
     def _live(self):
         return [i for i in range(len(self._engines))
-                if i not in self._failed]
+                if i not in self._failed and i not in self._removed]
+
+    def _set_replica_gauge(self):
+        self._m_replicas.set(sum(
+            1 for i in self._decode_idx + self._prefill_idx
+            if i not in self._failed))
 
     def _make_cb(self, idx):
         def cb(lrid, tok):

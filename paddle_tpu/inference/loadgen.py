@@ -41,8 +41,8 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["SLO", "RequestRecord", "poisson_arrivals",
-           "uniform_arrivals", "run_load", "summarize",
-           "conversation_workload", "write_records"]
+           "uniform_arrivals", "profile_arrivals", "run_load",
+           "summarize", "conversation_workload", "write_records"]
 
 
 @dataclass
@@ -111,6 +111,58 @@ def uniform_arrivals(n: int, qps: float) -> np.ndarray:
     return (1.0 + np.arange(n)) / float(qps)
 
 
+def _profile_rate(profile: dict, qps: float, t: float) -> float:
+    """Instantaneous arrival rate of a shaped-load profile at offset
+    ``t`` seconds — the λ(t) of the inhomogeneous Poisson process
+    :func:`profile_arrivals` draws from. Floored at 5% of the base
+    rate so the process always terminates."""
+    kind = profile.get("kind")
+    if kind == "sine":
+        # diurnal-ish swing: qps * (1 ± depth) over period_s
+        period = float(profile.get("period_s", 60.0))
+        depth = float(profile.get("depth", 0.5))
+        m = 1.0 + depth * np.sin(2.0 * np.pi * t / period)
+    elif kind == "ramp":
+        # linear warm-up from start_frac*qps to qps over ramp_s
+        ramp = float(profile.get("ramp_s", 60.0))
+        f0 = float(profile.get("start_frac", 0.1))
+        m = f0 + (1.0 - f0) * min(t / ramp, 1.0)
+    elif kind == "step":
+        # square-wave burst: high*qps for the first half of each
+        # period_s, low*qps for the second (the scale-up chaos shape)
+        period = float(profile.get("period_s", 60.0))
+        hi = float(profile.get("high", 2.0))
+        lo = float(profile.get("low", 0.25))
+        m = hi if (t % period) < period / 2.0 else lo
+    else:
+        raise ValueError(
+            f"qps_profile kind must be sine|ramp|step, got {kind!r}")
+    return float(qps) * max(float(m), 0.05)
+
+
+def profile_arrivals(n: int, qps: float, profile: dict,
+                     seed: int = 0) -> np.ndarray:
+    """Open-loop SHAPED arrival offsets (seconds from start): an
+    inhomogeneous Poisson process whose instantaneous rate follows
+    ``profile`` around the base ``qps`` — the burst/ramp/diurnal
+    workloads the elastic autoscaler is measured against (a constant
+    rate never exercises scale-down). Seeded and sequential
+    (``t += Exp(1/λ(t))``), so a given ``(n, qps, profile, seed)`` is
+    reproducible byte-for-byte. Profiles::
+
+        {"kind": "sine", "period_s": 60, "depth": 0.5}
+        {"kind": "ramp", "ramp_s": 60, "start_frac": 0.1}
+        {"kind": "step", "period_s": 60, "high": 2.0, "low": 0.25}
+    """
+    rng = np.random.RandomState(seed)
+    out = np.empty(n, np.float64)
+    t = 0.0
+    for i in range(n):
+        t += rng.exponential(1.0 / _profile_rate(profile, qps, t))
+        out[i] = t
+    return out
+
+
 def conversation_workload(n_sessions: int, turns: int, *,
                           vocab: int = 1000, prefix_len: int = 32,
                           turn_len: int = 8, seed: int = 0):
@@ -150,6 +202,7 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
              priorities: Optional[Sequence[int]] = None,
              adapter_ids: Optional[Sequence[Optional[int]]] = None,
              record_path: Optional[str] = None,
+             qps_profile: Optional[dict] = None,
              seed: int = 0) -> dict:
     """Serve ``prompts`` through ``engine`` — a ``ServingEngine`` OR
     any object with the same ``submit/step/num_queued/num_active/
@@ -178,6 +231,15 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     TPOT; the base model appears under key ``"base"``). NDJSON rows
     carry the adapter in an ``adapter`` field.
 
+    ``qps_profile`` (ISSUE 19 satellite) shapes the open-loop arrival
+    RATE around the base ``qps``: a :func:`profile_arrivals` dict
+    (``{"kind": "sine"|"ramp"|"step", ...}``) replaces the
+    constant-rate schedule with a seeded inhomogeneous Poisson
+    process — the burst/ramp/diurnal workloads elastic autoscaling is
+    measured on. The profile is echoed in the report and in every
+    NDJSON row; when absent, schedules and records are byte-identical
+    to the fixed-QPS harness.
+
     ``record_path`` (ISSUE 15 satellite) additionally writes ONE
     NDJSON row per request (:func:`write_records`: submit /
     first-token / last-token monotonic timestamps, priority, outcome,
@@ -192,6 +254,10 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
         raise ValueError(f"mode must be open|closed, got {mode!r}")
     if mode == "open" and not qps:
         raise ValueError("open-loop mode needs a target qps")
+    if qps_profile is not None and mode != "open":
+        raise ValueError(
+            "qps_profile shapes the OPEN-loop arrival rate; a closed "
+            "loop has no arrival schedule to shape")
     if priorities is not None and len(priorities) != len(prompts):
         raise ValueError(
             f"priorities ({len(priorities)}) must match prompts "
@@ -232,8 +298,12 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
             prev_cb(rid, tok)
 
     if mode == "open":
-        offsets = poisson_arrivals(n, qps, seed) \
-            if arrival == "poisson" else uniform_arrivals(n, qps)
+        if qps_profile is not None:
+            offsets = profile_arrivals(n, qps, qps_profile, seed)
+        elif arrival == "poisson":
+            offsets = poisson_arrivals(n, qps, seed)
+        else:
+            offsets = uniform_arrivals(n, qps)
     else:
         offsets = np.zeros(n)
         # slot capacity of the target: a cluster exposes its aggregate
@@ -278,13 +348,17 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
         (n / wall if wall > 0 else 0.0)
     report = summarize(list(records.values()), slo, wall,
                        offered_qps=offered, mode=mode)
+    if qps_profile is not None:
+        report["qps_profile"] = dict(qps_profile)
     if record_path is not None:
-        report["record_path"] = write_records(records.values(),
-                                              record_path, slo=slo)
+        report["record_path"] = write_records(
+            records.values(), record_path, slo=slo,
+            qps_profile=qps_profile)
     return report
 
 
-def write_records(records, path: str, slo: Optional[SLO] = None) -> str:
+def write_records(records, path: str, slo: Optional[SLO] = None,
+                  qps_profile: Optional[dict] = None) -> str:
     """One NDJSON row per request (ISSUE 15 satellite): submit /
     first-token / last-token timestamps (``time.monotonic()``
     seconds — the SAME clock base the span tracer exports, whose
@@ -293,7 +367,11 @@ def write_records(records, path: str, slo: Optional[SLO] = None) -> str:
     outcome. With ``slo``, each row also carries ``slo_met``
     (ISSUE 17 satellite: TTFT+TPOT vs the configured SLO — the
     health engine's burn-rate inputs, validatable offline against
-    the recorded trace). Returns ``path``."""
+    the recorded trace). With ``qps_profile`` (ISSUE 19: shaped-load
+    runs), every row carries the profile dict — offline analysis can
+    reconstruct the offered λ(t) each request arrived under; rows of
+    a fixed-QPS run are byte-identical to before the knob existed.
+    Returns ``path``."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     with open(path, "w") as f:
@@ -321,6 +399,8 @@ def write_records(records, path: str, slo: Optional[SLO] = None) -> str:
             }
             if slo is not None:
                 row["slo_met"] = bool(r.meets(slo))
+            if qps_profile is not None:
+                row["qps_profile"] = dict(qps_profile)
             f.write(json.dumps(row) + "\n")
     return path
 

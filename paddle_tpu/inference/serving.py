@@ -223,7 +223,7 @@ from ..ops import paged_cache as _pc
 from ..ops.pallas import paged_attention as _pa
 
 __all__ = ["ServingConfig", "ServingRequest", "ServingEngine",
-           "PrefilledRequest", "QueueShedError"]
+           "PrefilledRequest", "MigratedSession", "QueueShedError"]
 
 
 class QueueShedError(RuntimeError):
@@ -620,6 +620,53 @@ class PrefilledRequest:
     # the slot under the same adapter (load_adapter() is broadcast
     # cluster-wide, so the id resolves on both sides)
     adapter_id: Optional[int] = None
+
+
+@dataclass
+class MigratedSession:
+    """One LIVE session packaged for another replica (scale-down
+    drain / cluster rebalancing): the full continuation state a
+    preemption resume carries — cache position, last sampled token,
+    emit count, token history, sampling row, scheduling class,
+    adapter pin — PLUS the exported live KV bytes, so the importing
+    engine seats a decoding slot exactly where this one stopped and
+    the client's stream continues token-exact, never re-submitted.
+    ``payload=None`` degrades to the recompute path: the target
+    re-prefills ``history[:cache_len]`` through the ordinary chunk
+    machinery and restores the continuation (token-exact either way —
+    recompute IS the preemption recompute resume). Produced by
+    ``ServingEngine.export_session`` / ``drain_sessions``, consumed
+    by ``admit_migrated`` on any decode-capable engine of the SAME
+    model and serving layout (block_size / max_model_len /
+    kv_cache_dtype)."""
+    request_id: int                     # SOURCE-engine-local rid
+    prompt: np.ndarray                  # [L] int32 original prompt
+    history: list                       # prompt + emitted tokens
+    cache_len: int                      # valid cache positions
+    last_token: int                     # sampled, not yet in cache
+    n_emitted: int                      # tokens already streamed
+    max_new_tokens: int
+    worst_blocks: int                   # admission reserve (carried —
+    #                                     replicas share the config,
+    #                                     so the target's accounting
+    #                                     matches the source's)
+    n_blocks: int                       # real (non-pad) payload blocks
+    payload: Optional[list] = None      # per-layer host (k, v) rows;
+    #                                     None -> recompute on import
+    # the resolved per-slot sampling row travels verbatim (the target
+    # decodes under the SAME knobs the source sampled with)
+    temperature: Optional[float] = None
+    top_k: Optional[float] = None
+    top_p: Optional[float] = None
+    priority: int = 0
+    adapter_id: Optional[int] = None
+    # trace flow-link id: export records the start, import the finish
+    # — the merged fleet trace draws the migration as an arrow
+    flow_id: Optional[int] = None
+    # export timestamp: the cluster's migration_ms digest observes
+    # export -> seated wall time (queueing while pending included —
+    # that IS the drain latency a client could feel as a stall)
+    export_t: float = field(default_factory=time.monotonic)
 
 
 class _Slot:
@@ -1049,6 +1096,10 @@ class ServingEngine:
         self._n_shed = 0
         self._n_timeout = 0
         self._n_cancelled = 0           # in-flight cancels
+        # live-session migration (elastic fleet: scale-down drain /
+        # cluster rebalancing — ISSUE 19)
+        self._n_migrated_out = 0        # live sessions exported
+        self._n_migrated_in = 0         # live sessions imported
         # recompute-vs-swap cost model, measured online: EMA of chunk-
         # prefill row throughput (rows/s — what a recompute resume
         # pays per cached token) and of host-transfer bandwidth
@@ -2411,6 +2462,11 @@ class ServingEngine:
             "requests_shed": self._n_shed,
             "requests_timed_out": self._n_timeout,
             "requests_cancelled": self._n_cancelled,
+            # live-session migration (ISSUE 19): ALWAYS present (0 on
+            # engines that never joined an elastic cluster) so
+            # dashboards never KeyError across a mixed fleet
+            "sessions_migrated_out": self._n_migrated_out,
+            "sessions_migrated_in": self._n_migrated_in,
             # multi-LoRA keys: ALWAYS present (False/0 on base-model
             # or PADDLE_TPU_LORA=0 engines) so dashboards never
             # KeyError across a mixed or rolled-back fleet
@@ -2762,6 +2818,339 @@ class ServingEngine:
             return _pc.blocks_for(int(n_real), self._bs)
         return _pc.blocks_for(int(n_real) + int(max_new) + self._gamma,
                               self._bs)
+
+    # -- live session migration (elastic fleet, ISSUE 19) --------------
+
+    def export_session(self, i) -> MigratedSession:
+        """Package slot ``i``'s LIVE session for another replica
+        (scale-down drain / cluster rebalancing) and free the slot
+        with NO terminal accounting — the request stays live; its
+        stream continues wherever ``admit_migrated`` seats the record.
+        A decoding slot ships its trimmed live bytes through THE
+        fixed-width export executable (shared with the disaggregated
+        handoff and the preemption spill — still zero extra
+        executables); a mid-re-prefill slot (partial cache) ships
+        ``payload=None`` and resumes by recompute on the target.
+        Nothing is published locally: the session's prefix affinity
+        must FOLLOW the KV to the target (``admit_migrated``
+        republishes there), not linger on a replica that is going
+        away."""
+        slot = self._slots[i]
+        self._slot_props.pop(i, None)
+        samp_row = self._slot_samp[i].copy()
+        if slot.handoff and i in self._handoff_ready:
+            self._handoff_ready.remove(i)
+        # trim the verify-window overhang: blocks past cache_len hold
+        # rolled-back/garbage positions — same walk as _preempt, so
+        # the payload is exactly the live bytes
+        keep = max(_pc.blocks_for(slot.cache_len, self._bs), 1)
+        while len(slot.blocks) > keep:
+            blk = slot.blocks.pop()
+            self._alloc.free([blk])
+            self._tables[i, len(slot.blocks)] = 0
+            self._reserved += 1
+            self._tables_dev = None
+        if slot.resume is not None:
+            # mid-re-prefill: the ORIGINAL continuation carries over;
+            # its partial KV cannot back a payload
+            last_token, n_emitted = slot.resume
+        else:
+            last_token, n_emitted = slot.last_token, slot.n_emitted
+        n_ctx = len(slot.history) - 1   # == cache_len for a decoding
+        #                                 slot (the pending last_token
+        #                                 is not in the cache)
+        payload = None
+        if slot.pend_pos is None and slot.blocks \
+                and len(slot.blocks) <= self._mb_xfer:
+            payload = _pc.payload_rows(
+                self._export_payload(slot.blocks), len(slot.blocks))
+        fid = None
+        now = time.monotonic()
+        if self._trace is not None:
+            fid = _tracing.next_flow_id()
+            self._trace.flow(
+                "kv migrate", tid=1 + i, flow_id=fid, phase="s",
+                args={"rid": slot.rid, "blocks": len(slot.blocks)})
+            self._trace.emit(
+                f"req{slot.rid}", tid=1 + i, t0=slot.admit_t, t1=now,
+                args={"tokens": slot.n_emitted,
+                      "cache_len": slot.cache_len, "migrated": True})
+        rec = MigratedSession(
+            request_id=slot.rid,
+            prompt=np.asarray(slot.prompt, np.int32),
+            history=list(map(int, slot.history)),
+            cache_len=int(n_ctx), last_token=int(last_token),
+            n_emitted=int(n_emitted),
+            max_new_tokens=int(slot.max_new),
+            worst_blocks=int(slot.worst_blocks),
+            n_blocks=_pc.blocks_for(n_ctx, self._bs), payload=payload,
+            temperature=float(samp_row[0]), top_k=float(samp_row[1]),
+            top_p=float(samp_row[2]), priority=int(slot.priority),
+            adapter_id=slot.adapter_id, flow_id=fid)
+        self._alloc.free(slot.blocks)
+        self._reserved -= slot.worst_blocks - len(slot.blocks)
+        self._tables[i, :] = 0
+        self._tables_dev = None
+        self._slots[i] = None
+        self._set_slot_samp(i)
+        self._lora_release_slot(i, slot)
+        self._submit_t.pop(slot.rid, None)
+        self._last_emit.pop(slot.rid, None)
+        self._slo_ok.pop(slot.rid, None)
+        self._results.pop(slot.rid, None)
+        self._n_migrated_out += 1
+        self._m_occupancy.set(self.num_active)
+        return rec
+
+    def admit_migrated(self, rec: MigratedSession):
+        """Seat a LIVE session ANOTHER replica exported: allocate
+        blocks, import the payload bytes through THE fixed-width
+        import executable, and seat a DECODING slot at the exact
+        continuation point — cache_len, last token, emit count,
+        history, sampling row, priority, adapter pin — so the resumed
+        stream is token-exact vs never-migrated by construction (int8
+        payloads carry data + per-row scales, bitwise like the
+        handoff). ``payload=None`` seats the recompute path instead:
+        the context re-prefills through the ordinary chunk machinery
+        and ``_finish_prefill`` restores the continuation — still
+        token-exact (it IS the preemption recompute resume). The
+        session's full blocks are PUBLISHED here at import, so the
+        router's prefix-affinity probe follows the KV to this replica
+        (the source unpublished at export). Returns the engine-local
+        rid, or None when no slot / block / adapter-row capacity is
+        available right now (the cluster retries or tries another
+        replica). No TTFT is observed — the session already
+        streamed; later emits feed the ITL digest only."""
+        if self._role == "prefill":
+            raise ValueError(
+                "a role='prefill' engine cannot seat a migrated "
+                "session: migration targets must decode")
+        n_ctx = int(rec.cache_len)
+        history = list(map(int, rec.history))
+        if len(history) > self.config.max_model_len:
+            raise ValueError(
+                f"migrated session history ({len(history)} tokens) "
+                f"exceeds max_model_len ({self.config.max_model_len})"
+                " — exporter and importer must share the serving "
+                "layout")
+        payload = rec.payload
+        need = _pc.blocks_for(n_ctx, self._bs)
+        if payload is not None and int(rec.n_blocks) != need:
+            raise ValueError(
+                f"migrated payload holds {rec.n_blocks} blocks but a "
+                f"{n_ctx}-token cache needs {need} at block_size="
+                f"{self._bs} — exporter and importer must share the "
+                "serving layout")
+        ctx = np.asarray(history[:n_ctx], np.int32)
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return None
+        worst = int(rec.worst_blocks)
+        if self._alloc.free_blocks - self._reserved < worst:
+            return None
+        aid = rec.adapter_id
+        lrow = 0
+        if aid is not None:
+            if self._lora_pool is None:
+                raise ValueError(
+                    f"migrated session carries adapter_id {int(aid)} "
+                    "but this engine serves the base model only "
+                    "(lora_rank=0 / PADDLE_TPU_LORA=0)")
+            if not self._lora_pool.known(int(aid)):
+                raise ValueError(
+                    "migrated session carries unknown adapter_id "
+                    f"{int(aid)}: load_adapter() it on the target "
+                    "(the cluster broadcasts registrations)")
+            lrow = self._lora_pool.acquire(int(aid))
+            if lrow is None:
+                return None     # every row pinned; caller retries
+            self._sync_lora_metrics()
+        i = free[0]
+        self._slot_adapter[i] = lrow
+        rid = self._next_rid
+        self._next_rid += 1
+        self._results[rid] = []
+        if payload is not None:
+            n_blocks = int(rec.n_blocks)
+            blocks = self._alloc.alloc(n_blocks)
+            self._import_payload(blocks, payload)
+            self._n_blocks_imported += n_blocks
+            self._m_kv_transfer.inc(n_blocks)
+            self._reserved += worst - n_blocks
+            self._tables[i, :] = 0
+            self._tables[i, :n_blocks] = blocks
+            self._tables_dev = None
+            slot = _Slot(rid, blocks, worst, n_ctx,
+                         int(rec.last_token),
+                         int(rec.max_new_tokens),
+                         history=list(history), prompt=ctx,
+                         pend_pos=None)
+            slot.n_emitted = int(rec.n_emitted)
+            # publish the session's full blocks NOW: the prefix
+            # affinity that pointed at the source must resolve HERE
+            # from the next router probe on (positions < cache_len
+            # are committed — decode appends never write a published
+            # block, same invariant as _retire's publish-then-free)
+            if self._prefix_on and n_ctx >= self._bs:
+                n_full = min(len(blocks), n_ctx // self._bs)
+                for b, h in zip(blocks[:n_full],
+                                _pc.chain_hashes(
+                                    self._fp,
+                                    history[:n_full * self._bs],
+                                    self._bs)):
+                    self._alloc.publish(b, h)
+            mode = "swap"
+        else:
+            blocks, cached = self._map_prefix(ctx, n_ctx)
+            self._reserved += worst - len(blocks)
+            self._tables[i, :] = 0
+            if self._ragged or not (self._chunked
+                                    and self._chunk_budget > 0):
+                self._tables[i, :len(blocks)] = blocks
+            self._tables_dev = None
+            slot = _Slot(rid, blocks, worst, cached, None,
+                         int(rec.max_new_tokens),
+                         history=list(history), prompt=ctx,
+                         pend_pos=cached)
+            slot.resume = (int(rec.last_token), int(rec.n_emitted))
+            mode = "recompute"
+        slot.priority = int(rec.priority)
+        slot.adapter_id = None if aid is None else int(aid)
+        self._slots[i] = slot
+        self._set_slot_samp(i, rec)
+        self._n_migrated_in += 1
+        self._m_occupancy.set(self.num_active)
+        if self._trace is not None:
+            self._trace.instant(
+                "admit_migrated", tid=1 + i,
+                args={"rid": rid, "cache_len": n_ctx, "mode": mode})
+            if rec.flow_id:
+                self._trace.flow("kv migrate", tid=1 + i,
+                                 flow_id=int(rec.flow_id), phase="f",
+                                 args={"rid": rid})
+        if mode != "swap":
+            # shared suffix-boundary block: COW before the recomputed
+            # tail writes into it (same as _seat_resume's path)
+            bidx = cached // self._bs
+            if self._alloc.is_shared(blocks[bidx]):
+                self._cow(i, bidx)
+            if not self._ragged and self._chunk_budget <= 0:
+                tok = self._advance_prefill(i)
+                self._finish_prefill(i, tok, [])
+        return rid
+
+    def drain_sessions(self):
+        """Drain this engine for a scale-down: every RESIDENT session
+        leaves as a :class:`MigratedSession` (live-migrated — the
+        client's stream continues on the target, token-exact), every
+        queued-but-unserved request comes back as its ServingRequest
+        for plain re-routing, and the engine ends empty. Preempted
+        queue residents (resume-carrying) migrate too, shipping their
+        host-tier spill payload when one survives (a missing payload
+        degrades to the recompute path on the target — correctness
+        never depends on the tier). Mid-prefill slots that have
+        streamed nothing are preempted back to the queue first (there
+        is nothing to move) and leave as fresh requests. Parked
+        handoff slots are NOT drained here — collect them with
+        ``pop_prefilled()`` first; their payloads are self-contained.
+        Queue exits observe outcome="migrated". Returns
+        ``(migrations, fresh_requests)``."""
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.handoff:
+                continue
+            if slot.pend_pos is not None and slot.resume is None:
+                # streamed nothing yet: cheaper to re-prefill on the
+                # target than to move a partial cache (counts as a
+                # preemption; the published blocks are purged by the
+                # caller, so the warm-start publish is moot here)
+                self._preempt(i)
+        migrations, fresh = [], []
+        while self._queue:
+            req = self._queue.popleft()
+            self._queue_exit(req, "migrated")
+            if req.resume is not None:
+                migrations.append(self._migrate_queued(req))
+            else:
+                fresh.append(req)
+        for i, slot in enumerate(self._slots):
+            if slot is not None and not slot.handoff:
+                migrations.append(self.export_session(i))
+        return migrations, fresh
+
+    def _migrate_queued(self, req) -> MigratedSession:
+        """A PREEMPTED request still waiting to resume leaves the
+        queue as a migration record: its continuation state rides the
+        resume dict, its KV rides the host-tier spill payload (when
+        one survives — otherwise the target recomputes from
+        history)."""
+        r = req.resume
+        rid = req.request_id
+        payload = None
+        if self._host_tier is not None and r.get("key") is not None:
+            payload = self._host_tier.get(r["key"])
+            self._host_tier.pop(r["key"], restore=False)
+            self._m_host_bytes.set(self._host_tier.bytes_used)
+        self._last_emit.pop(rid, None)
+        self._slo_ok.pop(rid, None)
+        self._results.pop(rid, None)
+        self._n_migrated_out += 1
+        return MigratedSession(
+            request_id=rid, prompt=np.asarray(req.prompt, np.int32),
+            history=list(map(int, r["history"])),
+            cache_len=int(r["cache_len"]),
+            last_token=int(r["last_token"]),
+            n_emitted=int(r["n_emitted"]),
+            max_new_tokens=int(req.max_new_tokens),
+            worst_blocks=int(r["worst_blocks"]),
+            n_blocks=int(r["n_blocks"]), payload=payload,
+            temperature=req.temperature, top_k=req.top_k,
+            top_p=req.top_p, priority=int(req.priority),
+            adapter_id=req.adapter_id)
+
+    def shed_queued(self, n: int) -> list:
+        """Pop up to ``n`` queued-but-unserved FRESH requests (newest
+        first — the oldest waiters keep their place) for the cluster
+        to re-route after a scale-up: without this, new capacity only
+        absorbs future arrivals while the burst that triggered the
+        scale keeps queueing here. Preempted resume-carrying waiters
+        are skipped — their KV lives on this replica. Queue exits
+        observe outcome="migrated", same as a scale-down drain."""
+        out, keep = [], []
+        while self._queue and len(out) < int(n):
+            req = self._queue.pop()
+            if req.resume is not None:
+                keep.append(req)
+                continue
+            self._queue_exit(req, "migrated")
+            out.append(req)
+        while keep:
+            self._queue.append(keep.pop())
+        return out
+
+    def purge_published(self) -> int:
+        """Wipe this engine's prefix-affinity surface — the
+        allocator's content index AND the host tier's published-block
+        spill entries — so ``published_overlap()`` scores 0 from now
+        on. Called when a replica drains (scale-down) or fails: the
+        router must never again steer a multi-turn session at KV this
+        replica no longer serves. Returns the number of index entries
+        dropped."""
+        n = self._alloc.unpublish_all()
+        if self._host_tier is not None:
+            n += self._host_tier.purge_published()
+            self._m_host_bytes.set(self._host_tier.bytes_used)
+        self._sync_cache_metrics()
+        return n
+
+    def warm_migration(self):
+        """Pre-build the export/import executable pair off the hot
+        path (scale-up warm): one null-block round trip, so the first
+        real migration or handoff on this replica compiles nothing —
+        the zero-steady-state-recompile pin holds across scale
+        cycles."""
+        payload = _pc.payload_rows(self._export_payload([]), 0)
+        if self._role != "prefill":
+            self._import_payload([], payload)
 
     # -- tracing ------------------------------------------------------
 

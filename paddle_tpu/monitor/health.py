@@ -559,6 +559,14 @@ class HealthMonitor:
         pen = sum(_SCORE_PENALTY[ALERT_SEVERITY[n]] for n in self.firing())
         return max(0.0, 1.0 - pen)
 
+    def burn_rates(self) -> Dict[str, float]:
+        """The last computed SLO burn rates, ``{"fast", "slow"}`` —
+        the autoscaler's cheap per-tick signal tap (ISSUE 19): the
+        full :meth:`snapshot` copies the journal every call, which is
+        too heavy to poll from a control loop."""
+        return {"fast": float(self._last_burn.get("fast", 0.0)),
+                "slow": float(self._last_burn.get("slow", 0.0))}
+
     def snapshot(self) -> dict:
         return {
             "health_score": self.score(),

@@ -316,6 +316,22 @@ class BlockAllocator:
         self._hash_of[b] = content_hash
         return True
 
+    def unpublish_all(self) -> int:
+        """Wipe the content index (replica drain / failure: the
+        cluster router must stop scoring prefix affinity onto this
+        pool — a published hash on a replica that no longer serves is
+        a route to nowhere). LRU-cached blocks (refcount 0, reachable
+        only through the index) return to the free list; live blocks
+        keep their references and merely lose their published hashes.
+        Returns the number of index entries dropped."""
+        n = len(self._hash_of)
+        for b in self._lru:
+            self._free.append(b)
+        self._lru.clear()
+        self._hash_of.clear()
+        self._by_hash.clear()
+        return n
+
     def check_leaks(self, live_blocks=()):
         """Debug invariant sweep (engine shutdown in tests): every
         block is exactly one of {free, LRU-cached, referenced}, the
@@ -907,6 +923,21 @@ class HostKVTier:
         if restore:
             self.restores += 1
         return it[0]
+
+    def purge_published(self) -> int:
+        """Drop every LRU-evicted published-block entry (keys shaped
+        ``("pub", hash)``) — the host-side half of a replica-drain
+        index purge (``BlockAllocator.unpublish_all``): a drained or
+        dead replica must stop answering the router's affinity probe
+        from its spill tier too. Victim payloads (in-flight resume
+        state) are untouched. Returns the number of entries dropped."""
+        keys = [k for k in self._items
+                if isinstance(k, tuple) and k and k[0] == "pub"]
+        for k in keys:
+            _, nb, _ = self._items.pop(k)
+            self.bytes_used -= nb
+            self.drops += 1
+        return len(keys)
 
 
 def gather_dense(pool, block_tables):

@@ -115,7 +115,46 @@ import pytest
 # construction; the solo-reference serves are shared across the
 # batched/spec/TP/cluster parity tests via a module-level cache, so
 # adding a parity pairing reuses refs instead of re-serving them.
+#
+# r19 re-sweep (elastic autoscaling + live migration): the 19 new
+# test_autoscale.py tests measured ~49s total solo, slowest ~6s (the
+# int8 arm of the token-exact drain matrix — a solo reference engine
+# plus a 2-replica cluster per variant) — all well under the ~9s
+# line, so no in-file markers. The policy and loadgen-profile tests
+# are model-free (<1s combined); the chaos tests keep max_new small
+# and reuse one 2-replica cluster per scenario, so the budget stays
+# engine-construction-bound. The accumulated r13-r19 growth did push
+# the whole tier past its budget, so this round also re-tiers (the
+# r16 pattern): a full --durations sweep on the session box (1-CPU,
+# the r17 caveat class — 776 passed, 0 failed, 1100s) moved the 12
+# heaviest unpinned tests below into the slow set, each a parity
+# pairing or demo whose subsystem keeps cheaper tier-1 coverage
+# (beam4-vs-numpy keeps 6 beam tests; chrome-trace-load keeps the
+# handler/format/xplane trio; the TP sampling/sharded-step/int8
+# trims keep the guard-pinned tp2-census + tp4-exact pair; the int8
+# serving trims keep the kv-quant kernel parities and engine
+# pairings; the qwen2 left-pad + predictor trims keep the Llama
+# left-pad + predictor-beam paths). 12 moved < 19 added, so the
+# tier's test count still grows this round. Durations annotated
+# below are from the 1-CPU sweep; multi-core boxes run ~40-60% of
+# that. Post-trim the tier measured 1015s on the same 1-CPU box
+# (764 passed, 0 failed) — i.e. back inside budget everywhere but
+# the serialized-compile 1-CPU class.
 _SLOW_TESTS = {
+    # r19 re-tier (1-CPU durations; see note above):
+    "test_export_chrome_trace_loadable",                        # 10.5s
+    "test_generation_predictor",                                # 9.8s
+    "test_tp_sampling_parity",                                  # 9.5s
+    "test_int8_teacher_forced_trajectory_floor",                # 8.8s
+    "test_sharded_step_matches_single_program",                 # 8.4s
+    "test_serving_gpt_family",                                  # 8.3s
+    "test_beam4_matches_numpy_reference",                       # 8.1s
+    "test_dryrun_moe_ep_metrics_export",                        # 7.6s
+    "test_serving_int8_quantized_model",                        # 5.7s
+    "test_quantize_for_inference_swaps_and_generates",          # 5.4s
+    "test_left_padded_generate_qwen2_moe",                      # 4.8s
+    "test_tp_int8_quantized",                                   # 4.2s
+    # pre-r19 entries:
     "test_beam_equals_exhaustive_when_beam_is_vocab",           # 50s
     "test_ep_dropless_vs_capacity_loss_parity",                 # 35s
     "test_ep_dropless_output_matches_single_device",            # 35s
