@@ -2267,6 +2267,166 @@ def _ragged_serving_bench():
     return out
 
 
+def _async_bench():
+    """Async tick pipeline (the ISSUE-20 bar): the SAME decode-heavy
+    workload through the blocking loop (``async_depth=0``) and the
+    depth-1 dispatch-ahead pipeline (``async_depth=1``), single
+    engine AND a 2-replica cluster (serial replica ticking vs
+    dispatch-all-then-commit-all). The pipeline's win is evicting the
+    host from the device's critical path — commit bookkeeping,
+    digests, tracing and the token fetch overlap the next tick's
+    execution — so the measurable headline is ``host_gap_ms`` (the
+    dispatch→dispatch host time the device sees) and the aggregate
+    tok/s ratio. Caveat the proxy honestly: overlap converts host
+    idle/blocked time into device progress, which requires host and
+    device to run CONCURRENTLY — true on any real accelerator and on
+    a multi-core CPU proxy, but on a single-core container
+    (``cpu_cores: 1``) the XLA compute threads and the host thread
+    time-share one core, total CPU work is the wall clock, and the
+    measured ratio pins near 1.0 regardless of pipeline structure
+    (the residual win is the per-tick host packing the device-
+    resident carry eliminates). The >= 1.15x two-replica bar is
+    therefore a multi-core/accelerator assertion; ``cpu_cores`` in
+    the output says which regime this run measured."""
+    import gc
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ServingConfig, ServingEngine
+    from paddle_tpu.inference.cluster import (ClusterConfig,
+                                              EngineCluster)
+
+    # sized so host bookkeeping and the device tick are comparable —
+    # the regime where overlap pays; a huge model would bury the host
+    # in device time and a toy one has nothing to hide the host
+    # behind. fp32 on purpose: the CPU proxy emulates bf16 slowly,
+    # which inflates the device tick and drowns the host fraction the
+    # pipeline exists to hide. Many slots (16) keeps the O(slots)
+    # per-tick commit bookkeeping a visible slice of the gap.
+    cfg = LlamaConfig(
+        vocab_size=int(os.environ.get("BENCH_ASYNC_VOCAB", 4096)),
+        hidden_size=int(os.environ.get("BENCH_ASYNC_HIDDEN", 256)),
+        intermediate_size=int(os.environ.get("BENCH_ASYNC_FFN", 704)),
+        num_hidden_layers=int(os.environ.get("BENCH_ASYNC_LAYERS", 2)),
+        num_attention_heads=8, num_key_value_heads=4,
+        max_position_embeddings=512)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    slots = int(os.environ.get("BENCH_ASYNC_SLOTS", 16))
+    new = int(os.environ.get("BENCH_ASYNC_NEW", 32))
+    n_req = int(os.environ.get("BENCH_ASYNC_REQS", 32))
+    plens = [24, 40, 56, 32]
+    rng = np.random.RandomState(0)
+    warm = [rng.randint(1, cfg.vocab_size, (p,)) for p in plens]
+
+    def drain(target, workload):
+        """Submit everything up front (decode-heavy steady state —
+        the pipeline's regime) and drain on step()."""
+        tokens0 = target.stats()["tokens_total"]
+        execs0 = target.stats()["executables_compiled"]
+        for p in workload:
+            target.submit(p.copy(), new)
+        t0 = time.perf_counter()
+        while target.num_queued or target.num_active:
+            target.step()
+        wall = time.perf_counter() - t0
+        st = target.stats()
+        hg = st.get("host_gap_ms")
+        if hg is None:              # cluster: slowest replica's digest
+            hg = max((r["host_gap_ms"] for r in st["replicas"] if r),
+                     key=lambda d: d["p50"],
+                     default={"p50": 0.0, "p99": 0.0})
+        return {
+            "aggregate_tokens_per_sec":
+                round((st["tokens_total"] - tokens0) / wall, 1),
+            "host_gap_ms_p50": hg["p50"],
+            "host_gap_ms_p99": hg["p99"],
+            "async_depth": st["async_depth"],
+            "pipeline_flushes": st["pipeline_flushes"],
+            "recompiles_measured":
+                st["executables_compiled"] - execs0,
+        }
+
+    def fresh(n, seed):
+        """A fresh workload per drain — repeating identical prompts
+        would hit the prefix cache and erase the prefill phase,
+        changing the regime between repetitions."""
+        r = np.random.RandomState(seed)
+        return [r.randint(1, cfg.vocab_size, (plens[i % len(plens)],))
+                for i in range(n)]
+
+    def build_engine(depth):
+        eng = ServingEngine(model, ServingConfig(
+            num_slots=slots, block_size=16, max_model_len=256,
+            max_new_tokens=new, async_depth=depth))
+        eng.serve([p.copy() for p in warm], max_new_tokens=4)
+        return eng
+
+    def build_cluster(depth):
+        cl = EngineCluster(
+            model, ClusterConfig(num_replicas=2),
+            ServingConfig(num_slots=slots, block_size=16,
+                          max_model_len=256, max_new_tokens=new,
+                          async_depth=depth))
+        cl.serve([rng.randint(1, cfg.vocab_size, (p,))
+                  for p in plens * 2], max_new_tokens=4)
+        return cl
+
+    def duel(base, cand, n, reps=3):
+        """Alternate drains between the two warm targets and keep
+        each side's best. Host-scheduler drift on the CPU proxy moves
+        absolute tok/s by 10-20% over seconds — back-to-back
+        alternation puts both arms inside every drift window, so the
+        *ratio* stays meaningful where sequential measurement of one
+        full arm then the other does not."""
+        b_runs, c_runs = [], []
+        for i in range(reps):
+            b_runs.append(drain(base, fresh(n, 100 + i)))
+            c_runs.append(drain(cand, fresh(n, 200 + i)))
+        key = lambda r: r["aggregate_tokens_per_sec"]
+        return max(b_runs, key=key), max(c_runs, key=key)
+
+    eng0, eng1 = build_engine(0), build_engine(1)
+    sync_eng, async_eng = duel(eng0, eng1, n_req)
+    eng0.shutdown()
+    eng1.shutdown()
+    cl0, cl1 = build_cluster(0), build_cluster(1)
+    serial_cl, overlap_cl = duel(cl0, cl1, 2 * n_req)
+    cl0.shutdown()
+    cl1.shutdown()
+    out = {
+        "engine_sync": sync_eng,
+        "engine_async": async_eng,
+        "async_tokens_per_sec":
+            async_eng["aggregate_tokens_per_sec"],
+        "async_speedup": round(
+            async_eng["aggregate_tokens_per_sec"]
+            / max(sync_eng["aggregate_tokens_per_sec"], 1e-9), 3),
+        "cluster_serial": serial_cl,
+        "cluster_overlapped": overlap_cl,
+        "async_cluster_tokens_per_sec":
+            overlap_cl["aggregate_tokens_per_sec"],
+        "async_cluster_speedup": round(
+            overlap_cl["aggregate_tokens_per_sec"]
+            / max(serial_cl["aggregate_tokens_per_sec"], 1e-9), 3),
+        "host_gap_ms_p50": async_eng["host_gap_ms_p50"],
+        "num_slots": slots, "max_new_tokens": new,
+        "requests": n_req, "workload_prompt_lens": plens,
+        "model_shape": {
+            "hidden": cfg.hidden_size,
+            "layers": cfg.num_hidden_layers,
+            "ffn": cfg.intermediate_size, "vocab": cfg.vocab_size},
+        "cpu_proxy": jax.default_backend() != "tpu",
+        "cpu_cores": len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity") else (os.cpu_count() or 1),
+    }
+    del model
+    gc.collect()
+    return out
+
+
 def _moe_serving_bench():
     """MoE through the serving engine (the ISSUE-8 'excluded ->
     served, measured' bar): a mixed-length workload on a dropless
@@ -2724,6 +2884,10 @@ def main():
         autoscale = _autoscale_bench()
     except Exception as exc:
         autoscale = {"error": repr(exc)}
+    try:
+        serving_async = _async_bench()
+    except Exception as exc:
+        serving_async = {"error": repr(exc)}
 
     detail = {"large": large, "base": base,
               "remat_regime": remat_regime, "deep": deep,
@@ -2749,6 +2913,7 @@ def main():
               "health": health,
               "lora": lora,
               "autoscale": autoscale,
+              "serving_async": serving_async,
               # headline config's compiled-step accounting (analytic
               # FLOPs/step, peak HBM, collective census, cache counts)
               "telemetry": large.get("telemetry")
@@ -2769,6 +2934,7 @@ def main():
                          "serving_ragged", "kv_quant", "goodput",
                          "roofline", "cluster", "fusion", "preempt",
                          "flashmask", "health", "lora", "autoscale",
+                         "serving_async",
                          "moe_profile", "moe_fused", "moe_serving")
         } | {"decode_tokens_per_sec":
              decode.get("decode_tokens_per_sec")
@@ -2932,7 +3098,19 @@ def main():
              if isinstance(autoscale, dict) else None,
              "migration_p99_ms":
              autoscale.get("migration_p99_ms")
-             if isinstance(autoscale, dict) else None},
+             if isinstance(autoscale, dict) else None,
+             "async_tokens_per_sec":
+             serving_async.get("async_tokens_per_sec")
+             if isinstance(serving_async, dict) else None,
+             "async_speedup":
+             serving_async.get("async_speedup")
+             if isinstance(serving_async, dict) else None,
+             "async_cluster_speedup":
+             serving_async.get("async_cluster_speedup")
+             if isinstance(serving_async, dict) else None,
+             "host_gap_ms_p50":
+             serving_async.get("host_gap_ms_p50")
+             if isinstance(serving_async, dict) else None},
     }
     # trajectory contract (ISSUE 11/12 CI satellites): the goodput SLO
     # and cluster keys must be present in every round's summary — fail
@@ -2950,7 +3128,9 @@ def main():
               "lora_tokens_per_sec", "lora_batched_speedup",
               "lora_adapters_resident", "lora_churn_recompiles",
               "autoscale_goodput_delta",
-              "autoscale_replica_ticks_saved", "migration_p99_ms"):
+              "autoscale_replica_ticks_saved", "migration_p99_ms",
+              "async_tokens_per_sec", "async_speedup",
+              "async_cluster_speedup", "host_gap_ms_p50"):
         assert k in result["summary"], f"bench summary lost {k!r}"
     print(json.dumps(result))
     try:

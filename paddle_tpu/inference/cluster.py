@@ -614,12 +614,25 @@ class EngineCluster:
                     self._pending.append((i, rec))
         self._place_handoffs()
         self._place_migrations()
+        # decode replicas tick dispatch-all-then-commit-all: every
+        # async replica's executable is IN FLIGHT before any replica
+        # blocks on its token fetch, so N launches run concurrently
+        # instead of serially. A sync replica (async_depth=0 /
+        # PADDLE_TPU_ASYNC_TICK=0) runs its whole step inside the
+        # dispatch phase and no-ops the commit phase — the loop then
+        # degrades to today's serial ticking bit-for-bit.
+        stepped = []
         for i in list(self._decode_idx):
             if i in self._failed:
                 continue
             eng = self._engines[i]
             if eng.num_queued or eng.num_active:
-                self._safe_step(i)
+                self._safe_phase(i, dispatch=True)
+                stepped.append(i)
+        for i in stepped:
+            if i in self._failed:
+                continue
+            self._safe_phase(i, dispatch=False)
         self._collect_done()
         if self._health_on:
             self._watchdog_sweep()
@@ -1379,6 +1392,14 @@ class EngineCluster:
                    if self._incident is not None else 0),
             "nonfinite_logits_ticks":
                 sum(r["nonfinite_logits_ticks"] for r in reps),
+            # async tick pipeline (ISSUE 20): ALWAYS present — max
+            # depth across live replicas (the fleet's commit lag is
+            # the deepest replica's) and a flush-count sum; a sync or
+            # killed fleet reports 0/0
+            "async_depth": max((r["async_depth"] for r in reps),
+                               default=0),
+            "pipeline_flushes":
+                sum(r["pipeline_flushes"] for r in reps),
             "roofline": roofline,
             "replicas": reps_all,
         }
@@ -1533,6 +1554,25 @@ class EngineCluster:
     def _safe_step(self, idx):
         try:
             self._engines[idx].step()
+        except Exception as exc:        # noqa: BLE001 — fault domain
+            warnings.warn(
+                f"cluster replica {idx} failed mid-step ({exc!r}); "
+                "draining its queue back to the router")
+            self.fail_replica(idx)
+            if not self._live():
+                raise
+
+    def _safe_phase(self, idx, dispatch: bool):
+        """One phase of an overlapped decode tick (same fault domain
+        as ``_safe_step``): dispatch launches the replica's next tick,
+        commit drains its lagging host bookkeeping. Sync replicas run
+        their whole step in the dispatch phase."""
+        try:
+            eng = self._engines[idx]
+            if dispatch:
+                eng.tick_dispatch()
+            else:
+                eng.tick_commit()
         except Exception as exc:        # noqa: BLE001 — fault domain
             warnings.warn(
                 f"cluster replica {idx} failed mid-step ({exc!r}); "

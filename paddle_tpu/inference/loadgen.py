@@ -351,14 +351,23 @@ def run_load(engine, prompts: Sequence[np.ndarray], *,
     if qps_profile is not None:
         report["qps_profile"] = dict(qps_profile)
     if record_path is not None:
+        # async tick pipeline (ISSUE 20): stamp the target's commit
+        # lag onto every row — under async_depth=1 each token_t is
+        # observed one tick after its device step, so SLO digests
+        # computed offline need the lag to interpret the timestamps
+        try:
+            lag = int(engine.stats().get("async_depth", 0))
+        except Exception:           # torn down before the snapshot
+            lag = 0
         report["record_path"] = write_records(
             records.values(), record_path, slo=slo,
-            qps_profile=qps_profile)
+            qps_profile=qps_profile, commit_lag_ticks=lag)
     return report
 
 
 def write_records(records, path: str, slo: Optional[SLO] = None,
-                  qps_profile: Optional[dict] = None) -> str:
+                  qps_profile: Optional[dict] = None,
+                  commit_lag_ticks: int = 0) -> str:
     """One NDJSON row per request (ISSUE 15 satellite): submit /
     first-token / last-token timestamps (``time.monotonic()``
     seconds — the SAME clock base the span tracer exports, whose
@@ -371,6 +380,12 @@ def write_records(records, path: str, slo: Optional[SLO] = None,
     runs), every row carries the profile dict — offline analysis can
     reconstruct the offered λ(t) each request arrived under; rows of
     a fixed-QPS run are byte-identical to before the knob existed.
+    ``commit_lag_ticks`` (ISSUE 20) records the serving target's
+    ``async_depth`` at collection time: under the async tick pipeline
+    the stream callback — and therefore every ``token_t`` stamp —
+    fires at COMMIT, one tick after the device produced the token, so
+    offline TTFT/ITL analysis knows the observation lag (0 = stamps
+    are same-tick, the sync loop).
     Returns ``path``."""
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
@@ -396,6 +411,7 @@ def write_records(records, path: str, slo: Optional[SLO] = None,
                 else None,
                 "outcome": "completed" if r.completed
                 else "no_tokens",
+                "commit_lag_ticks": int(commit_lag_ticks),
             }
             if slo is not None:
                 row["slo_met"] = bool(r.meets(slo))

@@ -481,6 +481,22 @@ class ServingConfig:
     # scales, dequantized in-trace — the PR 10 KV-pool recipe applied
     # to the delta weights; ~4x adapters per resident byte)
     lora_quant: bool = False
+    # -- async tick pipeline (docs/OPS.md "Async tick pipeline") ------
+    # async_depth=1 arms depth-1 dispatch-ahead on the ragged engine:
+    # the tick executable additionally returns next-tick inputs
+    # (per-slot sampled token, advanced lengths, a budget/EOS ``done``
+    # mask) as DEVICE arrays, and on pure steady-state decode ticks
+    # the engine dispatches tick N+1 from that device-resident carry
+    # while tick N's tokens copy to host asynchronously — commit
+    # (emit/retire/stats/tracing) lags one tick. Any slot-composition
+    # event (admission, retirement, preemption, migration, handoff,
+    # cancel) flushes the pipeline, so async ON == OFF stays greedy
+    # token-exact. Requires the ragged engine. Env twin
+    # PADDLE_TPU_ASYNC_TICK: 0 = kill switch (beats an explicit depth
+    # — today's dispatch-then-block loop returns bit-for-bit, same
+    # executables), 1 = depth-1 when this field is left None. Only
+    # depth 1 is implemented.
+    async_depth: Optional[int] = None
 
     def __post_init__(self):
         # reject broken degrees HERE, with a message, instead of as a
@@ -521,6 +537,12 @@ class ServingConfig:
             raise ValueError(
                 f"health_watchdog_mult must be >= 1, got "
                 f"{self.health_watchdog_mult!r}")
+        ad = self.async_depth
+        if ad is not None and (not isinstance(ad, int)
+                               or isinstance(ad, bool)
+                               or ad < 0 or ad > 1):
+            raise ValueError(
+                f"async_depth must be 0, 1 or None, got {ad!r}")
         lr = self.lora_rank
         if not isinstance(lr, int) or isinstance(lr, bool) or lr < 0:
             raise ValueError(
@@ -697,6 +719,21 @@ class _Slot:
         self.prompt = prompt            # int32 prompt (pending chunks)
         self.pend_pos = pend_pos        # next chunk start; None = done
         self.pend_row = None            # device table row for chunks
+
+
+class _Pipe:
+    """One dispatched-but-uncommitted ragged tick (the async
+    pipeline's in-flight record): the executable's output futures plus
+    the host-side row layout the commit half needs. ``pure`` marks a
+    decode-only tick whose ``carry`` (device-resident next-tick packs)
+    may feed a pipelined dispatch."""
+    __slots__ = ("outs", "active", "given", "n_pending", "q_lens",
+                 "rid_of", "pend_pos0", "t_tick", "t_l0", "pure",
+                 "carry")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
 
 
 class ServingEngine:
@@ -990,6 +1027,36 @@ class ServingEngine:
                 "spec_tree requires the ragged engine (ragged_batch="
                 "True without PADDLE_TPU_RAGGED_BATCH=0); to disable "
                 "tree speculation itself use PADDLE_TPU_SPEC_TREE=0")
+        # -- async tick pipeline (docs/OPS.md "Async tick pipeline") --
+        # resolved ONCE at construction: config depth AND the
+        # PADDLE_TPU_ASYNC_TICK env twin (0 = kill switch beating an
+        # explicit depth — today's dispatch-then-block loop returns
+        # bit-for-bit; 1 arms depth-1 when the field is left None)
+        _ad = getattr(cfg, "async_depth", None)
+        _ae = os.environ.get("PADDLE_TPU_ASYNC_TICK", "")
+        if _ae == "0":
+            _depth = 0
+        elif _ad is None:
+            _depth = 1 if _ae == "1" else 0
+        else:
+            _depth = int(_ad)
+        if _depth and not self._ragged:
+            if _ad is None:
+                _depth = 0      # env-armed: best-effort, legacy engine
+            else:
+                raise NotImplementedError(
+                    "async_depth requires the ragged engine "
+                    "(ragged_batch=True without "
+                    "PADDLE_TPU_RAGGED_BATCH=0); to disable the "
+                    "pipeline itself use PADDLE_TPU_ASYNC_TICK=0")
+        self._async_on = _depth >= 1
+        self._async_depth = 1 if self._async_on else 0
+        self._pipe = None               # in-flight (uncommitted) tick
+        self._commit_due = None         # commit half of a split tick
+        self._n_pipe_flushes = 0
+        self._last_dispatch_t = None    # host-gap digest anchor
+        self._split_t0 = 0.0            # cluster phase-split health
+        self._split_c0 = 0              # bracket (tick_dispatch)
         if self._chunked:
             want = cfg.ragged_prefill_rows
             self._prefill_rows = max(1, min(
@@ -1395,6 +1462,19 @@ class ServingEngine:
         # zeroed summary) so stats()['spec_accept_len'] and the
         # serving_spec_accept_len gauge are always present
         self._d_accept = LatencyDigest()
+        # dispatch -> dispatch host time, as a P² digest —
+        # unconditional (sync engines observe too: their gap includes
+        # the blocking token fetch + commit bookkeeping, which is
+        # exactly what async_depth=1 moves off the critical path), so
+        # stats()['host_gap_ms'] and the serving_host_gap_ms gauge are
+        # always present
+        self._d_host_gap = LatencyDigest()
+        self._m_host_gap = monitor.gauge(
+            "serving_host_gap_ms",
+            "host time between consecutive tick dispatches (P2 "
+            "digest; under async_depth=1 commit bookkeeping overlaps "
+            "device execution, so the gap shrinks toward pure "
+            "pack+launch time)", labels=("q",))
         self._m_accept = monitor.gauge(
             "serving_spec_accept_len",
             "accepted-length quantiles per slot verify window (P2 "
@@ -1666,6 +1746,7 @@ class ServingEngine:
         e2e digest observes submit -> cancel. Returns False only when
         the id is unknown (never submitted, already finished, or
         already cancelled)."""
+        self._flush_pipe()      # commit in-flight ticks before mutating
         for k, req in enumerate(self._queue):
             if req.request_id == request_id:
                 del self._queue[k]
@@ -1831,6 +1912,8 @@ class ServingEngine:
 
     def _step_dispatch(self) -> List[tuple]:
         if self._ragged:
+            if self._async_on:
+                return self._step_async()
             return self._step_ragged()
         if self._gamma:
             return self._step_spec()
@@ -2077,7 +2160,23 @@ class ServingEngine:
         (``num_slots * (gamma+1) + prefill_rows``); slots with no work
         contribute zero rows, so raggedness lives entirely in the
         ``q_lens``/``row_starts`` VALUES and steady state runs zero
-        recompiles exactly like the per-width path it replaces."""
+        recompiles exactly like the per-width path it replaces.
+
+        The tick is split into a dispatch half (pack + launch) and a
+        commit half (token fetch + host bookkeeping); this sync path
+        runs them back to back, the async pipeline (``_step_async``)
+        lags the commit one tick behind the dispatch."""
+        pipe, emitted = self._ragged_dispatch()
+        if pipe is not None:
+            emitted.extend(self._ragged_commit(pipe))
+        return emitted
+
+    def _ragged_dispatch(self):
+        """Dispatch half of one ragged tick: admit, pack the row
+        layout, launch the ONE executable. Returns ``(pipe, emitted)``
+        — ``pipe`` holds everything the commit half needs (``None`` on
+        an idle tick), ``emitted`` carries admission-time prefill
+        tokens."""
         from ..generation import speculative as _spec
         t_tick = time.monotonic()
         emitted = self._admit()
@@ -2090,14 +2189,14 @@ class ServingEngine:
         pending = [i for i, s in enumerate(self._slots)
                    if s is not None and s.pend_pos is not None]
         if not active and not pending:
-            return emitted
+            return None, emitted
         if active:
             # room for this tick's write positions (the verify window
             # overhangs by up to gamma speculated slots); growth under
             # an overcommitted pool may preempt — survivors only
             active = self._ensure_blocks(active, horizon=g + 1)
             if not active and not pending:
-                return emitted
+                return None, emitted
 
         # -- pack the tick's work into per-slot row counts -------------
         q_lens = np.zeros(n_slots, np.int64)
@@ -2134,7 +2233,7 @@ class ServingEngine:
             given[i] = k
             budget -= k
         if not int(q_lens.sum()):
-            return emitted      # budget exhausted by earlier slots
+            return None, emitted    # budget exhausted by earlier slots
         row_slot, row_pos, row_starts, last_rows = _pc.ragged_row_meta(
             q_lens, base, self._rows, self._overflow)
         if self._tables_dev is None:
@@ -2229,6 +2328,17 @@ class ServingEngine:
             # tensor — churn changes VALUES at a fixed shape, so no
             # adapter mix ever recompiles the tick
             srows.append(self._slot_adapter)
+        if self._async_on and not g:
+            # LAST row of the async slots pack: each slot's remaining
+            # token budget (max_new - emitted). The executable's done
+            # mask retires rows on device (budget <= 1 or EOS), so a
+            # pipelined tick dispatched from the carry no-ops finished
+            # slots without a host round trip.
+            bud = np.zeros(n_slots, np.int64)
+            for i in active:
+                s = self._slots[i]
+                bud[i] = s.max_new - s.n_emitted
+            srows.append(bud)
         slots_pack = np.stack(srows).astype(np.int32)
         args = [self._params, self._pools, self._tables_dev,
                 self._dev(rows_pack), self._dev(slots_pack)]
@@ -2246,16 +2356,25 @@ class ServingEngine:
         args.append(sub)
         if self._ragged_exec is None:
             self._ragged_exec = self._compile_ragged_step(tuple(args))
-        tr = self._trace
-        if tr is not None:
-            # names/positions BEFORE the commit loops retire slots
-            rid_of = {i: self._slots[i].rid
-                      for i in active + list(given)}
-            pend_pos0 = {i: int(self._slots[i].pend_pos)
-                         for i in given}
+        # names/positions BEFORE the commit loops retire slots (the
+        # async commit guard also keys on these: a slot reseated with
+        # a DIFFERENT request between dispatch and commit must drop
+        # the stale tick's token)
+        rid_of = {i: self._slots[i].rid
+                  for i in active + list(given)}
+        pend_pos0 = {i: int(self._slots[i].pend_pos)
+                     for i in given}
         t_l0 = time.monotonic()
+        if self._last_dispatch_t is not None:
+            self._d_host_gap.observe(
+                1000.0 * (t_l0 - self._last_dispatch_t))
+        self._last_dispatch_t = t_l0
         with _quiet_donation():
             outs = self._ragged_exec(*args)
+        if self._async_on:
+            # the pools advance at DISPATCH (device futures): the next
+            # launch consumes them before this tick's commit runs
+            self._pools = outs[-1]
 
         self._m_steps.inc()
         self._n_decode_steps += 1
@@ -2266,6 +2385,35 @@ class ServingEngine:
         # packed row t of slot s attends base[s] + t + 1 positions
         self._note_kv_read(int((q_lens * base).sum())
                            + int((q_lens * (q_lens + 1) // 2).sum()))
+        pure = (self._async_on and not g and not given and not pending)
+        pipe = _Pipe(
+            outs=outs, active=list(active), given=given,
+            n_pending=len(pending), q_lens=q_lens, rid_of=rid_of,
+            pend_pos0=pend_pos0, t_tick=t_tick, t_l0=t_l0, pure=pure,
+            carry=(outs[2], outs[3]) if pure else None)
+        return pipe, emitted
+
+    def _ragged_commit(self, pipe) -> List[tuple]:
+        """Commit half of one ragged tick: fetch tokens, advance
+        slots, retire, commit prefill progress, emit trace spans.
+        Under async pipelining this runs one tick AFTER its dispatch —
+        a slot retired, cancelled, preempted or migrated in between is
+        skipped, dropping the speculative extra tick's token exactly
+        (its KV write already null-routed on device via the carry's
+        ``done`` mask, so there is nothing to trim)."""
+        outs = pipe.outs
+        g = self._gamma
+        n_slots = self.config.num_slots
+        active, given, q_lens = pipe.active, pipe.given, pipe.q_lens
+        rid_of, pend_pos0 = pipe.rid_of, pipe.pend_pos0
+        t_tick, t_l0 = pipe.t_tick, pipe.t_l0
+        tr = self._trace
+        emitted: List[tuple] = []
+        committed = active
+        if self._async_on:
+            committed = [i for i in active
+                         if self._slots[i] is not None
+                         and self._slots[i].rid == rid_of[i]]
 
         # -- commit decode / verify rows -------------------------------
         acc_lens = {}
@@ -2273,9 +2421,10 @@ class ServingEngine:
             tok_arr = np.asarray(outs[0])
             if self._health is not None:        # host fetch gated on
                 self._nf_last = bool(outs[1])   # the kill switch only
-            self._pools = outs[2]
+            if not self._async_on:
+                self._pools = outs[2]
             t_sync = time.monotonic()
-            for i in active:
+            for i in committed:
                 slot = self._slots[i]
                 tok = int(tok_arr[i])
                 slot.cache_len += 1
@@ -2295,9 +2444,10 @@ class ServingEngine:
                 props_next = np.asarray(outs[3])
             if self._health is not None:        # gated host fetch
                 self._nf_last = bool(outs[k])
-            self._pools = outs[k + 1]
+            if not self._async_on:
+                self._pools = outs[k + 1]
             t_sync = time.monotonic()
-            for i in active:
+            for i in committed:
                 acc_lens[i] = self._commit_verify_window(
                     i, out[i], accept[i], emitted)
             if self._heads is not None:
@@ -2305,7 +2455,7 @@ class ServingEngine:
                 # slot that survived the commit (retired/preempted
                 # slots dropped theirs); fresh slots without a cached
                 # proposal draft via the ngram-topk fallback next tick
-                for i in active:
+                for i in committed:
                     if self._slots[i] is not None:
                         self._slot_props[i] = props_next[i]
             if self._n_spec_proposed:
@@ -2329,7 +2479,11 @@ class ServingEngine:
                 # sampled logits are the request's first token
                 self._finish_prefill(i, int(tok_arr[i]), emitted)
         if tr is not None:
-            for i in active:
+            # under async the span's [t_l0, t_sync] brackets dispatch
+            # -> commit, i.e. it INCLUDES the one-tick overlap window
+            # (commit-lag semantics, docs/OPS.md "Async tick
+            # pipeline"); dropped (stale-slot) ticks emit no span
+            for i in committed:
                 args_i = {"rid": rid_of[i], "rows": int(q_lens[i])}
                 if g:
                     args_i["accepted_len"] = acc_lens[i]
@@ -2343,10 +2497,218 @@ class ServingEngine:
             self._trace_tick(
                 t_tick, "verify" if g else "decode", "ragged",
                 rows=int(q_lens.sum()), active=len(active),
-                pending=len(pending),
+                pending=pipe.n_pending,
                 occupancy=round(
-                    (len(active) + len(pending)) / n_slots, 3))
+                    (len(active) + pipe.n_pending) / n_slots, 3))
         return emitted
+
+    # -- async tick pipeline (docs/OPS.md "Async tick pipeline") ------
+
+    def _step_async(self) -> List[tuple]:
+        """One engine tick with depth-1 dispatch-ahead: launch tick
+        N+1 (from the device-resident carry when the slot composition
+        is unchanged, sync-shaped otherwise), THEN commit tick N —
+        host bookkeeping overlaps device execution."""
+        out = self._tick_dispatch_async()
+        out.extend(self._tick_commit_async())
+        return out
+
+    def _tick_dispatch_async(self) -> List[tuple]:
+        emitted: List[tuple] = []
+        prev = self._pipe
+        if prev is not None and self._pipe_ready(prev):
+            self._pipe = self._dispatch_pipelined(prev)
+            self._commit_due = prev
+            return emitted
+        if prev is not None:
+            # the slot composition wants to change (admission waiting,
+            # a dispatched slot retired/cancelled/preempted/migrated,
+            # prefill rows due, pool dry): drain the pipeline first,
+            # then dispatch sync-shaped
+            self._pipe = None
+            self._n_pipe_flushes += 1
+            emitted.extend(self._ragged_commit(prev))
+        pipe, pre = self._ragged_dispatch()
+        emitted.extend(pre)
+        if pipe is None:
+            return emitted
+        if pipe.pure:
+            self._pipe = pipe           # commit lags one tick
+        else:
+            self._commit_due = pipe     # commits this very tick
+        return emitted
+
+    def _tick_commit_async(self) -> List[tuple]:
+        due, self._commit_due = self._commit_due, None
+        if due is None:
+            return []
+        return self._ragged_commit(due)
+
+    def tick_dispatch(self) -> List[tuple]:
+        """Dispatch phase of an overlapped CLUSTER tick: launch this
+        engine's next tick and defer the lagging commit to
+        ``tick_commit()``, so N replicas' executables run concurrently
+        instead of serially. Sync engines (async off) run their whole
+        step here — the cluster's dispatch-all-then-commit-all loop
+        then degrades to today's serial ticking bit-for-bit."""
+        if not self._async_on:
+            return self.step()
+        self._split_t0 = time.monotonic()
+        self._split_c0 = self._n_exec_compiled
+        with self._prof.tick():
+            return self._tick_dispatch_async()
+
+    def tick_commit(self) -> List[tuple]:
+        """Commit phase of an overlapped cluster tick (no-op on sync
+        engines — their ``tick_dispatch`` already committed)."""
+        if not self._async_on:
+            return []
+        out = self._tick_commit_async()
+        if self._health is not None:
+            self._health_tick(self._split_t0, time.monotonic(),
+                              self._split_c0)
+        return out
+
+    def _pipe_ready(self, pipe) -> bool:
+        """May the next tick dispatch straight from the in-flight
+        tick's device carry? Requires an unchanged slot composition
+        (every dispatched slot still seated with the same request,
+        nothing queued, pending or parked) and block headroom for one
+        more position per slot — grown WITHOUT preemption (a
+        mid-pipeline victim would spill stale host state); a dry pool
+        flushes instead and the sync path re-runs growth with
+        preemption armed."""
+        if not pipe.pure or self._handoff_ready:
+            return False
+        for i in pipe.active:
+            s = self._slots[i]
+            if s is None or s.rid != pipe.rid_of[i]:
+                return False
+        if self._queue:
+            # a backed-up queue is safe to pipeline over ONLY when the
+            # in-flight commit provably frees no slot: no EOS
+            # configured and no dispatched slot on its last budgeted
+            # token. Then no admission is possible this tick in the
+            # sync schedule either — composition provably unchanged.
+            # Otherwise flush, so a retirement admits the newcomer on
+            # exactly the tick the blocking loop would have.
+            if self._eos >= 0:
+                return False
+            for i in pipe.active:
+                s = self._slots[i]
+                if s.max_new - s.n_emitted <= 1:
+                    return False
+            if self._preempt_on and any(
+                    q.priority > min(self._slots[i].priority
+                                     for i in pipe.active)
+                    for q in self._queue):
+                # a queued request that outranks a seated slot must
+                # reach the slot-pressure preemption scan NOW, not
+                # after the backlog drains
+                return False
+        if any(s is not None and s.pend_pos is not None
+               for s in self._slots):
+            return False
+        if all(self._slots[i].max_new - self._slots[i].n_emitted <= 1
+               for i in pipe.active):
+            # every slot retires at the in-flight commit (the carry
+            # zeroed all its rows) — a pipelined tick would be a pure
+            # no-op launch
+            return False
+        return self._pipe_grow(pipe)
+
+    def _pipe_grow(self, pipe) -> bool:
+        """Grow blocks for the pipelined tick's write positions: the
+        in-flight tick writes position ``cache_len``, the pipelined
+        one ``cache_len + 1``, both uncommitted host-side. No
+        preemption and no COW: decode appends into tail blocks the
+        slot owns privately; a dry pool returns False (caller
+        flushes)."""
+        for i in pipe.active:
+            slot = self._slots[i]
+            if slot.max_new - slot.n_emitted <= 1:
+                # retires at the in-flight commit (its pipelined row
+                # is zeroed on device) — never writes another block
+                continue
+            need = _pc.blocks_for(slot.cache_len + 2, self._bs)
+            while len(slot.blocks) < need:
+                try:
+                    (blk,) = self._alloc.alloc(1)
+                except RuntimeError:
+                    return False
+                self._tables[i, len(slot.blocks)] = blk
+                slot.blocks.append(blk)
+                self._tables_dev = None
+                self._reserved -= 1
+        return True
+
+    def _dispatch_pipelined(self, prev) -> "_Pipe":
+        """Dispatch the next tick straight from the in-flight tick's
+        device-resident carry: no host packing, no token upload, no
+        blocking fetch — the only host work left is the block-table
+        re-upload when growth touched it. Operand count and shapes
+        are EXACTLY the steady-state sync tick's (the carry rows ARE
+        next tick's packs), so pipelining adds zero executables."""
+        t_tick = time.monotonic()
+        carry_rows, carry_slots = prev.carry
+        if self._tables_dev is None:
+            self._tables_dev = self._dev(self._tables)
+        args = [self._params, self._pools, self._tables_dev,
+                carry_rows, carry_slots]
+        if self._lora_on:
+            args.append(self._lora_operand())
+        args.append(self._samp_operand())
+        args.append(self._next_key())
+        t_l0 = time.monotonic()
+        if self._last_dispatch_t is not None:
+            self._d_host_gap.observe(
+                1000.0 * (t_l0 - self._last_dispatch_t))
+        self._last_dispatch_t = t_l0
+        with _quiet_donation():
+            outs = self._ragged_exec(*args)
+        self._pools = outs[-1]
+        self._m_steps.inc()
+        self._n_decode_steps += 1
+        if self._mesh is not None:
+            self._m_tp_bytes.inc(self._tp_step_bytes)
+            self._n_tp_bytes += self._tp_step_bytes
+        n_slots = self.config.num_slots
+        active = list(prev.active)
+        self._m_util.observe(len(active) / n_slots)
+        # committed cache_len lags the device by one tick: the
+        # pipelined row of slot s attends cache_len + 2 positions
+        # (device-retired rows over-count by their window — analytic
+        # gauge, documented)
+        self._note_kv_read(sum(
+            self._slots[i].cache_len + 2 for i in active))
+        q_lens = np.zeros(n_slots, np.int64)
+        for i in active:
+            q_lens[i] = 1
+        if self._trace is not None:
+            self._trace.instant("pipelined dispatch", tid=0,
+                                args={"active": len(active)})
+        return _Pipe(
+            outs=outs, active=active, given={}, n_pending=0,
+            q_lens=q_lens, rid_of=dict(prev.rid_of), pend_pos0={},
+            t_tick=t_tick, t_l0=t_l0, pure=True,
+            carry=(outs[2], outs[3]))
+
+    def _flush_pipe(self) -> List[tuple]:
+        """Commit any in-flight pipelined tick NOW. Every
+        slot-composition mutator (cancel, preempt, handoff pop,
+        prefilled/migrated admits, session export/drain, shutdown)
+        calls this before touching slot or queue state, so the
+        pipeline only ever overlaps pure steady-state decode. No-op
+        on sync engines and an idle pipeline."""
+        out: List[tuple] = []
+        due, self._commit_due = self._commit_due, None
+        if due is not None:
+            out.extend(self._ragged_commit(due))
+        pipe, self._pipe = self._pipe, None
+        if pipe is not None:
+            self._n_pipe_flushes += 1
+            out.extend(self._ragged_commit(pipe))
+        return out
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drive ``step()`` until queue and slots drain; returns (and
@@ -2542,6 +2904,15 @@ class ServingEngine:
             if self._health is not None
             and self._health._incident is not None else 0,
             "nonfinite_logits_ticks": self._nonfinite_ticks,
+            # async-tick-pipeline keys: ALWAYS present (0 depth / 0
+            # flushes under the PADDLE_TPU_ASYNC_TICK=0 kill switch or
+            # async_depth unset; host_gap_ms observes on sync engines
+            # too — their gap includes the blocking fetch the pipeline
+            # removes) so dashboards never KeyError across a mixed or
+            # rolled-back fleet
+            "async_depth": self._async_depth,
+            "pipeline_flushes": self._n_pipe_flushes,
+            "host_gap_ms": self._d_host_gap.summary(),
         }
         if self._gamma:
             out.update({
@@ -2586,6 +2957,7 @@ class ServingEngine:
         drained with a terminal queue-wait observation
         (outcome="shutdown") — they would otherwise leave no latency
         record at all."""
+        self._flush_pipe()      # surface in-flight tokens first
         while self._queue:
             self._queue_exit(self._queue.popleft(), "shutdown")
         self._sync_cache_metrics()
@@ -2628,6 +3000,7 @@ class ServingEngine:
         router's affinity probe keys on), and the slot is freed for
         the next admission. The caller (``EngineCluster``) imports the
         payload into a decode replica via ``admit_prefilled()``."""
+        self._flush_pipe()      # commit in-flight ticks before mutating
         out = []
         for i in self._handoff_ready:
             slot = self._slots[i]
@@ -2682,6 +3055,7 @@ class ServingEngine:
         TTFT is observed here — the first token already streamed from
         the prefill engine; this request's later emits feed the ITL
         digest only."""
+        self._flush_pipe()      # commit in-flight ticks before mutating
         prompt = np.asarray(prefilled.prompt, np.int32).reshape(-1)
         n_real = int(prompt.size)
         max_new = int(prefilled.max_new_tokens)
@@ -2835,6 +3209,7 @@ class ServingEngine:
         must FOLLOW the KV to the target (``admit_migrated``
         republishes there), not linger on a replica that is going
         away."""
+        self._flush_pipe()      # commit in-flight ticks before mutating
         slot = self._slots[i]
         self._slot_props.pop(i, None)
         samp_row = self._slot_samp[i].copy()
@@ -2925,6 +3300,7 @@ class ServingEngine:
             raise ValueError(
                 "a role='prefill' engine cannot seat a migrated "
                 "session: migration targets must decode")
+        self._flush_pipe()      # commit in-flight ticks before mutating
         n_ctx = int(rec.cache_len)
         history = list(map(int, rec.history))
         if len(history) > self.config.max_model_len:
@@ -3055,6 +3431,7 @@ class ServingEngine:
         ``pop_prefilled()`` first; their payloads are self-contained.
         Queue exits observe outcome="migrated". Returns
         ``(migrations, fresh_requests)``."""
+        self._flush_pipe()      # commit in-flight ticks before mutating
         for i, slot in enumerate(self._slots):
             if slot is None or slot.handoff:
                 continue
@@ -3781,6 +4158,7 @@ class ServingEngine:
         state (cache_len / last_token / n_emitted / history / sampling
         row) — resume is token-exact by construction on either
         path."""
+        self._flush_pipe()      # no-op mid-tick (pipe already drained)
         slot = self._slots[i]
         self._slot_props.pop(i, None)
         samp_row = self._slot_samp[i].copy()
@@ -4438,6 +4816,8 @@ class ServingEngine:
                 g.labels(q=q).set(round(v, 3))
         for q, v in self._d_accept.quantiles().items():
             self._m_accept.labels(q=q).set(round(v, 3))
+        for q, v in self._d_host_gap.quantiles().items():
+            self._m_host_gap.labels(q=q).set(round(v, 3))
 
     def _prefill_bucketed(self, i, req, n_real) -> int:
         """Legacy bucketed prefill (``PADDLE_TPU_CHUNKED_PREFILL=0`` /
@@ -4796,6 +5176,20 @@ class ServingEngine:
         # shards on the existing GSPMD cut instead (the gmm kernel's
         # scalar-prefetch gather is a single-device layout)
         lora_gmm_ok = self._mesh is None
+        # async tick pipeline: the g=0 executable additionally returns
+        # next-tick inputs as DEVICE arrays (the carry) — per-slot
+        # sampled token, advanced base length, a decremented budget and
+        # an in-executable ``done`` mask (EOS or budget exhausted) that
+        # zeroes a finished slot's next-tick row so a pipelined tick
+        # no-ops it on device (row parks at the overflow position — the
+        # KV write null-routes, exactly like a pad row). Under the
+        # PADDLE_TPU_ASYNC_TICK=0 kill switch this flag is False and
+        # the compiled graph is bit-for-bit today's.
+        async_carry = self._async_on and not g
+        eos = self._eos
+        pad = self._pad
+        n_slots = self.config.num_slots
+        overflow = self._overflow
 
         def ragged(params, pools, tables, rows_pack, slots_pack, *rest):
             if lora_on:
@@ -4857,10 +5251,55 @@ class ServingEngine:
                 # of the same executable, never a new one. Always
                 # computed (executable stays bit-identical under
                 # PADDLE_TPU_HEALTH=0); only the host fetch is gated.
-                nf = jnp.any(~jnp.isfinite(rows))
+                if not async_carry:
+                    nf = jnp.any(~jnp.isfinite(rows))
+                    _, sel = jax.random.split(key)
+                    tok, _ = self._select_rows(rows, sel, samp)
+                    return tok, nf, pools
+                # pipelined mode masks the probe to LIVE slots: a
+                # device-carried tick packs row i <-> slot i, so a dead
+                # slot's gathered row is an overflow pad row whose
+                # fully-masked attention output is not meaningful
+                live = q_lens > 0
+                nf = jnp.any(~jnp.isfinite(rows) & live[:, None])
                 _, sel = jax.random.split(key)
                 tok, _ = self._select_rows(rows, sel, samp)
-                return tok, nf, pools
+                tok = tok.astype(jnp.int32)
+                # -- device-resident carry: tick N+1's packs ----------
+                budget = slots_pack[-1]
+                done = live & ((tok == eos) | (budget <= 1))
+                live2 = live & ~done
+                sl = jnp.arange(n_slots, dtype=jnp.int32)
+                nxt_base = jnp.where(live, base + 1, base)
+                nxt_budget = jnp.where(live, budget - 1, budget)
+                tail = r - n_slots      # pad rows past the slot rows
+                ids2 = jnp.concatenate(
+                    [jnp.where(live2, tok, pad),
+                     jnp.full((tail,), pad, jnp.int32)])
+                slot2 = jnp.concatenate(
+                    [sl, jnp.zeros((tail,), jnp.int32)])
+                pos2 = jnp.concatenate(
+                    [jnp.where(live2, nxt_base, overflow)
+                     .astype(jnp.int32),
+                     jnp.full((tail,), overflow, jnp.int32)])
+                carry_rows = jnp.stack([ids2, slot2, pos2])
+                crows = [nxt_base, live2.astype(base.dtype), sl, sl]
+                if lora_on:
+                    crows.append(slots_pack[lora_row])
+                crows.append(nxt_budget)
+                carry_slots = jnp.stack(
+                    [c.astype(jnp.int32) for c in crows])
+                if self._mesh is not None:
+                    # compiled executables are strict about INPUT
+                    # shardings — the carry feeds straight back as
+                    # next tick's packs, so pin it replicated (what
+                    # _dev commits host packs as)
+                    rep = NamedSharding(self._mesh, P(None, None))
+                    carry_rows = jax.lax.with_sharding_constraint(
+                        carry_rows, rep)
+                    carry_slots = jax.lax.with_sharding_constraint(
+                        carry_slots, rep)
+                return tok, nf, carry_rows, carry_slots, pools
             toks = rest[0]
             if tree is not None:
                 heads = rest[1] if heads_on else None

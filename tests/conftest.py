@@ -140,6 +140,17 @@ import pytest
 # that. Post-trim the tier measured 1015s on the same 1-CPU box
 # (764 passed, 0 failed) — i.e. back inside budget everywhere but
 # the serialized-compile 1-CPU class.
+#
+# r20 re-sweep (async tick pipeline): the 20 new test_async_tick.py
+# tests measured ~77s total solo on the 1-CPU box, slowest 6.9s (the
+# spec-tree arm of the async==sync parity matrix — a dual serve per
+# arm) — all under the ~9s line, so no new entries and no in-file
+# markers. Costs are dominated by the dual sync/async serves each
+# parity case runs; the tiny Llama/GPT models are module-scoped
+# fixtures, so adding a parity arm reuses the model build. The async
+# engine itself adds no compile cost to other suites: depth-1 shares
+# the sync ragged executable (executables_compiled stays 1, pinned by
+# the matrix).
 _SLOW_TESTS = {
     # r19 re-tier (1-CPU durations; see note above):
     "test_export_chrome_trace_loadable",                        # 10.5s
